@@ -1,0 +1,53 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of the reference distributed
+actor/task runtime (see SURVEY.md), re-designed TPU-first: the task/actor
+core is a lean single-control-plane runtime (tasks, actors, shared-memory
+objects, resource scheduling, placement groups), and the ML stack above it —
+train / tune / data / serve / rl — drives JAX/XLA SPMD programs over device
+meshes, with collectives compiled onto ICI instead of NCCL.
+
+Public surface mirrors the reference's top-level API:
+``init, remote, get, put, wait, kill, cancel, get_actor, method, nodes,
+cluster_resources, available_resources, shutdown`` plus the subpackages
+``train``, ``tune``, ``data``, ``serve``, ``rl``, ``util``, ``collective``.
+"""
+
+from ray_tpu._private.api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu._private.runtime import ObjectRef  # noqa: F401
+from ray_tpu.actor import get_actor, method  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+__version__ = "0.1.0"
+
+_LAZY_SUBMODULES = {
+    "train", "tune", "data", "serve", "rl", "util", "collective", "parallel",
+    "ops", "models", "accelerators", "cluster_utils", "dag", "workflow", "internal",
+}
+
+
+def __getattr__(name):
+    # Heavy subpackages (anything touching jax) load lazily so that bare
+    # runtime workers spawn fast on a 1-core host.
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"ray_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

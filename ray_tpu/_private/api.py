@@ -1,0 +1,240 @@
+"""Top-level API: init/shutdown/remote/get/put/wait/kill/cancel/...
+
+Counterpart of the reference's ``python/ray/_private/worker.py`` public
+surface (``ray.init`` :1225, ``get`` :2553, ``put`` :2685, ``wait`` :2750)
+minus the daemon zoo: ``init()`` stands up the in-driver Head, registers this
+host as the first node (auto-detecting CPUs and TPU chips), and installs the
+driver context.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import tempfile
+import time
+from typing import Any, Optional, Sequence, Union
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private import runtime
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.head import Head
+from ray_tpu._private.runtime import DriverContext, ObjectRef
+
+_head: Optional[Head] = None
+_session_dir: Optional[str] = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    num_gpus: Optional[int] = None,
+    resources: Optional[dict[str, float]] = None,
+    labels: Optional[dict[str, str]] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
+    namespace: Optional[str] = None,
+    _system_config: Optional[dict[str, Any]] = None,
+    _head: Optional[Head] = None,
+    _node_id=None,
+):
+    """Start (or attach to) a cluster and install the driver context.
+
+    With no arguments this host becomes a single-node cluster, like the
+    reference's ``ray.init()`` auto-start path. ``_head``/``_node_id`` are the
+    attach path used by cluster_utils test clusters.
+    """
+    global _session_dir
+    if runtime.is_initialized():
+        if ignore_reinit_error:
+            return _context_info()
+        raise rex.RayError("ray_tpu.init() called twice; pass ignore_reinit_error=True to ignore")
+    GLOBAL_CONFIG.apply_overrides(_system_config)
+    if address is not None and _head is None:
+        from ray_tpu.cluster_utils import resolve_address
+
+        cluster = resolve_address(address)
+        if cluster.head_node is None:
+            raise rex.RayError("Cluster has no head node")
+        _head, _node_id = cluster.head, cluster.head_node
+    if _head is not None:
+        head = _head
+        node_id = _node_id
+    else:
+        _session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+        sock = os.path.join(_session_dir, "head.sock")
+        head = Head(sock, authkey=os.urandom(16))
+        head.start()
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))
+        if num_gpus is not None:
+            res.setdefault("GPU", float(num_gpus))
+        tpu_chips = num_tpus
+        if tpu_chips is None:
+            from ray_tpu.accelerators import tpu as tpu_accel
+
+            tpu_chips = tpu_accel.detect_num_chips()
+        if tpu_chips:
+            res.setdefault("TPU", float(tpu_chips))
+            from ray_tpu.accelerators import tpu as tpu_accel
+
+            for k, v in tpu_accel.extra_resources(tpu_chips).items():
+                res.setdefault(k, v)
+        res.setdefault("memory", _default_memory(object_store_memory))
+        node_id = head.add_node(res, labels=labels)
+    ctx = DriverContext(head, node_id.binary())
+    runtime.set_ctx(ctx)
+    _set_head(head)
+    atexit.register(_atexit_shutdown)
+    return _context_info()
+
+
+def _set_head(head):
+    global _head
+    _head = head
+
+
+def _default_memory(object_store_memory):
+    if object_store_memory:
+        return float(object_store_memory)
+    if GLOBAL_CONFIG.object_store_memory:
+        return float(GLOBAL_CONFIG.object_store_memory)
+    try:
+        import psutil
+
+        return float(psutil.virtual_memory().total * 0.3)
+    except Exception:
+        return float(8 << 30)
+
+
+def _context_info():
+    return {"node_id": runtime.get_ctx().node_id_bin.hex(), "session_dir": _session_dir}
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    return runtime.is_initialized()
+
+
+def shutdown():
+    global _head
+    if not runtime.is_initialized():
+        return
+    ctx = runtime.get_ctx()
+    ctx.shutdown()
+    runtime.set_ctx(None)
+    if _head is not None:
+        _head.shutdown()
+        _head = None
+
+
+def put(value: Any) -> ObjectRef:
+    return runtime.get_ctx().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    ctx = runtime.get_ctx()
+    if isinstance(refs, ObjectRef):
+        return ctx.get([refs], timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"ray_tpu.get() takes ObjectRefs, got {type(r)}")
+        return ctx.get(list(refs), timeout)
+    raise TypeError(f"ray_tpu.get() takes an ObjectRef or a list, got {type(refs)}")
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("ray_tpu.wait() takes a list of ObjectRefs")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns > number of refs")
+    if num_returns <= 0:
+        raise ValueError("num_returns must be > 0")
+    return runtime.get_ctx().wait(refs, num_returns, timeout, fetch_local)
+
+
+def remote(*args, **kwargs):
+    from ray_tpu.remote_function import remote_decorator
+
+    return remote_decorator(args, kwargs)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("ray_tpu.kill() takes an actor handle")
+    runtime.get_ctx().call("kill_actor", actor_id=actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("ray_tpu.cancel() takes an ObjectRef")
+    # task id = first 12 bytes of a return object id + index; the head keys
+    # tasks by full task_id, so reconstruct it
+    from ray_tpu._private.ids import TaskID
+
+    task_id = ref.binary()[:12] + b"\x00\x00\x00\x00"
+    runtime.get_ctx().call("cancel_task", task_id=task_id, force=force)
+
+
+def nodes():
+    return runtime.get_ctx().call("nodes")
+
+
+def cluster_resources() -> dict[str, float]:
+    return runtime.get_ctx().call("cluster_resources")
+
+
+def available_resources() -> dict[str, float]:
+    return runtime.get_ctx().call("available_resources")
+
+
+class RuntimeContext:
+    """Reference: ``ray.runtime_context.RuntimeContext``."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def get_node_id(self) -> str:
+        return self._ctx.node_id_bin.hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        inst = getattr(self._ctx, "current_actor", None)
+        return None if inst is None else inst
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_assigned_resources(self) -> dict:
+        return {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(runtime.get_ctx())
+
+
+def timeline() -> list[dict]:
+    """Task state-transition events (reference: ``ray.timeline`` Chrome trace
+    from the GCS task-event table)."""
+    return runtime.get_ctx().call("task_events")

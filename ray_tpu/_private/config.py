@@ -1,0 +1,82 @@
+"""Runtime configuration flags.
+
+TPU-native analogue of the reference's ``RAY_CONFIG(type, name, default)`` flag
+system (``src/ray/common/ray_config_def.h`` — 218 flags, overridable via
+``RAY_{name}`` env vars or a ``_system_config`` dict passed to ``ray.init``).
+
+We keep the same three override tiers: compiled-in default < environment
+variable ``RAY_TPU_{NAME}`` < explicit ``_system_config`` dict at ``init()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class Config:
+    # -- object store ------------------------------------------------------
+    #: Objects at or below this many serialized bytes live in the in-process
+    #: store; larger ones go to a shared-memory segment (reference: core
+    #: worker memory store promotes to plasma above ~100KB).
+    max_direct_call_object_size: int = 100 * 1024
+    #: Logical "memory" resource advertised by a node when ``ray.init`` is not
+    #: given ``object_store_memory`` (reference: plasma store capacity).
+    object_store_memory: int = 0  # 0 = auto (30% of system RAM)
+
+    # -- scheduler ---------------------------------------------------------
+    #: Hybrid scheduling policy: pack onto busiest feasible node until its
+    #: critical-resource utilization exceeds this threshold, then prefer the
+    #: least-utilized node (reference: hybrid_scheduling_policy.cc,
+    #: ``scheduler_spread_threshold``).
+    scheduler_spread_threshold: float = 0.5
+    #: Max queued-but-infeasible warning interval.
+    infeasible_warn_interval_s: float = 30.0
+
+    # -- workers -----------------------------------------------------------
+    #: Idle (non-actor) workers are reaped by the health loop after this many
+    #: seconds without a task, when nothing is queued (reference: worker_pool
+    #: idle worker killing). 0 disables reaping.
+    idle_worker_keep_alive_s: float = 60.0
+    #: Default max_retries for normal tasks (reference:
+    #: ``task_retry_delay_ms`` / default 3 retries).
+    default_max_retries: int = 3
+
+    # -- actors ------------------------------------------------------------
+    default_max_restarts: int = 0
+    default_max_task_retries: int = 0
+
+    # -- health ------------------------------------------------------------
+    #: Interval of the head's liveness sweep over worker processes
+    #: (reference: GcsHealthCheckManager probing raylets).
+    health_check_interval_s: float = 1.0
+
+    # -- logging -----------------------------------------------------------
+    log_to_driver: bool = True
+
+    def apply_overrides(self, system_config: dict[str, Any] | None = None) -> None:
+        for f in dataclasses.fields(self):
+            env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type))
+        for k, v in (system_config or {}).items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown _system_config key: {k!r}")
+            setattr(self, k, v)
+
+
+def _coerce(raw: str, typ: Any) -> Any:
+    typ = str(typ)
+    if "bool" in typ:
+        return raw.lower() in ("1", "true", "yes")
+    if "int" in typ:
+        return int(raw)
+    if "float" in typ:
+        return float(raw)
+    return raw
+
+
+GLOBAL_CONFIG = Config()
+GLOBAL_CONFIG.apply_overrides()

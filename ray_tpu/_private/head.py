@@ -1,0 +1,1470 @@
+"""The head: control plane of a ray_tpu "cluster".
+
+The reference splits its control plane across three daemons — GCS (cluster
+metadata, ``src/ray/gcs/gcs_server/gcs_server.cc:187``), per-node raylets
+(scheduling + worker pools, ``src/ray/raylet/node_manager.cc``), and a plasma
+store — talking gRPC. On a TPU pod the topology is static and every data-plane
+byte that matters moves over ICI inside compiled XLA programs, so the
+host-side control plane can be radically simpler: one Head object living in
+the driver process, with worker processes attached over a unix socket.
+
+It still implements the same *capabilities*, each tagged with its reference
+counterpart:
+
+* cluster membership + logical resources per node      (GcsNodeManager /
+  ClusterResourceManager)
+* hybrid pack/spread scheduling, spread + node-affinity + placement-group
+  strategies                                           (cluster_task_manager.cc,
+  scheduling/policy/*)
+* worker pools with on-demand spawn + idle reuse       (worker_pool.h:152)
+* dependency-gated dispatch                            (dependency_manager.h)
+* object directory w/ inline + shm locations, waiters  (memory_store +
+  plasma + ownership directory)
+* task retries, worker-crash detection, actor restart
+  state machine, named/detached actors                 (task_manager.cc,
+  gcs_actor_manager.cc, gcs_health_check_manager.h)
+* placement groups PACK/SPREAD/STRICT_*                (gcs_placement_group_*)
+* function table, KV store                             (GCS internal KV)
+
+Multi-"node" test clusters add virtual nodes to the same Head
+(cluster_utils.Cluster mirrors the reference's ``cluster_utils.py:108``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu._private.shm_store import ShmLocation, ShmOwner
+
+# --------------------------------------------------------------------------
+# Object directory
+
+
+class ObjectEntry:
+    __slots__ = ("small", "shm", "is_error", "refcount", "pins", "size")
+
+    def __init__(self):
+        self.small: Optional[bytes] = None
+        self.shm: Optional[ShmLocation] = None
+        self.is_error = False
+        self.refcount = 0  # driver-side ObjectRef count
+        self.pins = 0  # pending-task dependency pins
+        self.size = 0
+
+    @property
+    def ready(self) -> bool:
+        return self.small is not None or self.shm is not None
+
+    def locator(self):
+        if self.small is not None:
+            return ("inline", self.small, self.is_error)
+        return ("shm", self.shm, self.is_error)
+
+
+# --------------------------------------------------------------------------
+# Nodes / workers
+
+
+class _WorkerProc:
+    """Subprocess handle with the process API the head expects
+    (pid / is_alive / terminate / join)."""
+
+    __slots__ = ("popen", "pid")
+
+    def __init__(self, popen):
+        self.popen = popen
+        self.pid = popen.pid
+
+    def is_alive(self) -> bool:
+        return self.popen.poll() is None
+
+    def terminate(self):
+        try:
+            self.popen.terminate()
+        except OSError:
+            pass
+
+    def join(self, timeout=None):
+        try:
+            self.popen.wait(timeout=timeout)
+        except Exception:
+            pass
+
+
+class WorkerHandle:
+    """A connected worker process (reference: raylet's WorkerInterface)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, node: "NodeState", proc, conn=None):
+        self.wid = next(WorkerHandle._ids)
+        self.node = node
+        self.proc = proc  # _WorkerProc (None for remote attach)
+        self.conn = conn  # set at registration
+        self.alive = True
+        self.current_task: Optional[dict] = None
+        self.actor_id: Optional[bytes] = None
+        self.idle_since = time.monotonic()
+        self.send_lock = threading.Lock()
+
+    def send(self, msg) -> bool:
+        try:
+            with self.send_lock:
+                self.conn.send(msg)
+            return True
+        except (OSError, ValueError, BrokenPipeError):
+            return False
+
+
+class NodeState:
+    def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
+        self.node_id = node_id
+        self.resources_total = dict(resources)
+        self.resources_avail = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+        self.idle_workers: list[WorkerHandle] = []
+        self.all_workers: set[WorkerHandle] = set()
+        self.spawning = 0
+        self.assigned: deque = deque()  # tasks waiting for a worker on this node
+        # placement-group reservations: pg_id -> bundle_index -> avail dict
+        self.pg_reserved: dict[bytes, dict[int, dict[str, float]]] = {}
+
+    def can_fit(self, res: dict[str, float]) -> bool:
+        return all(self.resources_avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items() if v > 0)
+
+    def allocate(self, res: dict[str, float]) -> None:
+        for k, v in res.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) - v
+
+    def release(self, res: dict[str, float]) -> None:
+        for k, v in res.items():
+            self.resources_avail[k] = min(
+                self.resources_avail.get(k, 0.0) + v, self.resources_total.get(k, 0.0)
+            )
+
+    def utilization(self, res: dict[str, float]) -> float:
+        """Max utilization over the resources this task needs (reference:
+        hybrid policy's critical-resource utilization)."""
+        u = 0.0
+        for k, v in res.items():
+            if v <= 0:
+                continue
+            total = self.resources_total.get(k, 0.0)
+            if total <= 0:
+                return 1.0
+            u = max(u, 1.0 - (self.resources_avail.get(k, 0.0) - v) / total)
+        return u
+
+
+# --------------------------------------------------------------------------
+# Actors
+
+
+ACTOR_PENDING, ACTOR_RESTARTING, ACTOR_ALIVE, ACTOR_DEAD = range(4)
+
+
+class ActorState:
+    def __init__(self, actor_id: bytes, create_spec: dict):
+        self.actor_id = actor_id
+        self.create_spec = create_spec
+        self.state = ACTOR_PENDING
+        self.worker: Optional[WorkerHandle] = None
+        self.node_id: Optional[NodeID] = None
+        self.restarts_left = create_spec.get("max_restarts", 0)
+        self.max_task_retries = create_spec.get("max_task_retries", 0)
+        self.name = create_spec.get("name")
+        self.detached = create_spec.get("lifetime") == "detached"
+        self.pending_calls: deque = deque()  # method specs queued while not ALIVE
+        self.inflight: dict[bytes, dict] = {}  # task_id -> spec sent to worker
+        self.num_handles = 1
+        self.death_cause: Optional[str] = None
+        self.alloc = None  # lifetime resource allocation (held until death)
+
+
+# --------------------------------------------------------------------------
+# Placement groups
+
+PG_PENDING, PG_CREATED, PG_REMOVED = range(3)
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: bytes, bundles: list[dict], strategy: str, name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = PG_PENDING
+        self.bundle_nodes: list[Optional[NodeID]] = [None] * len(bundles)
+        self.ready_event = threading.Event()
+
+
+# --------------------------------------------------------------------------
+
+
+class Head:
+    def __init__(self, socket_path: str, authkey: bytes):
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)  # object readiness + pg + actor events
+        self.socket_path = socket_path
+        self.authkey = authkey
+        self.shm_owner = ShmOwner()
+
+        self.objects: dict[bytes, ObjectEntry] = {}
+        self.functions: dict[bytes, bytes] = {}  # func table (reference: GCS fn table)
+        self.kv: dict[str, bytes] = {}
+
+        self.nodes: dict[bytes, NodeState] = {}
+        self.node_order: list[bytes] = []
+        self.actors: dict[bytes, ActorState] = {}
+        self.named_actors: dict[str, bytes] = {}
+        self.placement_groups: dict[bytes, PlacementGroupState] = {}
+
+        # tasks waiting on deps: obj_id -> set of task records
+        self.dep_waiters: dict[bytes, set] = {}
+        self.pending_sched: deque = deque()  # dep-free tasks awaiting node pick
+        # actor_id -> actor_create rec awaiting its dedicated worker
+        self._actor_create_recs: dict[bytes, dict] = {}
+        self.tasks: dict[bytes, dict] = {}  # task_id -> record (pending/running)
+        self.cancelled: set[bytes] = set()
+
+        self._shutdown = False
+        self._listener = None
+        self._threads: list[threading.Thread] = []
+        self._conn_worker: dict[Any, WorkerHandle] = {}
+        self.task_events: list[dict] = []  # observability feed (state API)
+        self._infeasible_warned: dict[bytes, float] = {}
+
+    # ---------------------------------------------------------------- wiring
+
+    def start(self):
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener(self.socket_path, family="AF_UNIX", authkey=self.authkey)
+        t = threading.Thread(target=self._accept_loop, name="head-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        h = threading.Thread(target=self._health_loop, name="head-health", daemon=True)
+        h.start()
+        self._threads.append(h)
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn):
+        worker: Optional[WorkerHandle] = None
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+                kind = msg[0]
+                if kind == "register":
+                    worker = self._on_register(conn, msg[1])
+                elif kind == "req":
+                    _, seq, method, payload = msg
+                    self._dispatch_request(conn, worker, seq, method, payload)
+                elif kind == "task_done":
+                    self._on_task_done(worker, msg[1])
+                elif kind == "actor_ready":
+                    self._on_actor_ready(worker, msg[1])
+        finally:
+            if worker is not None:
+                self._on_worker_disconnect(worker)
+
+    def _dispatch_request(self, conn, worker, seq, method, payload):
+        handler = getattr(self, "rpc_" + method)
+        blocking = method in ("get", "wait", "pg_ready", "get_actor_named")
+        if blocking:
+            threading.Thread(
+                target=self._run_request, args=(conn, worker, seq, handler, payload), daemon=True
+            ).start()
+        else:
+            self._run_request(conn, worker, seq, handler, payload)
+
+    def _run_request(self, conn, worker, seq, handler, payload):
+        try:
+            result = handler(**payload)
+            out = ("resp", seq, True, result)
+        except BaseException as e:  # noqa: BLE001 - errors cross the socket
+            out = ("resp", seq, False, e if _picklable(e) else rex.RayError(repr(e)))
+        try:
+            if worker is not None:
+                with worker.send_lock:
+                    conn.send(out)
+            else:
+                conn.send(out)
+        except (OSError, ValueError, BrokenPipeError):
+            pass
+
+    # -------------------------------------------------------------- workers
+
+    def _spawn_worker(self, node: NodeState, actor_id: Optional[bytes] = None) -> None:
+        # Workers are fresh interpreter processes running a dedicated entry
+        # point (`python -m ray_tpu._private.worker_main`), like the
+        # reference's worker pool (worker_pool.h:152) execing default_worker.py
+        # — NOT multiprocessing children, which would re-import the user's
+        # __main__ module (fatal for unguarded driver scripts).
+        import subprocess
+        import sys
+
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        popen = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.worker_main",
+                self.socket_path,
+                self.authkey.hex(),
+                node.node_id.binary().hex(),
+            ],
+            env=env,
+            start_new_session=False,
+        )
+        proc = _WorkerProc(popen)
+        wh = WorkerHandle(node, proc)
+        wh.actor_id = actor_id
+        with self.lock:
+            node.all_workers.add(wh)
+        # registration arrives on its own connection; matched in _on_register
+
+    def _on_register(self, conn, info) -> WorkerHandle:
+        node_id = info["node_id"]
+        pid = info["pid"]
+        with self.lock:
+            node = self.nodes[node_id]
+            wh = None
+            for cand in node.all_workers:
+                if cand.conn is None and cand.proc is not None and cand.proc.pid == pid:
+                    wh = cand
+                    break
+            if wh is None:  # race-safe fallback
+                wh = WorkerHandle(node, None)
+                node.all_workers.add(wh)
+            wh.conn = conn
+            if wh.actor_id is None:
+                node.spawning = max(0, node.spawning - 1)
+            self._conn_worker[conn] = wh
+            if wh.actor_id is not None:
+                rec = self._actor_create_recs.pop(wh.actor_id, None)
+                if rec is not None and rec["task_id"] in self.cancelled:
+                    # creation cancelled while the worker was coming up:
+                    # resolve the creation refs and mark the actor dead
+                    self._finish_cancelled(rec)
+                    actor = self.actors.get(wh.actor_id)
+                    if actor is not None and actor.state != ACTOR_DEAD:
+                        actor.restarts_left = 0
+                        self._kill_actor_locked(actor, "creation cancelled", restart=False)
+                    rec = None
+                if rec is None:
+                    # actor died/was cancelled before its worker came up
+                    wh.alive = False
+                    wh.send(("exit", None))
+                else:
+                    self._dispatch_to_worker(wh, rec)
+            else:
+                self._worker_idle(wh)
+        return wh
+
+    def _worker_idle(self, wh: WorkerHandle):
+        """Called with lock held: worker finished a task / just registered."""
+        node = wh.node
+        wh.current_task = None
+        wh.idle_since = time.monotonic()
+        if wh.actor_id is not None:
+            # Dedicated actor worker (reference: actors own their worker
+            # process for life) — it must never join the general pool, or a
+            # blocking normal task could wedge the actor's serial queue.
+            return
+        while node.assigned and node.alive:
+            rec = node.assigned.popleft()
+            if rec["task_id"] in self.cancelled:
+                self._finish_cancelled(rec)
+                continue
+            if self._dispatch_to_worker(wh, rec):
+                return
+            if not wh.alive:
+                # dispatch failure killed the worker (and requeued rec);
+                # don't feed further queued tasks to a dead worker.
+                return
+        if wh not in node.idle_workers:
+            node.idle_workers.append(wh)
+
+    def _dispatch_to_worker(self, wh: WorkerHandle, rec: dict) -> bool:
+        wh.current_task = rec
+        if wh in wh.node.idle_workers:
+            wh.node.idle_workers.remove(wh)
+        rec["worker"] = wh
+        rec["state"] = "RUNNING"
+        self._event(rec, "RUNNING")
+        if not wh.send(("run_task", rec["spec"])):
+            self._handle_worker_death_locked(wh)
+            return False
+        return True
+
+    # ------------------------------------------------------------ node admin
+
+    def add_node(self, resources: dict[str, float], labels=None) -> NodeID:
+        node_id = NodeID.from_random()
+        with self.lock:
+            self.nodes[node_id.binary()] = NodeState(node_id, resources, labels)
+            self.node_order.append(node_id.binary())
+            self._retry_pending_pgs()
+            self._schedule()
+        return node_id
+
+    def remove_node(self, node_id: NodeID, graceful: bool = False) -> None:
+        """Simulated node failure (reference: NodeKillerActor / node death in
+        GCS). Kills all workers, fails or retries their tasks, restarts their
+        actors elsewhere."""
+        with self.lock:
+            node = self.nodes.get(node_id.binary())
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            workers = list(node.all_workers)
+            assigned = list(node.assigned)
+            node.assigned.clear()
+            node.idle_workers.clear()
+        for wh in workers:
+            wh.alive = False
+            if wh.proc is not None and wh.proc.is_alive():
+                wh.proc.terminate()
+        with self.lock:
+            for rec in assigned:
+                self._requeue_or_fail(rec, rex.WorkerCrashedError("node removed"))
+            for wh in workers:
+                self._handle_worker_death_locked(wh)
+            for pg in self.placement_groups.values():
+                if any(n == node_id for n in pg.bundle_nodes):
+                    for i, n in enumerate(pg.bundle_nodes):
+                        if n == node_id:
+                            pg.bundle_nodes[i] = None
+                    pg.state = PG_PENDING
+                    pg.ready_event.clear()
+                    self._try_place_pg(pg)
+            self._schedule()
+            self.cv.notify_all()
+
+    # ----------------------------------------------------------- scheduling
+
+    def submit_task(self, spec: dict) -> None:
+        rec = {
+            "task_id": spec["task_id"],
+            "spec": spec,
+            "deps": set(),
+            "state": "PENDING",
+            "worker": None,
+            "node": None,
+            "retries_left": spec.get("max_retries", GLOBAL_CONFIG.default_max_retries),
+        }
+        with self.lock:
+            strategy = spec.get("strategy")
+            if strategy and strategy[0] == "pg":
+                # Fail fast if the task can never fit its designated bundle
+                # (reference: ValueError on infeasible bundle resources).
+                _, pg_id, bundle_idx, _ = strategy
+                pg = self.placement_groups.get(pg_id)
+                if pg is None:
+                    for rid in spec["return_ids"]:
+                        self._store_error(rid, ValueError("placement group removed"))
+                    return
+                res = self._effective_resources(spec)
+                bundles = [pg.bundles[bundle_idx]] if bundle_idx >= 0 else pg.bundles
+                if not any(
+                    all(b.get(k, 0.0) >= v for k, v in res.items()) for b in bundles
+                ):
+                    for rid in spec["return_ids"]:
+                        self._store_error(
+                            rid,
+                            ValueError(
+                                f"Task {spec.get('name')} requires {res} which can never fit "
+                                f"in placement group bundle(s) {bundles}; pass num_cpus=0 for "
+                                f"tasks in accelerator-only bundles"
+                            ),
+                        )
+                    return
+            self.tasks[spec["task_id"]] = rec
+            self._event(rec, "PENDING_ARGS_AVAIL")
+            for kind, payload in _iter_arg_refs(spec):
+                ent = self.objects.get(payload)
+                if ent is None:
+                    ent = self.objects[payload] = ObjectEntry()
+                ent.pins += 1
+                if not ent.ready:
+                    rec["deps"].add(payload)
+                    self.dep_waiters.setdefault(payload, set()).add(rec["task_id"])
+            if rec["deps"]:
+                rec["state"] = "WAITING_DEPS"
+            else:
+                self.pending_sched.append(rec)
+                self._schedule()
+
+    def _deps_ready(self, obj_id: bytes):
+        """Lock held. An object became available; activate waiting tasks."""
+        for tid in self.dep_waiters.pop(obj_id, ()):  # noqa: B020
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            rec["deps"].discard(obj_id)
+            if not rec["deps"] and rec["state"] == "WAITING_DEPS":
+                rec["state"] = "PENDING"
+                self.pending_sched.append(rec)
+        self._schedule()
+
+    def _schedule(self):
+        """Lock held. Hybrid policy (reference hybrid_scheduling_policy.cc):
+        prefer the first feasible node whose critical-resource utilization
+        stays under the spread threshold (pack); otherwise the least-utilized
+        feasible node (spread). Honors strategies: SPREAD, node affinity,
+        placement-group bundles."""
+        still_pending = deque()
+        while self.pending_sched:
+            rec = self.pending_sched.popleft()
+            if rec["task_id"] in self.cancelled:
+                self._finish_cancelled(rec)
+                continue
+            node = self._pick_node(rec["spec"])
+            if node is None:
+                still_pending.append(rec)
+                self._warn_infeasible(rec)
+                continue
+            res = self._effective_resources(rec["spec"])
+            self._allocate_for(rec, node, res)
+            rec["node"] = node.node_id
+            rec["state"] = "ASSIGNED"
+            if rec["spec"]["kind"] == "actor_create":
+                self._start_actor_on(rec, node)
+            elif node.idle_workers:
+                wh = node.idle_workers.pop()
+                self._dispatch_to_worker(wh, rec)
+            else:
+                node.assigned.append(rec)
+                self._maybe_spawn(node)
+        self.pending_sched = still_pending
+
+    def _warn_infeasible(self, rec):
+        now = time.monotonic()
+        tid = rec["task_id"]
+        if now - self._infeasible_warned.get(tid, 0.0) > GLOBAL_CONFIG.infeasible_warn_interval_s:
+            self._infeasible_warned[tid] = now
+            res = self._effective_resources(rec["spec"])
+            total = {}
+            for n in self.nodes.values():
+                if n.alive:
+                    for k, v in n.resources_total.items():
+                        total[k] = max(total.get(k, 0.0), v)
+            if any(total.get(k, 0.0) < v for k, v in res.items() if v > 0):
+                print(
+                    f"[ray_tpu] WARNING: task {rec['spec'].get('name')} requires {res} "
+                    f"which no node can ever satisfy (per-node max {total})."
+                )
+
+    def _effective_resources(self, spec: dict) -> dict[str, float]:
+        return {k: v for k, v in spec.get("resources", {}).items() if v != 0}
+
+    def _pick_node(self, spec: dict) -> Optional[NodeState]:
+        res = self._effective_resources(spec)
+        strategy = spec.get("strategy")
+        alive = [self.nodes[nid] for nid in self.node_order if self.nodes[nid].alive]
+        if not alive:
+            return None
+        if strategy and strategy[0] == "pg":
+            _, pg_id, bundle_idx, _ = strategy
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state != PG_CREATED:
+                return None
+            indices = [bundle_idx] if bundle_idx >= 0 else range(len(pg.bundles))
+            for bi in indices:
+                nid = pg.bundle_nodes[bi]
+                if nid is None:
+                    continue
+                node = self.nodes[nid.binary()]
+                avail = node.pg_reserved.get(pg_id, {}).get(bi, {})
+                if node.alive and all(avail.get(k, 0.0) + 1e-9 >= v for k, v in res.items()):
+                    spec["_pg_bundle"] = (pg_id, bi)
+                    return node
+            return None
+        if strategy and strategy[0] == "node":
+            _, node_hex, soft = strategy
+            node = self.nodes.get(bytes.fromhex(node_hex))
+            if node is not None and node.alive and node.can_fit(res):
+                return node
+            if not soft:
+                return None
+            # soft affinity falls through to default policy
+        feasible = [n for n in alive if n.can_fit(res)]
+        if not feasible:
+            return None
+        if strategy and strategy[0] == "spread":
+            return min(feasible, key=lambda n: (n.utilization(res), self.node_order.index(n.node_id.binary())))
+        # hybrid: first node (stable order) under threshold, else least utilized
+        thr = GLOBAL_CONFIG.scheduler_spread_threshold
+        for n in feasible:
+            if n.utilization(res) <= thr:
+                return n
+        return min(feasible, key=lambda n: n.utilization(res))
+
+    def _allocate_for(self, rec, node: NodeState, res):
+        bundle = rec["spec"].get("_pg_bundle")
+        if bundle is not None:
+            pg_id, bi = bundle
+            avail = node.pg_reserved[pg_id][bi]
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0.0) - v
+        else:
+            node.allocate(res)
+        rec["alloc"] = (node.node_id.binary(), res, bundle)
+
+    def _release_alloc(self, rec):
+        alloc = rec.pop("alloc", None)
+        if alloc is None:
+            return
+        nid, res, bundle = alloc
+        node = self.nodes.get(nid)
+        if node is None:
+            return
+        if bundle is not None:
+            pg_id, bi = bundle
+            reserved = node.pg_reserved.get(pg_id, {}).get(bi)
+            if reserved is not None:
+                for k, v in res.items():
+                    reserved[k] = reserved.get(k, 0.0) + v
+        else:
+            node.release(res)
+            self._retry_pending_pgs()
+
+    def _maybe_spawn(self, node: NodeState):
+        cap = max(int(node.resources_total.get("CPU", 1)), 1)
+        pool = (
+            len([w for w in node.all_workers if w.alive and w.actor_id is None and w.conn is not None])
+            + node.spawning
+        )
+        if node.assigned and pool < cap:
+            node.spawning += 1
+            threading.Thread(target=self._spawn_worker, args=(node,), daemon=True).start()
+
+    # ------------------------------------------------------------ completion
+
+    def _on_task_done(self, wh: WorkerHandle, payload: dict):
+        task_id = payload["task_id"]
+        with self.lock:
+            rec = self.tasks.pop(task_id, None)
+            if rec is None:
+                if wh is not None:
+                    self._worker_idle(wh)
+                return
+            self._release_alloc(rec)
+            self._unpin_deps(rec["spec"])
+            for obj_id, locator in payload.get("results", []):
+                self._store_locator(obj_id, locator)
+            self._event(rec, "FINISHED" if not payload.get("results_error") else "FAILED")
+            spec = rec["spec"]
+            if spec["kind"] == "actor_method":
+                actor = self.actors.get(spec["actor_id"])
+                if actor is not None:
+                    actor.inflight.pop(task_id, None)
+            if wh is not None and wh.alive:
+                self._worker_idle(wh)
+            self.cv.notify_all()
+            self._schedule()
+
+    def _store_locator(self, obj_id: bytes, locator):
+        ent = self.objects.get(obj_id)
+        if ent is None:
+            ent = self.objects[obj_id] = ObjectEntry()
+        kind, payload, is_err = locator
+        if kind == "inline":
+            ent.small = payload
+            ent.size = len(payload)
+        else:
+            ent.shm = payload
+            ent.size = payload.total_size
+            self.shm_owner.register(payload)
+        ent.is_error = is_err
+        self._deps_ready(obj_id)
+        self.cv.notify_all()
+
+    def _unpin_deps(self, spec: dict):
+        for kind, obj_id in _iter_arg_refs(spec):
+            ent = self.objects.get(obj_id)
+            if ent is not None:
+                ent.pins -= 1
+                self._maybe_evict(obj_id, ent)
+
+    def _store_error(self, obj_id: bytes, exc: Exception):
+        sv = ser.serialize(exc)
+        self._store_locator(obj_id, ("inline", sv.to_bytes(), True))
+
+    def _finish_cancelled(self, rec):
+        self._release_alloc(rec)
+        self.tasks.pop(rec["task_id"], None)
+        self._unpin_deps(rec["spec"])
+        for rid in rec["spec"]["return_ids"]:
+            self._store_error(rid, rex.TaskCancelledError())
+        self.cv.notify_all()
+
+    # --------------------------------------------------------------- failure
+
+    def _health_loop(self):
+        while not self._shutdown:
+            time.sleep(GLOBAL_CONFIG.health_check_interval_s)
+            dead, reap = [], []
+            keep = GLOBAL_CONFIG.idle_worker_keep_alive_s
+            now = time.monotonic()
+            with self.lock:
+                for node in self.nodes.values():
+                    for wh in list(node.all_workers):
+                        if wh.alive and wh.proc is not None and not wh.proc.is_alive():
+                            dead.append(wh)
+                    # Reap workers idle beyond the keep-alive (reference:
+                    # worker_pool idle worker killing), but never while work
+                    # is queued for the node.
+                    if keep > 0 and not self.pending_sched and not node.assigned:
+                        for wh in list(node.idle_workers):
+                            if wh.actor_id is None and now - wh.idle_since > keep:
+                                node.idle_workers.remove(wh)
+                                node.all_workers.discard(wh)
+                                wh.alive = False
+                                reap.append(wh)
+            for wh in reap:
+                wh.send(("exit", None))
+            for wh in dead:
+                self._on_worker_dead(wh)
+
+    def _on_worker_disconnect(self, wh: WorkerHandle):
+        if wh.proc is not None and wh.proc.is_alive():
+            # Graceful exit or crash; health loop would catch it, but react now.
+            wh.proc.join(timeout=0.5)
+        self._on_worker_dead(wh)
+
+    def _on_worker_dead(self, wh: WorkerHandle):
+        with self.lock:
+            self._handle_worker_death_locked(wh)
+            self._schedule()
+
+    def _handle_worker_death_locked(self, wh: WorkerHandle):
+        if not wh.alive:
+            return
+        wh.alive = False
+        node = wh.node
+        node.all_workers.discard(wh)
+        if wh in node.idle_workers:
+            node.idle_workers.remove(wh)
+        rec = wh.current_task
+        if rec is not None and rec["task_id"] in self.tasks and rec["spec"]["kind"] == "task":
+            self.tasks.pop(rec["task_id"], None)
+            self._requeue_or_fail(rec, rex.WorkerCrashedError())
+        if wh.actor_id is not None:
+            self._on_actor_worker_death(wh.actor_id)
+
+    def _requeue_or_fail(self, rec, error: Exception):
+        """Lock held. Task retry semantics (reference task_manager.cc:
+        ``max_retries`` for normal tasks; actor methods obey the actor's
+        ``max_task_retries``)."""
+        self._release_alloc(rec)
+        spec = rec["spec"]
+        if rec["task_id"] in self.cancelled:
+            self._finish_cancelled(rec)
+            return
+        if spec["kind"] == "actor_method":
+            # handled by the actor restart machinery
+            return
+        if rec["retries_left"] > 0:
+            rec["retries_left"] -= 1
+            rec["state"] = "PENDING"
+            rec["worker"] = None
+            spec.pop("_pg_bundle", None)
+            self._event(rec, "RETRY")
+            self.tasks[rec["task_id"]] = rec
+            self.pending_sched.append(rec)
+        else:
+            self.tasks.pop(rec["task_id"], None)
+            self._unpin_deps(spec)
+            for rid in spec["return_ids"]:
+                self._store_error(rid, error)
+            self.cv.notify_all()
+
+    # ---------------------------------------------------------------- actors
+
+    def create_actor(self, spec: dict) -> None:
+        with self.lock:
+            name = spec.get("name")
+            if name and name in self.named_actors:
+                # check BEFORE registering, so a duplicate name leaves no
+                # orphan PENDING actor behind
+                raise ValueError(f"Actor name {name!r} already taken")
+            actor = ActorState(spec["actor_id"], spec)
+            self.actors[spec["actor_id"]] = actor
+            if name:
+                self.named_actors[name] = spec["actor_id"]
+        self.submit_task(spec)
+
+    def _start_actor_on(self, rec, node: NodeState):
+        """Lock held. Actor creation got a node: spawn a dedicated worker."""
+        spec = rec["spec"]
+        actor = self.actors[spec["actor_id"]]
+        actor.node_id = node.node_id
+        rec["state"] = "RUNNING"
+        # Keyed by actor id, NOT queued on node.assigned: only the dedicated
+        # worker spawned for this actor may pick it up.
+        self._actor_create_recs[spec["actor_id"]] = rec
+        threading.Thread(
+            target=self._spawn_actor_worker, args=(node, spec["actor_id"]), daemon=True
+        ).start()
+
+    def _spawn_actor_worker(self, node: NodeState, actor_id: bytes):
+        self._spawn_worker(node, actor_id=actor_id)
+
+    def _on_actor_ready(self, wh: WorkerHandle, payload):
+        actor_id = payload["actor_id"]
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            if payload.get("error") is not None:
+                # __init__ raised: actor is DEAD, creation error propagates to
+                # the creation "ready" object and all queued calls.
+                self._kill_actor_locked(actor, payload["error"], restart=False)
+                return
+            actor.state = ACTOR_ALIVE
+            actor.worker = wh
+            wh.actor_id = actor_id
+            rec = self.tasks.pop(actor.create_spec["task_id"], None)
+            if rec is not None:
+                actor.alloc = rec.pop("alloc", None)
+                self._event(rec, "FINISHED")
+            for rid in actor.create_spec["return_ids"]:
+                sv = ser.serialize(None)
+                self._store_locator(rid, ("inline", sv.to_bytes(), False))
+            while actor.pending_calls:
+                mspec = actor.pending_calls.popleft()
+                self._send_actor_task(actor, mspec)
+            self.cv.notify_all()
+
+    def submit_actor_task(self, spec: dict) -> None:
+        with self.lock:
+            actor = self.actors.get(spec["actor_id"])
+            if actor is None or actor.state == ACTOR_DEAD:
+                cause = actor.death_cause if actor else "actor not found"
+                for rid in spec["return_ids"]:
+                    self._store_error(rid, rex.ActorDiedError(msg=f"Actor is dead: {cause}"))
+                return
+            rec = {"task_id": spec["task_id"], "spec": spec, "state": "PENDING", "worker": None, "retries_left": actor.max_task_retries}
+            self.tasks[spec["task_id"]] = rec
+            # Pin ObjectRef args until completion (mirrors submit_task); the
+            # actor worker fetches them at execution time.
+            for _kind, payload in _iter_arg_refs(spec):
+                ent = self.objects.get(payload)
+                if ent is None:
+                    ent = self.objects[payload] = ObjectEntry()
+                ent.pins += 1
+            if actor.state == ACTOR_ALIVE:
+                self._send_actor_task(actor, spec)
+            else:
+                actor.pending_calls.append(spec)
+
+    def _send_actor_task(self, actor: ActorState, spec: dict):
+        """Lock held. Actor calls go straight to the actor's worker in
+        submission order (socket FIFO = the reference's sequential actor
+        submit queue)."""
+        actor.inflight[spec["task_id"]] = spec
+        rec = self.tasks.get(spec["task_id"])
+        if rec is not None:
+            rec["state"] = "RUNNING"
+            rec["worker"] = actor.worker
+        if not actor.worker.send(("run_task", spec)):
+            self._on_actor_worker_death(actor.actor_id)
+
+    def _on_actor_worker_death(self, actor_id: bytes):
+        """Lock held. Actor restart state machine (reference
+        gcs_actor_manager.cc: restart if restarts remain, else mark DEAD and
+        fail inflight + queued calls)."""
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == ACTOR_DEAD:
+            return
+        inflight = list(actor.inflight.values())
+        actor.inflight.clear()
+        actor.worker = None
+        self._actor_create_recs.pop(actor_id, None)
+        self._release_alloc({"alloc": actor.alloc} if actor.alloc else {})
+        actor.alloc = None
+        if actor.restarts_left != 0:
+            if actor.restarts_left > 0:
+                actor.restarts_left -= 1
+            actor.state = ACTOR_RESTARTING
+            # inflight calls with retry budget left are re-queued ahead of new
+            # calls; the rest fail (reference: max_task_retries per call,
+            # -1 = unlimited)
+            retry = []
+            for s in inflight:
+                rec = self.tasks.get(s["task_id"])
+                left = rec["retries_left"] if rec is not None else 0
+                if left != 0:
+                    if rec is not None and left > 0:
+                        rec["retries_left"] -= 1
+                    retry.append(s)
+                else:
+                    self.tasks.pop(s["task_id"], None)
+                    self._unpin_deps(s)
+                    for rid in s["return_ids"]:
+                        self._store_error(rid, rex.RayActorError(msg="actor died; restarting"))
+            for s in reversed(retry):
+                actor.pending_calls.appendleft(s)
+            # If the worker died mid-creation, reap the in-flight create task:
+            # release its allocation and carry its return ids into the retry so
+            # they eventually resolve.
+            old_rec = self.tasks.pop(actor.create_spec["task_id"], None)
+            if old_rec is not None:
+                self._release_alloc(old_rec)
+            cspec = dict(actor.create_spec)
+            cspec["task_id"] = TaskID.from_random().binary()
+            cspec["return_ids"] = actor.create_spec["return_ids"] if old_rec is not None else []
+            # Future lookups (ready/kill) must see the re-creation task's id,
+            # or its record + resource allocation leak forever.
+            actor.create_spec = cspec
+            rec = {"task_id": cspec["task_id"], "spec": cspec, "deps": set(), "state": "PENDING", "worker": None, "retries_left": 0}
+            self.tasks[cspec["task_id"]] = rec
+            self.pending_sched.append(rec)
+        else:
+            self._kill_actor_locked(actor, "worker died", restart=False, inflight=inflight)
+        self.cv.notify_all()
+
+    def _kill_actor_locked(self, actor: ActorState, cause, restart: bool, inflight=None):
+        actor.state = ACTOR_DEAD
+        actor.death_cause = str(cause)
+        err = cause if isinstance(cause, Exception) else rex.ActorDiedError(msg=str(cause))
+        for s in (inflight or []) + list(actor.inflight.values()) + list(actor.pending_calls):
+            self.tasks.pop(s["task_id"], None)
+            self._unpin_deps(s)
+            for rid in s["return_ids"]:
+                self._store_error(rid, err)
+        actor.inflight.clear()
+        actor.pending_calls.clear()
+        self._actor_create_recs.pop(actor.actor_id, None)
+        self._release_alloc({"alloc": actor.alloc} if actor.alloc else {})
+        actor.alloc = None
+        rec = self.tasks.pop(actor.create_spec["task_id"], None)
+        if rec is not None:
+            self._release_alloc(rec)
+            for rid in actor.create_spec["return_ids"]:
+                self._store_error(rid, err)
+        if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
+            del self.named_actors[actor.name]
+        wh = actor.worker
+        if wh is not None:
+            wh.actor_id = None
+            wh.alive = False
+            if wh.proc is not None and wh.proc.is_alive():
+                wh.proc.terminate()
+        self.cv.notify_all()
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            if no_restart:
+                actor.restarts_left = 0
+                self._kill_actor_locked(actor, "ray.kill", restart=False)
+            else:
+                wh = actor.worker
+                if wh is not None and wh.proc is not None:
+                    wh.proc.terminate()
+
+    def remove_actor_handle(self, actor_id: bytes):
+        """Driver-side handle count dropped; non-detached actors exit when the
+        last handle dies (reference: actor GC via reference counting)."""
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return
+            actor.num_handles -= 1
+            if actor.num_handles <= 0 and not actor.detached and actor.state != ACTOR_DEAD:
+                actor.restarts_left = 0
+                self._kill_actor_locked(actor, "all handles out of scope", restart=False)
+
+    # -------------------------------------------------------------- objects
+
+    def put_serialized(self, sv: ser.SerializedValue, is_error=False) -> bytes:
+        obj_id = ObjectID.for_put().binary()
+        self.put_at(obj_id, sv, is_error)
+        return obj_id
+
+    def put_at(self, obj_id: bytes, sv: ser.SerializedValue, is_error=False):
+        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+            locator = ("inline", sv.to_bytes(), is_error)
+        else:
+            from ray_tpu._private.shm_store import write_shm
+
+            locator = ("shm", write_shm(sv), is_error)
+        with self.lock:
+            self._store_locator(obj_id, locator)
+
+    def get_locators(self, obj_ids: list[bytes], timeout: Optional[float]) -> list:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        with self.lock:
+            for oid in obj_ids:
+                while True:
+                    ent = self.objects.get(oid)
+                    if ent is not None and ent.ready:
+                        out.append(ent.locator())
+                        break
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise rex.GetTimeoutError(f"Get timed out on {ObjectID(oid)}")
+                    if self._shutdown:
+                        raise rex.RayError("shutting down")
+                    self.cv.wait(timeout=min(remaining, 1.0) if remaining else 1.0)
+        return out
+
+    def wait_objects(self, obj_ids: list[bytes], num_returns: int, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.lock:
+            while True:
+                ready = [oid for oid in obj_ids if (e := self.objects.get(oid)) and e.ready]
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self.cv.wait(timeout=min(remaining, 1.0) if remaining else 1.0)
+
+    def add_ref(self, obj_id: bytes):
+        with self.lock:
+            ent = self.objects.get(obj_id)
+            if ent is None:
+                ent = self.objects[obj_id] = ObjectEntry()
+            ent.refcount += 1
+
+    def remove_ref(self, obj_id: bytes):
+        with self.lock:
+            ent = self.objects.get(obj_id)
+            if ent is None:
+                return
+            ent.refcount -= 1
+            self._maybe_evict(obj_id, ent)
+
+    def _maybe_evict(self, obj_id: bytes, ent: ObjectEntry):
+        if ent.refcount <= 0 and ent.pins <= 0 and ent.ready:
+            self.objects.pop(obj_id, None)
+            if ent.shm is not None:
+                self.shm_owner.unlink(ent.shm.name)
+
+    def free_objects(self, obj_ids: list[bytes]):
+        with self.lock:
+            for oid in obj_ids:
+                ent = self.objects.pop(oid, None)
+                if ent is not None and ent.shm is not None:
+                    self.shm_owner.unlink(ent.shm.name)
+
+    # -------------------------------------------------------- task cancel
+
+    def cancel_task(self, task_id: bytes, force: bool):
+        with self.lock:
+            rec = self.tasks.get(task_id)
+            if rec is None:
+                return
+            self.cancelled.add(task_id)
+            if rec["state"] in ("PENDING", "WAITING_DEPS"):
+                self.tasks.pop(task_id, None)
+                self._finish_cancelled(rec)
+            elif rec["state"] in ("RUNNING", "ASSIGNED") and rec.get("worker") is not None:
+                wh = rec["worker"]
+                if force and wh.proc is not None:
+                    wh.proc.terminate()
+                else:
+                    wh.send(("cancel", task_id))
+
+    # ------------------------------------------------------------- functions
+
+    def put_function(self, func_id: bytes, blob: bytes):
+        with self.lock:
+            self.functions[func_id] = blob
+
+    def get_function(self, func_id: bytes) -> bytes:
+        with self.lock:
+            return self.functions[func_id]
+
+    # ------------------------------------------------------- placement groups
+
+    def create_pg(self, bundles: list[dict], strategy: str, name: str = "") -> bytes:
+        pg_id = PlacementGroupID.from_random().binary()
+        pg = PlacementGroupState(pg_id, bundles, strategy, name)
+        with self.lock:
+            self.placement_groups[pg_id] = pg
+            self._try_place_pg(pg)
+        return pg_id
+
+    def _try_place_pg(self, pg: PlacementGroupState):
+        """Lock held. Bundle placement (reference
+        bundle_scheduling_policy.cc): STRICT_PACK = all bundles on one node;
+        PACK = minimize nodes (greedy best-fit); SPREAD = prefer distinct
+        nodes; STRICT_SPREAD = require distinct nodes. Placement is
+        incremental: bundles still placed on alive nodes (after a partial node
+        failure) keep their existing allocation; only unplaced bundles are
+        assigned, all-or-nothing."""
+        # bundles whose node is gone are unplaced; the rest keep their commit
+        todo = [i for i, nid in enumerate(pg.bundle_nodes) if nid is None]
+        if not todo:
+            if pg.state != PG_CREATED:
+                pg.state = PG_CREATED
+                pg.ready_event.set()
+                self.cv.notify_all()
+            return
+        alive = [self.nodes[nid] for nid in self.node_order if self.nodes[nid].alive]
+        if not alive:
+            return
+        shadow = {n.node_id.binary(): dict(n.resources_avail) for n in alive}
+        placed_nodes = {pg.bundle_nodes[i].binary() for i in range(len(pg.bundles)) if pg.bundle_nodes[i] is not None}
+
+        def fits(nid, bundle):
+            return all(shadow[nid].get(k, 0.0) + 1e-9 >= v for k, v in bundle.items() if v > 0)
+
+        def take(nid, bundle):
+            for k, v in bundle.items():
+                shadow[nid][k] = shadow[nid].get(k, 0.0) - v
+
+        assign: dict[int, bytes] = {}
+        strategy = pg.strategy
+        if strategy == "STRICT_PACK":
+            # all bundles must share one node; surviving bundles pin it
+            cands = (
+                [n for n in alive if n.node_id.binary() in placed_nodes]
+                if placed_nodes
+                else alive
+            )
+            for n in cands:
+                nid = n.node_id.binary()
+                snap = dict(shadow[nid])
+                ok = True
+                for i in todo:
+                    if fits(nid, pg.bundles[i]):
+                        take(nid, pg.bundles[i])
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    assign = {i: nid for i in todo}
+                    break
+                shadow[nid] = snap
+        else:
+            used_nodes: set[bytes] = set(placed_nodes)
+            order = sorted(todo, key=lambda i: -sum(pg.bundles[i].values()))
+            for i in order:
+                b = pg.bundles[i]
+                cands = [n.node_id.binary() for n in alive if fits(n.node_id.binary(), b)]
+                if strategy == "STRICT_SPREAD":
+                    cands = [c for c in cands if c not in used_nodes]
+                elif strategy == "SPREAD":
+                    fresh = [c for c in cands if c not in used_nodes]
+                    cands = fresh or cands
+                elif strategy == "PACK":
+                    packed = [c for c in cands if c in used_nodes]
+                    cands = packed or cands
+                if not cands:
+                    assign = {}
+                    break
+                nid = cands[0]
+                take(nid, b)
+                used_nodes.add(nid)
+                assign[i] = nid
+        if len(assign) != len(todo):
+            return  # stays PENDING; retried on node add / resource release
+        # commit only the newly placed bundles
+        for i in todo:
+            node = self.nodes[assign[i]]
+            b = pg.bundles[i]
+            node.allocate(b)
+            node.pg_reserved.setdefault(pg.pg_id, {})[i] = dict(b)
+            pg.bundle_nodes[i] = node.node_id
+        pg.state = PG_CREATED
+        pg.ready_event.set()
+        self.cv.notify_all()
+
+    def _retry_pending_pgs(self):
+        """Lock held. Re-attempt placement of PENDING groups when capacity
+        appears (node added, resources released)."""
+        for pg in self.placement_groups.values():
+            if pg.state == PG_PENDING:
+                self._try_place_pg(pg)
+
+    def remove_pg(self, pg_id: bytes):
+        with self.lock:
+            pg = self.placement_groups.pop(pg_id, None)
+            if pg is None:
+                return
+            pg.state = PG_REMOVED
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid is None:
+                    continue
+                node = self.nodes.get(nid.binary())
+                if node is None:
+                    continue
+                node.pg_reserved.get(pg_id, {}).pop(i, None)
+                if not node.pg_reserved.get(pg_id):
+                    node.pg_reserved.pop(pg_id, None)
+                node.release(pg.bundles[i])
+            self._retry_pending_pgs()
+            self._schedule()
+
+    def pg_ready_wait(self, pg_id: bytes, timeout: Optional[float]) -> bool:
+        with self.lock:
+            pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            raise ValueError("placement group removed")
+        return pg.ready_event.wait(timeout)
+
+    # ------------------------------------------------------------------ rpcs
+    # Thin adapters so worker processes hit the same logic over the socket.
+
+    def rpc_put(self, obj_id, small, shm, is_error=False):
+        with self.lock:
+            self._store_locator(obj_id, ("inline", small, is_error) if small is not None else ("shm", shm, is_error))
+        return True
+
+    def rpc_get(self, obj_ids, timeout=None):
+        return self.get_locators(obj_ids, timeout)
+
+    def rpc_wait(self, obj_ids, num_returns, timeout=None):
+        return self.wait_objects(obj_ids, num_returns, timeout)
+
+    def rpc_submit_task(self, spec):
+        self.submit_task(spec)
+        return True
+
+    def rpc_create_actor(self, spec):
+        self.create_actor(spec)
+        return True
+
+    def rpc_submit_actor_task(self, spec):
+        self.submit_actor_task(spec)
+        return True
+
+    def rpc_kill_actor(self, actor_id, no_restart=True):
+        self.kill_actor(actor_id, no_restart)
+        return True
+
+    def rpc_cancel_task(self, task_id, force=False):
+        self.cancel_task(task_id, force)
+        return True
+
+    def rpc_put_function(self, func_id, blob):
+        self.put_function(func_id, blob)
+        return True
+
+    def rpc_get_function(self, func_id):
+        return self.get_function(func_id)
+
+    def rpc_get_actor_named(self, name, timeout=0.0):
+        deadline = time.monotonic() + (timeout or 0.0)
+        with self.lock:
+            while True:
+                aid = self.named_actors.get(name)
+                if aid is not None:
+                    return aid, self.actors[aid].create_spec.get("methods", {})
+                if time.monotonic() >= deadline:
+                    raise ValueError(f"Failed to look up actor with name '{name}'")
+                self.cv.wait(timeout=0.1)
+
+    def rpc_actor_state(self, actor_id):
+        with self.lock:
+            a = self.actors.get(actor_id)
+            return None if a is None else a.state
+
+    def rpc_actor_inc_handle(self, actor_id):
+        with self.lock:
+            a = self.actors.get(actor_id)
+            if a is not None:
+                a.num_handles += 1
+        return True
+
+    def rpc_actor_dec_handle(self, actor_id):
+        self.remove_actor_handle(actor_id)
+        return True
+
+    def rpc_kv_put(self, key, value):
+        with self.lock:
+            self.kv[key] = value
+        return True
+
+    def rpc_kv_get(self, key):
+        with self.lock:
+            return self.kv.get(key)
+
+    def rpc_kv_del(self, key):
+        with self.lock:
+            return self.kv.pop(key, None) is not None
+
+    def rpc_kv_keys(self, prefix=""):
+        with self.lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    def rpc_create_pg(self, bundles, strategy, name=""):
+        return self.create_pg(bundles, strategy, name)
+
+    def rpc_remove_pg(self, pg_id):
+        self.remove_pg(pg_id)
+        return True
+
+    def rpc_pg_ready(self, pg_id, timeout=None):
+        return self.pg_ready_wait(pg_id, timeout)
+
+    def rpc_add_ref(self, obj_id):
+        self.add_ref(obj_id)
+        return True
+
+    def rpc_free_ref(self, obj_id):
+        self.remove_ref(obj_id)
+        return True
+
+    def rpc_free(self, obj_ids):
+        self.free_objects(obj_ids)
+        return True
+
+    def rpc_cluster_resources(self):
+        with self.lock:
+            out: dict[str, float] = {}
+            for n in self.nodes.values():
+                if n.alive:
+                    for k, v in n.resources_total.items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
+
+    def rpc_available_resources(self):
+        with self.lock:
+            out = {}
+            for n in self.nodes.values():
+                if n.alive:
+                    for k, v in n.resources_avail.items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
+
+    def rpc_nodes(self):
+        with self.lock:
+            return [
+                {
+                    "NodeID": n.node_id.hex(),
+                    "Alive": n.alive,
+                    "Resources": dict(n.resources_total),
+                    "Available": dict(n.resources_avail),
+                    "Labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            ]
+
+    def rpc_list_tasks(self):
+        with self.lock:
+            return [
+                {"task_id": ObjectID(r["task_id"]).hex() if len(r["task_id"]) == 16 else r["task_id"].hex(), "name": r["spec"].get("name"), "state": r["state"]}
+                for r in self.tasks.values()
+            ]
+
+    def rpc_list_actors(self):
+        with self.lock:
+            names = {0: "PENDING", 1: "RESTARTING", 2: "ALIVE", 3: "DEAD"}
+            return [
+                {
+                    "actor_id": ActorID(a.actor_id).hex(),
+                    "state": names[a.state],
+                    "name": a.name,
+                    "class_name": a.create_spec.get("class_name"),
+                    "node_id": a.node_id.hex() if a.node_id else None,
+                }
+                for a in self.actors.values()
+            ]
+
+    def rpc_list_objects(self):
+        with self.lock:
+            return [
+                {"object_id": ObjectID(oid).hex(), "size": e.size, "ready": e.ready, "refcount": e.refcount, "pins": e.pins}
+                for oid, e in self.objects.items()
+            ]
+
+    def rpc_task_events(self):
+        with self.lock:
+            return list(self.task_events)
+
+    # -------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        with self.lock:
+            self._shutdown = True
+            workers = [w for n in self.nodes.values() for w in n.all_workers]
+            self.cv.notify_all()
+        for wh in workers:
+            wh.alive = False
+            try:
+                wh.send(("exit",))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for wh in workers:
+            if wh.proc is not None:
+                wh.proc.join(timeout=max(0.0, deadline - time.monotonic()))
+                if wh.proc.is_alive():
+                    wh.proc.terminate()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        self.shm_owner.shutdown()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    # --------------------------------------------------------- observability
+
+    def _event(self, rec, state):
+        self.task_events.append(
+            {
+                "task_id": rec["task_id"].hex(),
+                "name": rec["spec"].get("name"),
+                "state": state,
+                "time": time.time(),
+                "kind": rec["spec"].get("kind"),
+            }
+        )
+        if len(self.task_events) > 100_000:
+            del self.task_events[:50_000]
+
+
+def _iter_arg_refs(spec: dict):
+    for a in spec.get("args", ()):  # ('v', bytes) | ('r', obj_id)
+        if a[0] == "r":
+            yield a
+    for a in spec.get("kwargs", {}).values():
+        if a[0] == "r":
+            yield a
+
+
+def _picklable(e) -> bool:
+    try:
+        import cloudpickle
+
+        cloudpickle.dumps(e)
+        return True
+    except Exception:
+        return False

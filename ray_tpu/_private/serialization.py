@@ -1,0 +1,95 @@
+"""Zero-copy-aware serialization.
+
+TPU-native counterpart of the reference's ``python/ray/_private/serialization.py``
+(+ vendored cloudpickle): values are serialized with cloudpickle at pickle
+protocol 5 so large contiguous buffers (numpy arrays, jax host arrays via
+dlpack→numpy, arrow buffers) travel out-of-band. The out-of-band buffers are
+what the shared-memory store lays out contiguously, giving zero-copy reads on
+the consumer side (the plasma mmap equivalent).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import cloudpickle
+
+
+class SerializedValue:
+    """A pickled header plus out-of-band buffers.
+
+    total_size == len(header) + sum(buffer sizes); the store uses this to
+    decide inline vs shared-memory placement.
+    """
+
+    __slots__ = ("header", "buffers", "total_size")
+
+    def __init__(self, header: bytes, buffers: list[pickle.PickleBuffer]):
+        self.header = header
+        self.buffers = buffers
+        self.total_size = len(header) + sum(len(b.raw()) for b in buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single self-describing byte string (for socket
+        transport of small objects)."""
+        out = io.BytesIO()
+        out.write(len(self.header).to_bytes(8, "little"))
+        out.write(len(self.buffers).to_bytes(4, "little"))
+        for b in self.buffers:
+            out.write(len(b.raw()).to_bytes(8, "little"))
+        out.write(self.header)
+        for b in self.buffers:
+            out.write(b.raw())
+        return out.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes | memoryview) -> "SerializedValue":
+        mv = memoryview(data)
+        hlen = int.from_bytes(mv[:8], "little")
+        nbuf = int.from_bytes(mv[8:12], "little")
+        off = 12
+        sizes = []
+        for _ in range(nbuf):
+            sizes.append(int.from_bytes(mv[off : off + 8], "little"))
+            off += 8
+        header = bytes(mv[off : off + hlen])
+        off += hlen
+        bufs = []
+        for s in sizes:
+            bufs.append(pickle.PickleBuffer(mv[off : off + s]))
+            off += s
+        return cls(header, bufs)
+
+
+def serialize(value: Any) -> SerializedValue:
+    buffers: list[pickle.PickleBuffer] = []
+
+    def cb(buf: pickle.PickleBuffer):
+        # Only keep genuinely large buffers out-of-band; tiny ones are cheaper
+        # inline in the header.
+        if buf.raw().nbytes >= 4096:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # serialize in-band
+
+    header = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
+    return SerializedValue(header, buffers)
+
+
+def deserialize(header: bytes | memoryview, buffers: list) -> Any:
+    return pickle.loads(header, buffers=buffers)
+
+
+def deserialize_value(sv: SerializedValue) -> Any:
+    return pickle.loads(sv.header, buffers=sv.buffers)
+
+
+def dumps(value: Any) -> bytes:
+    """Convenience: fully in-band cloudpickle (control-plane metadata)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,156 @@
+"""Shared-memory object store (plasma equivalent).
+
+The reference runs a plasma store inside each raylet: an mmap + dlmalloc arena
+with a unix-socket flatbuffer protocol, LRU eviction and create-backpressure
+(``src/ray/object_manager/plasma/store.h:55``). On a TPU host the picture is
+simpler: every process that needs zero-copy access is on the same machine, and
+device-resident arrays live in HBM addressed by sharding specs — the host
+store only carries host-side payloads (batches, checkpending state, small
+tensors, control data). So instead of a separate daemon we use one POSIX shm
+segment per large object, created by whichever process produced the value and
+owned (for unlink purposes) by the head:
+
+* producer lays out [header][buffer0][buffer1...] with 64-byte alignment,
+* consumers attach by name and reconstruct the pickled value with pickle-5
+  out-of-band buffers pointing straight into the mapping (zero copy),
+* the head records {object_id -> ShmLocation} and unlinks on free/shutdown.
+
+Small objects (<= max_direct_call_object_size) never touch shm; they ride the
+control-plane socket inline, like the reference's in-process memory store
+(``store_provider/memory_store/memory_store.cc``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import threading
+from multiprocessing import resource_tracker, shared_memory
+
+from ray_tpu._private.serialization import SerializedValue
+
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+@dataclasses.dataclass
+class ShmLocation:
+    name: str
+    header_len: int
+    buffer_lens: list[int]
+    total_size: int
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    # Python's resource_tracker unlinks segments created by a process when
+    # that process exits, which would tear objects out from under other
+    # readers. Lifetime is owned by the head instead (explicit unlink).
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+def write_shm(sv: SerializedValue) -> ShmLocation:
+    """Lay a serialized value out in a fresh shm segment."""
+    hlen = len(sv.header)
+    offs = [_align(hlen)]
+    for b in sv.buffers[:-1] if sv.buffers else []:
+        offs.append(_align(offs[-1] + len(b.raw())))
+    total = (offs[-1] + len(sv.buffers[-1].raw())) if sv.buffers else hlen
+    total = max(total, 1)
+    shm = shared_memory.SharedMemory(create=True, size=total)
+    _untrack(shm)
+    try:
+        shm.buf[:hlen] = sv.header
+        lens = []
+        for off, b in zip(offs, sv.buffers):
+            raw = b.raw()
+            n = raw.nbytes
+            shm.buf[off : off + n] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+            lens.append(n)
+        loc = ShmLocation(shm.name, hlen, lens, total)
+    finally:
+        shm.close()
+    return loc
+
+
+class ShmReader:
+    """Attach to a segment and expose zero-copy out-of-band buffers.
+
+    The mapping must outlive any views handed to the deserialized value, so we
+    keep the SharedMemory open and let a weak registry close it when the value
+    is garbage collected (readers pin via ``hold``).
+    """
+
+    def __init__(self, loc: ShmLocation):
+        self.shm = shared_memory.SharedMemory(name=loc.name)
+        _untrack(self.shm)
+        self.loc = loc
+
+    def read(self):
+        loc = self.loc
+        mv = self.shm.buf
+        header = mv[: loc.header_len]
+        bufs = []
+        off = _align(loc.header_len)
+        for n in loc.buffer_lens:
+            bufs.append(pickle.PickleBuffer(mv[off : off + n]))
+            off = _align(off + n)
+        value = pickle.loads(header, buffers=bufs)
+        return value
+
+    def close(self):
+        try:
+            self.shm.close()
+        except BufferError:
+            # Views into the mapping are still alive; leak the mapping (it is
+            # unlinked by the head, so it dies with the last process). Disarm
+            # SharedMemory.__del__ so it doesn't retry and print at exit.
+            self.shm._buf = None
+            self.shm._mmap = None
+
+
+class ShmOwner:
+    """Head-side registry of live segments; unlinks on free/shutdown."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._segments: dict[str, int] = {}  # name -> size
+        self.bytes_used = 0
+
+    def register(self, loc: ShmLocation) -> None:
+        with self._lock:
+            if loc.name not in self._segments:
+                self._segments[loc.name] = loc.total_size
+                self.bytes_used += loc.total_size
+
+    def unlink(self, name: str) -> None:
+        with self._lock:
+            size = self._segments.pop(name, None)
+            if size is not None:
+                self.bytes_used -= size
+        try:
+            # attach registers with the resource tracker; unlink() unregisters
+            # again, so no explicit _untrack here (it would double-unregister).
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            names = list(self._segments)
+            self._segments.clear()
+            self.bytes_used = 0
+        for name in names:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+                shm.close()
+                shm.unlink()
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,253 @@
+"""Worker process entry point and task executor.
+
+TPU-native counterpart of the reference's worker side: ``CoreWorkerProcess::
+RunTaskExecutionLoop`` (``core_worker_process.cc:63``) plus the Cython task
+executor (``_raylet.pyx:2177`` ``task_execution_handler``). One process, one
+context; normal workers run tasks one at a time, actor workers hold the actor
+instance and execute its methods in arrival order (= submission order, since
+the head forwards over a FIFO socket), or on a thread pool when
+``max_concurrency > 1`` (reference: threaded actors / concurrency groups).
+
+Workers deliberately import no JAX at startup: on a TPU host the heavy
+libraries load lazily inside user functions, keeping worker spawn ~100ms.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+import traceback
+from typing import Optional
+
+from ray_tpu import exceptions as rex
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.runtime import ObjectRef, WorkerContext, set_ctx
+
+
+class WorkerState:
+    def __init__(self, ctx: WorkerContext):
+        self.ctx = ctx
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self.func_cache: dict[bytes, object] = {}
+        self.actor_instance = None
+        self.actor_id: Optional[bytes] = None
+        self.actor_pool = None  # ThreadPoolExecutor for max_concurrency > 1
+        self.running = True
+        self.exec_thread_id: Optional[int] = None
+        self.cancel_requested: set[bytes] = set()
+        self.current_task_id: Optional[bytes] = None
+        # task_id -> ident of the thread executing it (the exec loop, or a
+        # pool thread for max_concurrency>1 actors) — cancel targets THAT
+        # thread, never the dispatch loop.
+        self.task_threads: dict[bytes, int] = {}
+
+
+def main(socket_path: str, authkey: bytes, node_id_bin: bytes):
+    from multiprocessing.connection import Client
+
+    conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
+    ctx = WorkerContext(conn, node_id_bin)
+    set_ctx(ctx)
+    state = WorkerState(ctx)
+    ctx.send_raw(("register", {"pid": os.getpid(), "node_id": node_id_bin}))
+
+    recv = threading.Thread(target=_recv_loop, args=(conn, ctx, state), daemon=True)
+    recv.start()
+    _exec_loop(state)
+
+
+def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
+    while state.running:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            state.running = False
+            state.task_queue.put(None)
+            return
+        kind = msg[0]
+        if kind == "resp":
+            _, seq, ok, payload = msg
+            ctx.on_response(seq, ok, payload)
+        elif kind == "run_task":
+            state.task_queue.put(msg[1])
+        elif kind == "cancel":
+            _handle_cancel(state, msg[1])
+        elif kind == "exit":
+            state.running = False
+            state.task_queue.put(None)
+            os._exit(0)
+
+
+def _handle_cancel(state: WorkerState, task_id: bytes):
+    state.cancel_requested.add(task_id)
+    tid = state.task_threads.get(task_id)
+    if tid is not None:
+        # best-effort async interrupt (reference: SIGINT into the worker),
+        # into the thread running this task only
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(tid), ctypes.py_object(rex.TaskCancelledError)
+        )
+
+
+def _exec_loop(state: WorkerState):
+    state.exec_thread_id = threading.get_ident()
+    while state.running:
+        spec = state.task_queue.get()
+        if spec is None:
+            break
+        if spec["kind"] == "actor_method" and state.actor_pool is not None:
+            state.actor_pool.submit(_run_spec, state, spec)
+        else:
+            _run_spec(state, spec)
+    os._exit(0)
+
+
+def _run_spec(state: WorkerState, spec: dict):
+    kind = spec["kind"]
+    if kind == "actor_create":
+        _run_actor_create(state, spec)
+    else:
+        _run_task(state, spec)
+
+
+def _resolve_function(state: WorkerState, func_id: bytes):
+    fn = state.func_cache.get(func_id)
+    if fn is None:
+        blob = state.ctx.call("get_function", func_id=func_id)
+        fn = ser.loads(blob)
+        state.func_cache[func_id] = fn
+    return fn
+
+
+def _load_args(state: WorkerState, spec: dict):
+    """Deserialize by-value args; fetch by-ref args from the store. Errors in
+    dependencies propagate (reference: RayTaskError poisoning dependents)."""
+    ref_ids = []
+    for a in list(spec.get("args", ())) + list(spec.get("kwargs", {}).values()):
+        if a[0] == "r":
+            ref_ids.append(a[1])
+    fetched = {}
+    if ref_ids:
+        locators = state.ctx.call("get", obj_ids=ref_ids, timeout=None)
+        for oid, loc in zip(ref_ids, locators):
+            value = state.ctx._materialize(oid, loc)
+            if loc[2]:  # dependency failed
+                if isinstance(value, rex.RayTaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            fetched[oid] = value
+
+    def one(a):
+        if a[0] == "r":
+            return fetched[a[1]]
+        return ser.deserialize_value(ser.SerializedValue.from_bytes(a[1]))
+
+    args = [one(a) for a in spec.get("args", ())]
+    kwargs = {k: one(v) for k, v in spec.get("kwargs", {}).items()}
+    return args, kwargs
+
+
+def _store_results(state: WorkerState, spec: dict, value, is_error=False):
+    """Serialize returns; small ones ride the task_done message, large ones go
+    straight to shm from this process (zero extra copies)."""
+    return_ids = spec["return_ids"]
+    n = len(return_ids)
+    if is_error or n == 1:
+        values = [value] * n if n else []
+    else:
+        try:
+            values = list(value)
+        except TypeError:
+            values = [value]
+        if len(values) != n:
+            err = rex.RayTaskError.from_exception(
+                spec.get("name", "task"),
+                ValueError(f"Task declared num_returns={n} but returned {type(value)}"),
+            )
+            return _store_results(state, spec, err, is_error=True)
+    results = []
+    for rid, v in zip(return_ids, values):
+        try:
+            sv = ser.serialize(v)
+        except Exception as e:  # unserializable return
+            sv = ser.serialize(rex.RayTaskError.from_exception(spec.get("name", "task"), e))
+            is_error = True
+        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+            results.append((rid, ("inline", sv.to_bytes(), is_error)))
+        else:
+            from ray_tpu._private.shm_store import write_shm
+
+            results.append((rid, ("shm", write_shm(sv), is_error)))
+    return results
+
+
+def _run_task(state: WorkerState, spec: dict):
+    task_id = spec["task_id"]
+    state.current_task_id = task_id
+    state.task_threads[task_id] = threading.get_ident()
+    is_error = False
+    try:
+        if task_id in state.cancel_requested:
+            raise rex.TaskCancelledError()
+        if spec["kind"] == "actor_method":
+            method = getattr(state.actor_instance, spec["method_name"])
+            args, kwargs = _load_args(state, spec)
+            value = method(*args, **kwargs)
+        else:
+            fn = _resolve_function(state, spec["func_id"])
+            args, kwargs = _load_args(state, spec)
+            value = fn(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001
+        if isinstance(e, rex.TaskCancelledError):
+            value = e
+        elif isinstance(e, rex.RayTaskError):
+            value = e
+        else:
+            value = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
+        is_error = True
+    finally:
+        state.current_task_id = None
+        state.task_threads.pop(task_id, None)
+        state.cancel_requested.discard(task_id)
+    try:
+        results = _store_results(state, spec, value, is_error)
+    except BaseException:  # noqa: BLE001
+        traceback.print_exc()
+        results = []
+    state.ctx.send_raw(
+        ("task_done", {"task_id": task_id, "results": results, "results_error": is_error})
+    )
+
+
+def _cli_main():
+    """Entry point for ``python -m ray_tpu._private.worker_main`` — workers
+    are exec'd fresh (reference: worker_pool spawning default_worker.py), so
+    they never re-import the driver's __main__ module."""
+    import sys
+
+    socket_path, authkey_hex, node_id_hex = sys.argv[1], sys.argv[2], sys.argv[3]
+    main(socket_path, bytes.fromhex(authkey_hex), bytes.fromhex(node_id_hex))
+
+
+def _run_actor_create(state: WorkerState, spec: dict):
+    try:
+        cls = _resolve_function(state, spec["func_id"])
+        args, kwargs = _load_args(state, spec)
+        state.actor_instance = cls(*args, **kwargs)
+        state.actor_id = spec["actor_id"]
+        state.ctx.current_actor = spec["actor_id"].hex()  # for get_runtime_context()
+        if spec.get("max_concurrency", 1) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            state.actor_pool = ThreadPoolExecutor(max_workers=spec["max_concurrency"])
+        state.ctx.send_raw(("actor_ready", {"actor_id": spec["actor_id"], "error": None}))
+    except BaseException as e:  # noqa: BLE001
+        err = rex.RayTaskError.from_exception(spec.get("name", "actor"), e)
+        state.ctx.send_raw(("actor_ready", {"actor_id": spec["actor_id"], "error": err}))
+
+
+if __name__ == "__main__":
+    _cli_main()

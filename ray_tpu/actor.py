@@ -1,0 +1,214 @@
+"""Actors: stateful workers with ordered method calls.
+
+Counterpart of the reference's ``python/ray/actor.py`` (``ActorClass._remote``
+:830, ``ActorHandle``, ``ActorMethod``). An actor is a dedicated worker
+process holding a class instance; method calls are pushed in submission order
+over the head→worker FIFO socket (= the reference's sequential actor submit
+queue), with ``max_concurrency`` switching to a thread pool. Restart-on-death
+follows ``max_restarts`` / ``max_task_retries``
+(reference: gcs_actor_manager.cc state machine).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+from ray_tpu._private import options as opt
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.runtime import get_ctx
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    _SUPPORTED_OPTIONS = frozenset({"num_returns"})
+
+    def options(self, **options) -> "ActorMethod":
+        unknown = set(options) - self._SUPPORTED_OPTIONS
+        if unknown:
+            raise ValueError(
+                f"Unsupported actor-method options: {sorted(unknown)} "
+                f"(supported: {sorted(self._SUPPORTED_OPTIONS)})"
+            )
+        return ActorMethod(self._handle, self._name, options.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(self._name, args, kwargs, self._num_returns)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor method {self._name}() cannot be called directly; use .remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, methods: dict[str, dict], class_name: str, owned: bool):
+        self._actor_id = actor_id
+        self._methods = methods
+        self._class_name = class_name
+        self._owned = owned
+
+    @property
+    def _actor_id_hex(self) -> str:
+        return self._actor_id.hex()
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        meta = self._methods.get(name)
+        if meta is None:
+            raise AttributeError(f"Actor {self._class_name} has no method {name!r}")
+        return ActorMethod(self, name, meta.get("num_returns", 1))
+
+    def _submit_method(self, name, args, kwargs, num_returns):
+        ctx = get_ctx()
+        s_args, s_kwargs = ctx.serialize_args(args, kwargs)
+        task_id, return_ids = ctx.new_task_returns(max(num_returns, 1))
+        spec = {
+            "task_id": task_id,
+            "kind": "actor_method",
+            "actor_id": self._actor_id,
+            "method_name": name,
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "num_returns": num_returns,
+            "return_ids": return_ids,
+            "name": f"{self._class_name}.{name}",
+        }
+        refs = ctx.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+    def __reduce__(self):
+        # A handle crossing a serialization boundary pins the actor for the
+        # session (conservative GC; see ObjectRef.__reduce__).
+        try:
+            get_ctx().call("actor_inc_handle", actor_id=self._actor_id)
+        except Exception:
+            pass
+        return (_deserialize_handle, (self._actor_id, self._methods, self._class_name))
+
+    def __del__(self):
+        if self._owned:
+            try:
+                ctx = get_ctx()
+                if not ctx.closed:
+                    ctx.call("actor_dec_handle", actor_id=self._actor_id)
+            except Exception:
+                pass
+
+
+def _deserialize_handle(actor_id, methods, class_name):
+    return ActorHandle(actor_id, methods, class_name, owned=False)
+
+
+class ActorClass:
+    def __init__(self, cls: type, default_options: dict[str, Any]):
+        self._cls = cls
+        self._options = default_options
+        opt.validate(self._options, is_actor=True)
+        self._blob: Optional[bytes] = None
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = {**self._options, **new_options}
+        ac = ActorClass(self._cls, merged)
+        ac._blob = self._blob
+        return ac
+
+    def method_table(self) -> dict[str, dict]:
+        methods = {}
+        for name in dir(self._cls):
+            if name.startswith("__"):
+                continue
+            m = getattr(self._cls, name, None)
+            if callable(m):
+                methods[name] = {"num_returns": getattr(m, "_num_returns", 1)}
+        return methods
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, options):
+        ctx = get_ctx()
+        name = options.get("name")
+        if name and options.get("get_if_exists"):
+            try:
+                return get_actor(name)
+            except ValueError:
+                pass
+        if self._blob is None:
+            self._blob = ser.dumps(self._cls)
+        func_id = ctx.upload_function(self._blob)
+        s_args, s_kwargs = ctx.serialize_args(args, kwargs)
+        actor_id = ActorID.from_random().binary()
+        task_id, return_ids = ctx.new_task_returns(1)
+        methods = self.method_table()
+        spec = {
+            "task_id": task_id,
+            "kind": "actor_create",
+            "actor_id": actor_id,
+            "func_id": func_id,
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "num_returns": 1,
+            "return_ids": return_ids,
+            "resources": opt.to_resources(options, is_actor=True),
+            "strategy": opt.to_strategy(options),
+            "max_restarts": options.get("max_restarts", 0),
+            "max_task_retries": options.get("max_task_retries", 0),
+            "max_concurrency": options.get("max_concurrency", 1),
+            "name": options.get("name") or self._cls.__name__,
+            "lifetime": options.get("lifetime"),
+            "methods": methods,
+        }
+        if not options.get("name"):
+            spec["name"] = None  # anonymous actors are not registered by name
+        spec["class_name"] = self._cls.__name__
+        for rid in return_ids:
+            ctx.call("add_ref", obj_id=rid)
+        try:
+            ctx.call("create_actor", spec=spec)
+        except Exception:
+            for rid in return_ids:
+                ctx.call("free_ref_async", obj_id=rid)
+            raise
+        return ActorHandle(actor_id, methods, self._cls.__name__, owned=True)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (reference: ``ray.get_actor``)."""
+    ctx = get_ctx()
+    actor_id, methods = ctx.call("get_actor_named", name=name, timeout=0.0)
+    spec_methods = methods or {}
+    return ActorHandle(actor_id, spec_methods, name, owned=False)
+
+
+def method(**kwargs):
+    """Decorator to override per-method defaults, e.g.
+    ``@ray_tpu.method(num_returns=2)`` (reference: ``ray.method``)."""
+
+    def wrap(fn):
+        if "num_returns" in kwargs:
+            fn._num_returns = kwargs["num_returns"]
+        return fn
+
+    return wrap

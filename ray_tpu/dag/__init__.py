@@ -1,0 +1,67 @@
+"""Lazy task DAGs (reference: ``python/ray/dag/dag_node.py`` + compiled DAGs).
+
+``f.bind(x)`` builds a DAG node; ``node.execute()`` walks the graph
+submitting tasks with upstream ObjectRefs as args. Compiled (accelerated)
+DAG execution over reusable channels is a later-round feature; this module
+provides the lazy-graph surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _resolve(self, v: Any):
+        if isinstance(v, DAGNode):
+            return v.execute()
+        return v
+
+    def _resolved_args(self):
+        args = [self._resolve(a) for a in self._bound_args]
+        kwargs = {k: self._resolve(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def execute(self):
+        raise NotImplementedError
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def execute(self):
+        args, kwargs = self._resolved_args()
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+
+    def execute(self):
+        args, kwargs = self._resolved_args()
+        return self._cls.remote(*args, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for runtime input (reference: dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__((), {})
+        self._value = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def execute(self):
+        return self._value

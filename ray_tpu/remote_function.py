@@ -1,0 +1,96 @@
+"""Remote functions: ``@ray_tpu.remote`` on a plain function.
+
+Counterpart of the reference's ``python/ray/remote_function.py`` —
+``RemoteFunction._remote`` (:262) pickles the function once into the cluster
+function table, resolves options, and submits a task spec; ``.options(...)``
+returns a shallow override copy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+from ray_tpu._private import options as opt
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.runtime import get_ctx
+
+
+class RemoteFunction:
+    def __init__(self, fn, default_options: Optional[dict] = None):
+        if not callable(fn):
+            raise TypeError("@remote must decorate a callable")
+        self._fn = fn
+        self._options = default_options or {}
+        opt.validate(self._options, is_actor=False)
+        self._blob: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Remote function {self._fn.__name__}() cannot be called directly; "
+            f"use {self._fn.__name__}.remote()."
+        )
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = {**self._options, **new_options}
+        rf = RemoteFunction(self._fn, merged)
+        rf._blob = self._blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, options):
+        ctx = get_ctx()
+        if self._blob is None:
+            self._blob = ser.dumps(self._fn)
+        func_id = ctx.upload_function(self._blob)
+        num_returns = options.get("num_returns", 1)
+        s_args, s_kwargs = ctx.serialize_args(args, kwargs)
+        task_id, return_ids = ctx.new_task_returns(max(num_returns, 1))
+        spec = {
+            "task_id": task_id,
+            "kind": "task",
+            "func_id": func_id,
+            "args": s_args,
+            "kwargs": s_kwargs,
+            "num_returns": num_returns,
+            "return_ids": return_ids,
+            "resources": opt.to_resources(options, is_actor=False),
+            "strategy": opt.to_strategy(options),
+            "max_retries": options.get("max_retries", GLOBAL_CONFIG.default_max_retries),
+            "name": options.get("name") or getattr(self._fn, "__qualname__", "task"),
+        }
+        refs = ctx.submit_task(spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG-node construction (reference: dag/dag_node.py)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+
+def remote_decorator(args: tuple, kwargs: dict[str, Any]):
+    """Implements both ``@remote`` and ``@remote(**opts)`` for functions and
+    classes (dispatch mirrors reference ``python/ray/_private/worker.py`` remote)."""
+    from ray_tpu.actor import ActorClass
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target, {})
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, dict(kwargs))
+        return RemoteFunction(target, dict(kwargs))
+
+    return wrap

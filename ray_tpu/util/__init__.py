@@ -1,0 +1,26 @@
+"""Utility APIs (reference: ``python/ray/util/``)."""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    for mod in ("actor_pool", "queue", "metrics", "state"):
+        try:
+            m = importlib.import_module(f"ray_tpu.util.{mod}")
+        except ImportError:
+            continue
+        if hasattr(m, name):
+            return getattr(m, name)
+        if mod == name:
+            return m
+    raise AttributeError(name)

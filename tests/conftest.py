@@ -1,0 +1,50 @@
+"""Shared fixtures.
+
+Mirrors the reference's ``python/ray/tests/conftest.py``: ``ray_start_regular``
+(single-node init/shutdown per test), ``ray_start_cluster`` (in-process
+multi-node). JAX-touching tests force an 8-device virtual CPU mesh so
+multi-chip sharding logic runs in CI with no TPU attached (the reference
+equivalently fakes GPUs with logical resources).
+"""
+
+import os
+
+# Must be set before jax ever initializes in this process: tests exercise
+# multi-"chip" sharding on a virtual 8-device CPU mesh.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster_holder = []
+
+    def factory(**head_args):
+        cluster = Cluster(initialize_head=True, head_node_args=head_args)
+        cluster_holder.append(cluster)
+        return cluster
+
+    yield factory
+    for c in cluster_holder:
+        c.shutdown()

@@ -1,0 +1,163 @@
+"""Actor tests (modeled on the reference's ``python/ray/tests/test_actor.py``
+family: ordering, state, named actors, restarts, kill)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, by=1):
+        self.v += by
+        return self.v
+
+    def value(self):
+        return self.v
+
+    def crash(self):
+        import os
+
+        os._exit(1)
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_state_isolated(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.inc.remote())
+    assert ray_tpu.get(b.value.remote()) == 0
+
+
+def test_named_actor(ray_start_regular):
+    # Keep the original handle alive: like the reference, a non-detached named
+    # actor is killed once every handle goes out of scope.
+    c = Counter.options(name="global_counter").remote()
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.inc.remote()) == 1
+    del c
+
+
+def test_named_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_actor_init_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("init failed")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises((RuntimeError, RayActorError)):
+        ray_tpu.get(b.m.remote())
+
+
+def test_actor_crash_no_restart(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    c.crash.remote()
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.value.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start_regular):
+    c = Counter.options(max_restarts=1).remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    c.crash.remote()
+    # wait for restart; state resets (fresh __init__)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            v = ray_tpu.get(c.value.remote(), timeout=5)
+            assert v == 0
+            break
+        except RayActorError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_ray_kill(ray_start_regular):
+    c = Counter.options(max_restarts=5).remote()
+    ray_tpu.get(c.inc.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.value.remote(), timeout=30)
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.inc.remote())
+
+    assert ray_tpu.get(use.remote(c)) == 1
+    assert ray_tpu.get(c.value.remote()) == 1
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def block(self, t):
+            time.sleep(t)
+            return 1
+
+    p = Parallel.remote()
+    ray_tpu.get(p.block.remote(0.0))  # wait for actor bring-up before timing
+    start = time.monotonic()
+    refs = [p.block.remote(1.0) for _ in range(4)]
+    ray_tpu.get(refs)
+    assert time.monotonic() - start < 3.5  # would be >=4s if serialized
+
+
+def test_method_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class M:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.two.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_actor_pool(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class W:
+        def f(self, x):
+            return x * 2
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.f.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]

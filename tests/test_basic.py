@@ -1,0 +1,213 @@
+"""Core task/object API tests (modeled on the reference's
+``python/ray/tests/test_basic.py`` family)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, RayTaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3], "b": "x"})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)  # 4MB -> shm path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # zero-copy: the result should be backed by shared memory (not writeable)
+    assert out.flags["WRITEABLE"] is False or out.base is not None
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+    refs = [f.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def f(x, y):
+        return x + y
+
+    a = ray_tpu.put(10)
+    b = f.remote(a, 5)
+    c = f.remote(b, a)
+    assert ray_tpu.get(c) == 25
+
+
+def test_task_chain_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def dependent(x):
+        return x
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+    # error poisons dependents
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(dependent.remote(boom.remote()))
+
+
+def test_task_error_is_raytaskerror(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("k")
+
+    with pytest.raises(RayTaskError):
+        ray_tpu.get(boom.remote())
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_num_cpus_options(ray_start_regular):
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+    assert ray_tpu.get(f.options(num_cpus=1).remote()) == 1
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ref = slow.remote()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(60)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=10)
+    assert ready == [f]
+    assert not_ready == [s]
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=2, timeout=0.2)
+    assert ready == [f] and not_ready == [s]
+    ray_tpu.cancel(s, force=True)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_task_returns_ref(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return ray_tpu.put(123)
+
+    ref_of_ref = make.remote()
+    inner_ref = ray_tpu.get(ref_of_ref)
+    assert ray_tpu.get(inner_ref) == 123
+
+
+def test_large_arg_promoted(ray_start_regular):
+    big = np.ones(500_000, dtype=np.float64)  # 4MB by-value arg
+
+    @ray_tpu.remote
+    def s(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(s.remote(big)) == 500_000.0
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+
+
+def test_cannot_call_remote_directly(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_put_objectref_rejected(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_cancel_pending(ray_start_regular):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(60)
+
+    @ray_tpu.remote
+    def victim():
+        return 1
+
+    # fill all 4 cpus
+    blockers = [blocker.remote() for _ in range(4)]
+    v = victim.remote()
+    ray_tpu.cancel(v)
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=10)
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
+
+
+def test_dag_bind_execute(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    node = add.bind(add.bind(1, 2), 4)
+    assert ray_tpu.get(node.execute()) == 7

@@ -1,0 +1,81 @@
+"""Object lifetime / refcount regressions (reference: reference_count.h
+semantics — a live ObjectRef keeps its object alive across arbitrary reuse)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+
+def test_put_ref_survives_task_use(ray_start_regular):
+    # Regression: put objects must not be evicted after first use as an arg.
+    big = ray_tpu.put(list(range(50_000)))  # large enough for the shm path
+
+    @ray_tpu.remote
+    def length(x):
+        return len(x)
+
+    assert ray_tpu.get(length.remote(big)) == 50_000
+    # second use + direct get must still work while the ref is alive
+    assert ray_tpu.get(length.remote(big)) == 50_000
+    assert len(ray_tpu.get(big, timeout=10)) == 50_000
+
+
+def test_actor_arg_pinned_until_execution(ray_start_regular):
+    # Regression: actor-method args must be pinned even if the driver drops
+    # its ref right after submission.
+    @ray_tpu.remote
+    class Consumer:
+        def consume(self, x):
+            return len(x)
+
+    c = Consumer.remote()
+
+    @ray_tpu.remote
+    def produce():
+        return list(range(50_000))
+
+    ref = c.consume.remote(produce.remote())
+    # the intermediate ref was created inline and dropped immediately
+    assert ray_tpu.get(ref, timeout=30) == 50_000
+
+
+def test_actor_restart_releases_resources(ray_start_regular):
+    # Regression: a restarted actor must not leak its resource allocation —
+    # after kill, the CPU it held must be schedulable again.
+    @ray_tpu.remote(num_cpus=1, max_restarts=1)
+    class Holder:
+        def ping(self):
+            return "ok"
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "ok"
+    h.crash.remote()
+    # wait for restart
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(h.ping.remote(), timeout=5) == "ok"
+            break
+        except RayActorError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+    ray_tpu.kill(h)
+
+    # all CPUs must come back once the actor is dead
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        total = ray_tpu.cluster_resources().get("CPU", 0)
+        if avail == total:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU") == ray_tpu.cluster_resources().get("CPU")
