@@ -1,0 +1,122 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric: single-chip GPT training throughput (tokens/sec) on the
+flagship decoder-only model, bf16 compute.
+
+``vs_baseline`` normalizes across hardware and model size via MFU (model
+FLOPs utilization, train FLOPs ≈ 6·N·tokens): the reference's headline
+training number is the GPT-J-6B DeepSpeed ZeRO-3 fine-tune at 4.565
+samples/s × 512 tokens on 16× T4 (`release/air_examples/
+gptj_deepspeed_finetuning/gptj_deepspeed_fine_tuning.ipynb`, BASELINE.md) →
+146 tokens/s/GPU → 6·6.05e9·146 / 65e12 (T4 fp16 peak) ≈ 8.15% MFU.
+``vs_baseline`` = our MFU / 0.0815, so >1.0 means better hardware
+utilization than the reference's own headline run.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REF_MFU = 0.0815  # reference GPT-J-6B fine-tune (see module docstring)
+
+PEAK_FLOPS = {
+    # per-chip dense bf16 peak
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+    "TPU v6 lite": 918e12,   # v6e
+    "TPU v6e": 918e12,
+    "TPU v7": 4614e12,       # ironwood
+    "cpu": 1e12,             # nominal, for smoke runs without a TPU
+}
+_MAX_TPU_PEAK = max(v for k, v in PEAK_FLOPS.items() if k != "cpu")
+
+
+def _peak_for(device) -> tuple[float, bool]:
+    """(peak_flops, assumed). Unknown TPU kinds assume the highest known peak
+    so MFU/vs_baseline are understated, never inflated."""
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    for name, peak in PEAK_FLOPS.items():
+        if name.lower() in kind:
+            return peak, False
+    if "tpu" in kind:
+        return _MAX_TPU_PEAK, True
+    return PEAK_FLOPS["cpu"], True
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.gpt import GPTConfig, gpt_init, gpt_loss
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.parallel.train_step import build_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16)
+        batch = 8
+        steps = 10
+    else:  # smoke config for CPU-only environments
+        cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128, n_layers=2, n_heads=4)
+        batch = 4
+        steps = 2
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1), devices=[dev])
+
+    def loss_fn(params, tokens):
+        return gpt_loss(cfg, params, tokens, mesh)
+
+    init_fn, step_fn = build_train_step(loss_fn, optax.adamw(1e-4), mesh)
+    params = gpt_init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    state = init_fn(params)
+    del params
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size, jnp.int32
+    )
+    tokens = jax.device_put(tokens, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+
+    # warmup / compile
+    state, loss = step_fn(state, tokens)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step_fn(state, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tok_per_step = batch * cfg.seq_len
+    tok_per_sec = steps * tok_per_step / dt
+    peak, peak_assumed = _peak_for(dev)
+    mfu = 6.0 * n_params * tok_per_sec / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_train_tokens_per_sec_per_chip",
+                "value": round(tok_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / REF_MFU, 3),
+                "detail": {
+                    "model_params": n_params,
+                    "mfu": round(mfu, 4),
+                    "device": str(getattr(dev, "device_kind", dev)),
+                    "peak_flops_assumed": peak_assumed,
+                    "loss": float(loss),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Model zoo: TPU-first architectures as pure-JAX parameter pytrees.
+
+The flagship is ``gpt`` (decoder-only transformer, the shape of the
+reference's GPT-J-6B north-star fine-tune). Models here are functions, not
+modules: ``init(rng, cfg) -> params`` and ``forward(cfg, params, tokens)``,
+stacked over layers for ``lax.scan`` (fast compiles at depth) and annotated
+for the sharding rule table in ``ray_tpu.parallel.sharding``.
+"""
+
+from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init, gpt_loss  # noqa: F401

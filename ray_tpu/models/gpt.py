@@ -1,0 +1,146 @@
+"""Decoder-only transformer (GPT family) — the flagship model.
+
+Matches the reference's north-star workload (GPT-J-6B fine-tune,
+BASELINE.json / ``release/air_examples/gptj_deepspeed_finetuning``) but built
+TPU-first:
+
+* parameters are a plain pytree with the layer dimension stacked in front, so
+  the depth loop is one ``lax.scan`` (constant compile time in depth) with
+  ``jax.checkpoint`` rematerialization per block (HBM ∝ 1 layer of
+  activations);
+* compute in bfloat16 on the MXU, params kept fp32 (master copy) and cast at
+  use; fp32 softmax/layernorm accumulations;
+* no data-dependent Python control flow — everything jits once;
+* sharding is external: ``ray_tpu.parallel.sharding`` maps parameter paths to
+  PartitionSpecs; this file only places activation constraints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50_304          # multiple of 128 for MXU lanes
+    seq_len: int = 1024
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    dtype: str = "bfloat16"           # activation/compute dtype
+    remat: bool = True
+
+    # GPT-J-6B shape (reference north star):
+    # vocab 50400→50432, seq 2048, d_model 4096, 28 layers, 16 heads
+
+    @property
+    def d_ff(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def gpt_init(rng: jax.Array, cfg: GPTConfig) -> dict:
+    """Initialize the parameter pytree (fp32 master weights)."""
+    k_tok, k_pos, k_blocks, k_head = jax.random.split(rng, 4)
+    d, dff, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    init = jax.nn.initializers.normal(0.02)
+
+    def kernel(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)
+
+    ks = jax.random.split(k_blocks, 4)
+    return {
+        "embed": {
+            "tokens": init(k_tok, (cfg.vocab_size, d), jnp.float32),
+            "pos": init(k_pos, (cfg.seq_len, d), jnp.float32),
+        },
+        "blocks": {
+            "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
+            "attn_qkv": {"kernel": kernel(ks[0], (L, d, 3 * d), d), "bias": jnp.zeros((L, 3 * d))},
+            "attn_out": {"kernel": kernel(ks[1], (L, d, d), d), "bias": jnp.zeros((L, d))},
+            "ln2": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
+            "mlp_in": {"kernel": kernel(ks[2], (L, d, dff), d), "bias": jnp.zeros((L, dff))},
+            "mlp_out": {"kernel": kernel(ks[3], (L, dff, d), dff), "bias": jnp.zeros((L, d))},
+        },
+        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "lm_head": {"kernel": kernel(k_head, (d, cfg.vocab_size), d)},
+    }
+
+
+def _layernorm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _block(cfg: GPTConfig, x, layer, mesh=None):
+    """One transformer block. ``layer`` = this layer's params (leading L dim
+    already indexed away by scan)."""
+    from jax.sharding import PartitionSpec as P
+
+    def c(y, spec):
+        if mesh is None:
+            return y
+        from ray_tpu.parallel.sharding import constrain
+
+        return constrain(y, mesh, spec)
+
+    dt = x.dtype
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+    qkv = ln1 @ layer["attn_qkv"]["kernel"].astype(dt) + layer["attn_qkv"]["bias"].astype(dt)
+    qkv = c(qkv, P(("dp", "fsdp"), None, "tp"))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+    att = causal_attention(heads(q), heads(k), heads(v))
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, d)
+    att = att @ layer["attn_out"]["kernel"].astype(dt) + layer["attn_out"]["bias"].astype(dt)
+    x = x + c(att, P(("dp", "fsdp"), None, None))
+
+    ln2 = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+    hmid = jax.nn.gelu(ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt))
+    hmid = c(hmid, P(("dp", "fsdp"), None, "tp"))
+    out = hmid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
+    return x + c(out, P(("dp", "fsdp"), None, None))
+
+
+def gpt_forward(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32."""
+    dt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    x = x + params["embed"]["pos"].astype(dt)[:s]
+
+    block = lambda carry, layer: (_block(cfg, carry, layer, mesh), None)
+    if cfg.remat:
+        block = jax.checkpoint(block, prevent_cse=False)
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"]
+    return logits
+
+
+def gpt_loss(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:
+    """Next-token cross-entropy, mean over (batch, seq-1)."""
+    logits = gpt_forward(cfg, params, tokens[:, :-1], mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

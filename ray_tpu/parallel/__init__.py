@@ -1,0 +1,27 @@
+"""SPMD parallelism over TPU device meshes.
+
+This package is the TPU-native replacement for the parallelism the reference
+delegates to torch.distributed/NCCL/DeepSpeed inside ``train_loop_per_worker``
+(reference: ``python/ray/train/torch/train_loop_utils.py:158-186`` DDP/FSDP
+wrapping, ``train/torch/config.py:47-91`` process-group setup):
+
+* data parallel (DDP)        → ``dp`` mesh axis; gradients reduced by XLA
+  collectives over ICI during the compiled step, no wrapper object.
+* sharded data parallel (ZeRO/FSDP) → ``fsdp`` axis; parameters and optimizer
+  state sharded with NamedSharding, all-gathered per layer by XLA.
+* tensor parallel (Megatron) → ``tp`` axis on weight matrices.
+* sequence/context parallel  → ``sp`` axis on the sequence dimension of
+  activations (ring attention in ``ray_tpu.ops``).
+
+Everything is driven by one ``Mesh`` + PartitionSpec rule table; XLA SPMD
+inserts the all-reduce / all-gather / reduce-scatter collectives.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    constrain,
+    param_sharding_rules,
+    shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState, build_train_step  # noqa: F401

@@ -1,0 +1,74 @@
+"""Device-mesh construction.
+
+The reference's unit of scale is "N worker actors, one NCCL rank each"
+(``train/_internal/backend_executor.py:358`` sets RANK/WORLD_SIZE). The TPU
+unit of scale is a ``jax.sharding.Mesh`` over all chips; this module builds
+meshes from either an explicit axis layout or a total device count, factoring
+sensibly (tp innermost on ICI neighbors, then fsdp, then dp outermost —
+multi-slice dp rides DCN, everything else stays on ICI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+# Axis order = device-grid nesting, outermost → innermost: tp is the
+# fastest-varying axis (adjacent ICI neighbors), then sp, then fsdp, with dp
+# outermost (the axis that crosses slice/DCN boundaries). PartitionSpecs refer
+# to axes by NAME, so this ordering only affects which physical devices form
+# each axis group.
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical axis sizes. ``-1`` on one axis means "all remaining devices"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        fixed = [a for a, s in sizes.items() if s != -1]
+        free = [a for a, s in sizes.items() if s == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        prod = math.prod(sizes[a] for a in fixed)
+        if free:
+            if n_devices % prod:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[free[0]] = n_devices // prod
+        elif prod != n_devices:
+            raise ValueError(f"mesh {sizes} needs {prod} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence] = None,
+    axis_names: Sequence[str] = AXES,
+):
+    """Build a ``jax.sharding.Mesh``.
+
+    Device order: JAX returns devices in row-major topology order; the AXES
+    ordering makes ``tp`` the innermost (fastest-varying) position so
+    tensor-parallel collectives ride adjacent ICI links, then ``sp``,
+    ``fsdp``, with ``dp`` outermost (the axis that crosses slice/DCN
+    boundaries on multi-slice pods).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    import numpy as np
+
+    arr = np.asarray(devices).reshape([sizes[a] for a in axis_names])
+    return Mesh(arr, axis_names=tuple(axis_names))

@@ -1,0 +1,79 @@
+"""PartitionSpec rule tables for transformer parameter pytrees.
+
+The reference expresses FSDP/ZeRO by wrapping modules
+(``train/torch/train_loop_utils.py:176-186``); here sharding is data, not
+wrappers: a rule table maps parameter-path regexes to PartitionSpecs, and XLA
+SPMD compiles the matching collectives. Conventions (Megatron-style):
+
+* ``tp`` shards the *output* dim of QKV and MLP-in kernels and the *input*
+  dim of the attention-proj and MLP-out kernels, so each block needs exactly
+  one all-reduce (forward) per sublayer, which XLA fuses into the matmuls.
+* ``fsdp`` shards the other (non-tp) dim of every large kernel plus the
+  embedding vocab dim — parameters and Adam state live scattered and are
+  all-gathered per layer on use (= ZeRO-3).
+* activations: batch over ``("dp","fsdp")``, sequence over ``sp``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# (regex over '/'-joined param path) -> PartitionSpec
+# Matches the GPT pytree in ray_tpu.models.gpt: params are stacked over
+# layers (leading scan dim) so specs lead with None for the layer axis.
+_RULES = [
+    (r"embed/tokens$", P("fsdp", "tp")),          # (vocab, d_model)
+    (r"embed/pos$", P(None, None)),               # (seq, d_model)
+    (r"blocks/attn_qkv/kernel$", P(None, "fsdp", "tp")),   # (L, d, 3h)
+    (r"blocks/attn_qkv/bias$", P(None, "tp")),
+    (r"blocks/attn_out/kernel$", P(None, "tp", "fsdp")),   # (L, h, d)
+    (r"blocks/attn_out/bias$", P(None, None)),
+    (r"blocks/mlp_in/kernel$", P(None, "fsdp", "tp")),     # (L, d, 4d)
+    (r"blocks/mlp_in/bias$", P(None, "tp")),
+    (r"blocks/mlp_out/kernel$", P(None, "tp", "fsdp")),    # (L, 4d, d)
+    (r"blocks/mlp_out/bias$", P(None, None)),
+    (r"blocks/ln\d/(scale|bias)$", P(None, None)),
+    (r"ln_f/(scale|bias)$", P()),  # rank-1 (d,) — replicate
+    (r"lm_head/kernel$", P("tp", "fsdp")),        # (d_model, vocab)
+]
+
+
+def spec_for_path(path: str) -> P:
+    for pattern, spec in _RULES:
+        if re.search(pattern, path):
+            return spec
+    return P()  # replicate by default (small tensors)
+
+
+def param_sharding_rules(params: Any) -> Any:
+    """Pytree of PartitionSpecs matching ``params``' structure."""
+
+    def one(path, leaf):
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        return spec_for_path(key)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(params: Any, mesh) -> Any:
+    """device_put the pytree with NamedShardings from the rule table."""
+    specs = param_sharding_rules(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def batch_spec() -> P:
+    """(batch, seq) token batches: batch over dp+fsdp, seq over sp."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def constrain(x, mesh, spec: P):
+    """with_sharding_constraint pinned to a mesh (no-op outside jit)."""
+    from jax.lax import with_sharding_constraint
+
+    return with_sharding_constraint(x, NamedSharding(mesh, spec))

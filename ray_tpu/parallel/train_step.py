@@ -1,0 +1,94 @@
+"""Compiled SPMD training step.
+
+The reference's training step is user torch code with DDP allreduce hooks
+(``train/torch/train_loop_utils.py:158``); ours is one jitted function over
+the mesh: forward + backward + optimizer update, with gradient reduction,
+ZeRO gathers and tensor-parallel collectives all compiled by XLA SPMD from
+the sharding annotations. Optimizer state inherits the parameter shardings
+(ZeRO: Adam moments live scattered over ``fsdp``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import optax
+from jax.sharding import NamedSharding
+
+from ray_tpu.parallel.sharding import batch_spec, param_sharding_rules
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, jax.Array], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh,
+):
+    """Returns ``(init_fn, step_fn)``.
+
+    ``loss_fn(params, batch) -> scalar`` is differentiated; ``init_fn(params)``
+    shards params + optimizer state onto the mesh; ``step_fn(state, batch)``
+    is jitted with explicit in/out shardings so it can be dispatched with zero
+    host-side resharding.
+    """
+
+    def init_fn(params) -> TrainState:
+        p_specs = param_sharding_rules(params)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, p_specs
+        )
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=_opt_shardings(optimizer, params, p_specs, mesh),
+        )(params)
+        import jax.numpy as jnp
+
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    def step(state: TrainState, batch: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(None, NamedSharding(mesh, batch_spec())),
+        donate_argnums=(0,),
+    )
+    return init_fn, step_fn
+
+
+def _opt_shardings(optimizer, params, p_specs, mesh):
+    """Optimizer-state shardings: optax state subtrees (Adam mu/nu, …) mirror
+    the parameter pytree, so an opt-state leaf path ends with some parameter's
+    path — match by longest path suffix and inherit that param's spec (ZeRO:
+    moments live scattered exactly like their parameter). Non-mirroring leaves
+    (step counts, scalars) replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    def path_keys(path):
+        return tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+    param_specs: dict[tuple, Any] = {}
+    jax.tree_util.tree_map_with_path(
+        lambda path, spec: param_specs.setdefault(path_keys(path), spec), p_specs
+    )
+
+    shapes = jax.eval_shape(optimizer.init, params)
+
+    def one(path, leaf):
+        keys = path_keys(path)
+        for i in range(len(keys)):
+            spec = param_specs.get(keys[i:])
+            if spec is not None and len(spec) <= len(leaf.shape):
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(one, shapes)

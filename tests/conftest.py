@@ -10,10 +10,19 @@ equivalently fakes GPUs with logical resources).
 import os
 
 # Must be set before jax ever initializes in this process: tests exercise
-# multi-"chip" sharding on a virtual 8-device CPU mesh.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# multi-"chip" sharding on a virtual 8-device CPU mesh. The env vars alone are
+# not enough in environments whose site hooks pre-register a TPU plugin, so
+# also force the platform through jax.config (no-op if jax is absent).
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 
