@@ -1,0 +1,19 @@
+"""ray_tpu.collective — collectives among actors (host plane) and meshes
+(device plane). See ``collective.py`` for the backend story."""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    mesh_allreduce,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import ReduceOp  # noqa: F401

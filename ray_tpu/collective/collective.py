@@ -1,0 +1,254 @@
+"""Collective communication among actors/tasks.
+
+API parity with the reference's ``ray.util.collective``
+(``util/collective/collective.py``: ``init_collective_group`` :120,
+``allreduce`` :258, ``barrier`` :298, ``broadcast`` :373, ``allgather`` :423,
+``reducescatter`` :472, ``send``/``recv`` :531/:594) — but the backends are
+TPU-native:
+
+* ``backend="host"`` (default): host-side CPU tensors move through a named
+  coordinator actor + the shared-memory object plane. This replaces Gloo.
+* Device arrays DON'T use this API on TPU: the tensor plane is XLA
+  collectives (psum/all_gather/ppermute) compiled into pjit programs over the
+  mesh — see ``ray_tpu.parallel``. ``mesh_allreduce`` et al. below are thin
+  jitted helpers for one-off device reductions on a local mesh.
+
+Each participating process keeps a per-group sequence counter; collectives on
+a group must be called in the same order by all members (same contract as
+NCCL/Gloo).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.collective.coordinator import CollectiveCoordinator, poll
+from ray_tpu.collective.types import GroupInfo, ReduceOp
+
+# Process-level registry (one membership per process, like an NCCL
+# communicator): any thread of a member actor may issue collectives, but
+# concurrent collectives on the same group must be externally ordered.
+_registry: dict[str, dict] = {}
+_registry_lock = threading.Lock()
+
+
+def _groups() -> dict[str, dict]:
+    return _registry
+
+
+def _coordinator_handle(group_name: str, world_size: int):
+    import ray_tpu
+    from ray_tpu.actor import get_actor
+
+    name = f"collective://{group_name}"
+    try:
+        return get_actor(name)
+    except ValueError:
+        pass
+    Coordinator = ray_tpu.remote(num_cpus=0)(CollectiveCoordinator)
+    try:
+        return Coordinator.options(
+            name=name, lifetime="detached", get_if_exists=True
+        ).remote(group_name, world_size)
+    except ValueError:
+        return get_actor(name)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Join this process to a collective group (call from inside each member
+    actor/task). Reference: ``collective.py:120``."""
+    if rank < 0 or rank >= world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    with _registry_lock:
+        if group_name in _registry:
+            raise RuntimeError(f"collective group {group_name!r} already initialized")
+        coord = _coordinator_handle(group_name, world_size)
+        import ray_tpu
+
+        ray_tpu.get(coord.join.remote(rank))
+        _registry[group_name] = {
+            "info": GroupInfo(group_name, world_size, rank, backend),
+            "coord": coord,
+            "seq": 0,
+            "p2p_seq": {},
+        }
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups().pop(group_name, None)
+    if g is not None and g["info"].rank == 0:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(g["coord"])
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g["info"].rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g["info"].world_size if g else -1
+
+
+def _group(group_name: str) -> dict:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this process; "
+            f"call init_collective_group() first"
+        )
+    return g
+
+
+def _next_seq(g: dict) -> int:
+    s = g["seq"]
+    g["seq"] = s + 1
+    return s
+
+
+def _fanin(g, kind: str, tensor, op: Optional[str], timeout: float):
+    import ray_tpu
+
+    seq = _next_seq(g)
+    rank = g["info"].rank
+    coord = g["coord"]
+    ray_tpu.get(coord.put_part.remote(kind, seq, rank, tensor))
+    return poll(
+        lambda: ray_tpu.get(coord.try_collect.remote(kind, seq, rank, op)),
+        timeout=timeout,
+    )
+
+
+def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM, timeout: float = 60.0):
+    """All-reduce a host tensor across the group; returns the reduced array
+    (and writes in place when ``tensor`` is a writable numpy array).
+    Reference semantics: ``collective.py:258``."""
+    g = _group(group_name)
+    opname = op.value if isinstance(op, ReduceOp) else str(op)
+    result = _fanin(g, "allreduce", np.asarray(tensor), opname, timeout)
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        np.copyto(tensor, result)
+        return tensor
+    return result
+
+
+def allgather(tensor, group_name: str = "default", timeout: float = 60.0) -> list:
+    """Gather every rank's tensor; returns a list indexed by rank
+    (reference ``collective.py:423``)."""
+    g = _group(group_name)
+    return _fanin(g, "allgather", np.asarray(tensor), None, timeout)
+
+
+def reducescatter(tensor, group_name: str = "default", op=ReduceOp.SUM, timeout: float = 60.0):
+    """Reduce across ranks, then return this rank's shard (row-split of the
+    flattened leading axis; reference ``collective.py:472``)."""
+    g = _group(group_name)
+    opname = op.value if isinstance(op, ReduceOp) else str(op)
+    shards = _fanin(g, "reducescatter", np.asarray(tensor), opname, timeout)
+    return shards[g["info"].rank]
+
+
+def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
+    """Block until every member arrives (reference ``collective.py:298``)."""
+    g = _group(group_name)
+    _fanin(g, "barrier", None, None, timeout)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default", timeout: float = 60.0):
+    """Broadcast from ``src_rank`` to all (reference ``collective.py:373``)."""
+    import ray_tpu
+
+    g = _group(group_name)
+    seq = _next_seq(g)
+    coord = g["coord"]
+    rank = g["info"].rank
+    if rank == src_rank:
+        ray_tpu.get(coord.bcast_put.remote(seq, np.asarray(tensor)))
+        return tensor
+    result = poll(
+        lambda: ray_tpu.get(coord.bcast_try_get.remote(seq, rank)), timeout=timeout
+    )
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        np.copyto(tensor, result)
+        return tensor
+    return result
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (reference ``collective.py:531``)."""
+    import ray_tpu
+
+    g = _group(group_name)
+    rank = g["info"].rank
+    if dst_rank == rank:
+        raise ValueError("cannot send to self")
+    key = (rank, dst_rank)
+    seq = g["p2p_seq"].get(key, 0)
+    g["p2p_seq"][key] = seq + 1
+    ray_tpu.get(g["coord"].p2p_put.remote(rank, dst_rank, seq, np.asarray(tensor)))
+
+
+def recv(tensor, src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    """Point-to-point receive; fills ``tensor`` in place when possible and
+    returns the array (reference ``collective.py:594``)."""
+    import ray_tpu
+
+    g = _group(group_name)
+    rank = g["info"].rank
+    if src_rank == rank:
+        raise ValueError("cannot recv from self")
+    key = (src_rank, rank)
+    seq = g["p2p_seq"].get(key, 0)
+    g["p2p_seq"][key] = seq + 1
+    result = poll(
+        lambda: ray_tpu.get(g["coord"].p2p_try_get.remote(src_rank, rank, seq)),
+        timeout=timeout,
+    )
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        np.copyto(tensor, result)
+        return tensor
+    return result
+
+
+# ---------------------------------------------------------------- device side
+
+
+def mesh_allreduce(x, mesh=None, op=ReduceOp.SUM):
+    """Reduce a device array across all devices of a local mesh — compiled as
+    one XLA collective over ICI. For collectives *inside* a training step,
+    annotate shardings and let XLA insert them (ray_tpu.parallel) instead."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        from ray_tpu.parallel.mesh import make_mesh, MeshConfig
+
+        mesh = make_mesh(MeshConfig(dp=-1, fsdp=1, tp=1, sp=1))
+    op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
+    fns = {
+        ReduceOp.SUM: jnp.sum,
+        ReduceOp.PRODUCT: jnp.prod,
+        ReduceOp.MIN: jnp.min,
+        ReduceOp.MAX: jnp.max,
+    }
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    xs = jax.device_put(x, NamedSharding(mesh, P(mesh.axis_names[0])))
+    return jax.jit(lambda a: fns[op](a, axis=0))(xs)

@@ -1,0 +1,126 @@
+"""Rendezvous + host-side collective coordinator actor.
+
+The reference rendezvouses NCCL communicators through a named actor holding
+the unique id (``util/collective/collective_group/nccl_collective_group.py:
+28-77`` NCCLUniqueIDStore); data then flows over NCCL. On TPU the *device*
+tensor plane is compiled XLA collectives over ICI — host-side collectives
+(small CPU tensors, control data) flow through this named coordinator actor
+instead, riding the shared-memory object plane.
+
+One coordinator actor per group, named ``collective://<group>``. All methods
+are non-blocking (the actor single-threads them); members poll ``try_*``
+methods. Sequence numbers order successive collectives on the same group.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+class CollectiveCoordinator:
+    """State machine for one collective group's host-side ops."""
+
+    def __init__(self, group_name: str, world_size: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.joined: set[int] = set()
+        # (kind, seq) -> {"parts": {rank: payload}, "result": Any, "taken": set}
+        self.slots: dict[tuple, dict] = {}
+        # point-to-point mailboxes: (src, dst, seq) -> payload
+        self.mail: dict[tuple, Any] = {}
+
+    def join(self, rank: int) -> int:
+        self.joined.add(rank)
+        return self.world_size
+
+    def ready(self) -> bool:
+        return len(self.joined) >= self.world_size
+
+    # ------------------------------------------------------------- fan-in ops
+
+    def _slot(self, key: tuple) -> dict:
+        s = self.slots.get(key)
+        if s is None:
+            s = self.slots[key] = {"parts": {}, "result": None, "taken": set()}
+        return s
+
+    def put_part(self, kind: str, seq: int, rank: int, payload) -> None:
+        self._slot((kind, seq))["parts"][rank] = payload
+
+    def try_collect(self, kind: str, seq: int, rank: int, op: Optional[str] = None):
+        """Returns ``(True, result)`` once all ranks contributed, else
+        ``(False, None)``. The result is computed once and cached; the slot is
+        freed when every rank has taken it."""
+        key = (kind, seq)
+        s = self.slots.get(key)
+        if s is None or len(s["parts"]) < self.world_size:
+            return False, None
+        if s["result"] is None:
+            s["result"] = self._reduce(kind, s["parts"], op)
+        s["taken"].add(rank)
+        result = s["result"]
+        if len(s["taken"]) >= self.world_size:
+            del self.slots[key]
+        return True, result
+
+    def _reduce(self, kind: str, parts: dict[int, Any], op: Optional[str]):
+        from ray_tpu.collective.types import ReduceOp
+
+        ordered = [parts[r] for r in range(self.world_size)]
+        if kind == "allgather":
+            return ordered
+        if kind == "barrier":
+            return True
+        if kind in ("allreduce", "reducescatter"):
+            rop = ReduceOp(op or "sum")
+            acc = ordered[0]
+            for p in ordered[1:]:
+                acc = rop.combine(acc, p)
+            if kind == "reducescatter":
+                import numpy as np
+
+                return np.array_split(np.asarray(acc), self.world_size)
+            return acc
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    # ----------------------------------------------------------- broadcast
+
+    def bcast_put(self, seq: int, payload) -> None:
+        self._slot(("broadcast", seq))["result"] = payload
+
+    def bcast_try_get(self, seq: int, rank: int):
+        key = ("broadcast", seq)
+        s = self.slots.get(key)
+        if s is None or s["result"] is None:
+            return False, None
+        s["taken"].add(rank)
+        result = s["result"]
+        if len(s["taken"]) >= self.world_size - 1:  # root doesn't fetch
+            del self.slots[key]
+        return True, result
+
+    # -------------------------------------------------------- point-to-point
+
+    def p2p_put(self, src: int, dst: int, seq: int, payload) -> None:
+        self.mail[(src, dst, seq)] = payload
+
+    def p2p_try_get(self, src: int, dst: int, seq: int):
+        key = (src, dst, seq)
+        if key in self.mail:
+            return True, self.mail.pop(key)
+        return False, None
+
+
+def poll(fn, timeout: float = 60.0, interval: float = 0.002):
+    """Client-side poll helper: call ``fn()`` (returning (done, value)) until
+    done or timeout."""
+    deadline = time.monotonic() + timeout
+    while True:
+        done, value = fn()
+        if done:
+            return value
+        if time.monotonic() > deadline:
+            raise TimeoutError("collective operation timed out")
+        time.sleep(interval)
+        interval = min(interval * 1.5, 0.05)

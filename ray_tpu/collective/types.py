@@ -1,0 +1,37 @@
+"""Collective types: reduce ops and group metadata.
+
+Reference: ``python/ray/util/collective/types.py`` (ReduceOp enum, options
+dataclasses). Ours is numpy/JAX-flavored: a ReduceOp maps to the numpy ufunc
+used host-side and to the jax.lax collective used in compiled programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+    def combine(self, a, b):
+        import numpy as np
+
+        if self is ReduceOp.SUM:
+            return np.add(a, b)
+        if self is ReduceOp.PRODUCT:
+            return np.multiply(a, b)
+        if self is ReduceOp.MIN:
+            return np.minimum(a, b)
+        return np.maximum(a, b)
+
+
+@dataclasses.dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    rank: int
+    backend: str

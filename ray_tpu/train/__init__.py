@@ -1,0 +1,28 @@
+"""ray_tpu.train — distributed training on TPU meshes.
+
+Public surface mirrors the reference's ``ray.train`` (SURVEY §2.3): configs,
+Checkpoint, session functions (report/get_context/get_checkpoint/
+get_dataset_shard), DataParallelTrainer/JaxTrainer, Result.
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint, load_pytree, save_pytree  # noqa: F401
+from ray_tpu.train._config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import (  # noqa: F401
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)
+from ray_tpu.train._backend_executor import JaxBackend  # noqa: F401

@@ -1,0 +1,111 @@
+"""Checkpoint: a directory handle with metadata.
+
+Reference: ``python/ray/train/_checkpoint.py:56`` — a Checkpoint is a
+directory on a filesystem, never a live object graph; frameworks serialize
+into it (here: orbax/msgpack/npz for JAX pytrees).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Iterator, Optional
+
+_METADATA_FILE = ".ray_tpu_checkpoint.json"
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory on the local/shared filesystem."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint contents into ``path`` (or a fresh temp dir)."""
+        dest = path or tempfile.mkdtemp(prefix="ckpt_")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Yield a local directory with the checkpoint contents. Local
+        checkpoints are yielded as-is (zero-copy)."""
+        yield self.path
+
+    def get_metadata(self) -> dict:
+        f = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(f):
+            with open(f) as fp:
+                return json.load(fp)
+        return {}
+
+    def set_metadata(self, metadata: dict) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as fp:
+            json.dump(metadata, fp)
+
+    def update_metadata(self, metadata: dict) -> None:
+        m = self.get_metadata()
+        m.update(metadata)
+        self.set_metadata(m)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __hash__(self):
+        return hash(self.path)
+
+
+def save_pytree(tree: Any, path: str, *, step: Optional[int] = None) -> Checkpoint:
+    """Serialize a JAX pytree into ``path`` and return a Checkpoint.
+
+    Uses numpy .npz of flattened leaves + a JSON treedef — robust, fast, no
+    format churn. (Orbax integration lives in ray_tpu.train.orbax_utils for
+    async multihost checkpointing of sharded arrays.)
+    """
+    import jax
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    np.savez(
+        os.path.join(path, "pytree.npz"),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+    with open(os.path.join(path, "treedef.json"), "w") as fp:
+        json.dump({"n_leaves": len(leaves), "step": step}, fp)
+    import pickle
+
+    with open(os.path.join(path, "treedef.pkl"), "wb") as fp:
+        pickle.dump(treedef, fp)
+    ckpt = Checkpoint(path)
+    if step is not None:
+        ckpt.update_metadata({"step": step})
+    return ckpt
+
+
+def load_pytree(checkpoint: "Checkpoint | str") -> Any:
+    """Inverse of :func:`save_pytree`; leaves come back as numpy arrays
+    (device placement/sharding is the caller's job via device_put)."""
+    import pickle
+
+    import numpy as np
+
+    path = checkpoint.path if isinstance(checkpoint, Checkpoint) else checkpoint
+    with open(os.path.join(path, "treedef.pkl"), "rb") as fp:
+        treedef = pickle.load(fp)
+    data = np.load(os.path.join(path, "pytree.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)

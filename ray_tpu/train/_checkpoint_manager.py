@@ -1,0 +1,94 @@
+"""Checkpoint persistence + keep-N bookkeeping.
+
+Reference: ``python/ray/train/_internal/storage.py`` (StorageContext) +
+checkpoint manager semantics of ``CheckpointConfig`` (``air/config.py:427``).
+Workers report checkpoints as local dirs; the manager commits them under
+``<storage>/<experiment>/<trial>/checkpoint_NNNNN`` and prunes by score/age.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, trial_dir: str, config: Optional[CheckpointConfig] = None):
+        self.trial_dir = trial_dir
+        self.config = config or CheckpointConfig()
+        self.committed: list[tuple[Optional[float], int, str]] = []  # (score, idx, path)
+        self.index = 0
+        os.makedirs(trial_dir, exist_ok=True)
+
+    def commit(self, reported: Checkpoint, metrics: dict) -> Checkpoint:
+        dest = os.path.join(self.trial_dir, f"checkpoint_{self.index:06d}")
+        self.index += 1
+        if os.path.abspath(reported.path) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(reported.path, dest)
+        ckpt = Checkpoint(dest)
+        ckpt.update_metadata({"metrics": _json_safe(metrics), "index": self.index - 1})
+        score = None
+        attr = self.config.checkpoint_score_attribute
+        if attr is not None and attr in metrics:
+            try:
+                score = float(metrics[attr])
+            except (TypeError, ValueError):
+                score = None
+        self.committed.append((score, self.index - 1, dest))
+        self._prune()
+        return ckpt
+
+    def _prune(self):
+        keep = self.config.num_to_keep
+        if keep is None or len(self.committed) <= keep:
+            return
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            victims = self.committed[: len(self.committed) - keep]  # oldest first
+            self.committed = self.committed[len(self.committed) - keep:]
+        else:
+            # rank best-first; unscored checkpoints always rank weakest
+            sign = 1.0 if self.config.checkpoint_score_order == "max" else -1.0
+            ranked = sorted(
+                self.committed,
+                key=lambda t: (t[0] is not None, sign * t[0] if t[0] is not None else 0.0),
+                reverse=True,
+            )
+            self.committed = ranked[:keep]
+            victims = ranked[keep:]
+        keep_paths = {p for _, _, p in self.committed}
+        for _, _, path in victims:
+            if path not in keep_paths and os.path.exists(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self.committed:
+            return None
+        _, _, path = max(self.committed, key=lambda t: t[1])
+        return Checkpoint(path)
+
+    def best(self) -> Optional[Checkpoint]:
+        scored = [t for t in self.committed if t[0] is not None]
+        if not scored:
+            return self.latest()
+        pick = max if self.config.checkpoint_score_order == "max" else min
+        return Checkpoint(pick(scored, key=lambda t: t[0])[2])
+
+
+def _json_safe(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            import json
+
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out

@@ -1,0 +1,117 @@
+"""Run/scaling/failure/checkpoint configs.
+
+Counterparts of the reference's ``python/ray/air/config.py``:
+``ScalingConfig`` :101, ``FailureConfig`` :377, ``CheckpointConfig`` :427,
+``RunConfig`` :576 — reshaped for TPU: a worker is a *host* driving all its
+local chips through one JAX process (multi-controller SPMD), not a
+one-process-per-device rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many train workers (hosts) and what each one holds.
+
+    ``num_workers`` is the number of JAX processes (= TPU hosts). Chips are
+    not divided among workers on a host: each worker drives all chips the
+    scheduler gives it via one device mesh.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for API parity; TPU path is use_tpu
+    resources_per_worker: Optional[dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5e-8" (advisory label)
+
+    def worker_resources(self) -> dict[str, float]:
+        if self.resources_per_worker is not None:
+            res = dict(self.resources_per_worker)
+            res.setdefault("CPU", 1.0)
+            return res
+        res = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = 1.0
+        if self.use_gpu:
+            res["GPU"] = 1.0
+        return res
+
+    @property
+    def total_resources(self) -> dict[str, float]:
+        per = self.worker_resources()
+        return {k: v * self.num_workers for k, v in per.items()}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Trial-level retry budget (reference ``air/config.py:377``).
+
+    ``max_failures=-1`` retries forever; 0 disables retries."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-N checkpointing policy (reference ``air/config.py:427``)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Where results live + failure/checkpoint policy
+    (reference ``air/config.py:576``)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+    log_to_file: bool = False
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.environ.get(
+            "RAY_TPU_STORAGE_PATH", os.path.expanduser("~/ray_tpu_results")
+        )
+        return os.path.abspath(os.path.expanduser(base))
+
+
+@dataclasses.dataclass
+class JaxConfig:
+    """Backend config for JAX process-group bring-up (the reference's
+    ``train/torch/config.py:47-91`` runs ``dist.init_process_group``; the TPU
+    equivalent is ``jax.distributed.initialize`` against a coordinator, after
+    which all hosts share one global device mesh)."""
+
+    coordinator_port: int = 8476
+    # When True (multi-host TPU pods), workers call
+    # jax.distributed.initialize(coordinator, num_processes, process_id).
+    # Single-host runs (and CPU test meshes) skip it.
+    init_distributed: bool = False
+    mesh_shape: Optional[dict[str, int]] = None  # dp/fsdp/sp/tp sizes
+
+    def backend_name(self) -> str:
+        return "jax"
+
+
+def dataclass_repr(obj: Any) -> str:
+    fields = dataclasses.fields(obj)
+    parts = [f"{f.name}={getattr(obj, f.name)!r}" for f in fields]
+    return f"{type(obj).__name__}({', '.join(parts)})"

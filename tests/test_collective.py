@@ -1,0 +1,108 @@
+"""Collective library tests (reference: util/collective tests).
+
+Members are actors; each joins a group and performs the same sequence of
+collectives. Host backend only (device plane is covered by parallel tests).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0)
+class Member:
+    def __init__(self, rank, world, group="g"):
+        from ray_tpu import collective as col
+
+        self.rank = rank
+        self.world = world
+        self.group = group
+        col.init_collective_group(world, rank, group_name=group)
+
+    def do_allreduce(self):
+        from ray_tpu import collective as col
+
+        x = np.full((4,), float(self.rank + 1))
+        out = col.allreduce(x, group_name=self.group)
+        return out
+
+    def do_allgather(self):
+        from ray_tpu import collective as col
+
+        return col.allgather(np.array([self.rank]), group_name=self.group)
+
+    def do_reducescatter(self):
+        from ray_tpu import collective as col
+
+        x = np.arange(4, dtype=np.float64) + self.rank
+        return col.reducescatter(x, group_name=self.group)
+
+    def do_broadcast(self):
+        from ray_tpu import collective as col
+
+        x = np.full((3,), float(self.rank * 100))
+        return col.broadcast(x, src_rank=1, group_name=self.group)
+
+    def do_sendrecv(self):
+        from ray_tpu import collective as col
+
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name=self.group)
+            return None
+        return col.recv(np.zeros(1), src_rank=0, group_name=self.group)
+
+    def do_barrier(self):
+        from ray_tpu import collective as col
+
+        col.barrier(group_name=self.group)
+        return self.rank
+
+    def rank_info(self):
+        from ray_tpu import collective as col
+
+        return col.get_rank(self.group), col.get_collective_group_size(self.group)
+
+
+@pytest.fixture
+def members(ray_start_regular):
+    world = 2
+    ms = [Member.remote(r, world) for r in range(world)]
+    ray_tpu.get([m.rank_info.remote() for m in ms])  # wait for init
+    yield ms
+
+
+def test_allreduce(members):
+    outs = ray_tpu.get([m.do_allreduce.remote() for m in members])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 3.0))
+
+
+def test_allgather(members):
+    outs = ray_tpu.get([m.do_allgather.remote() for m in members])
+    for o in outs:
+        assert [int(x[0]) for x in o] == [0, 1]
+
+
+def test_reducescatter(members):
+    o0, o1 = ray_tpu.get([m.do_reducescatter.remote() for m in members])
+    # sum over ranks of arange(4)+r = [1,3,5,7]; rank0 gets [1,3], rank1 [5,7]
+    np.testing.assert_allclose(o0, [1.0, 3.0])
+    np.testing.assert_allclose(o1, [5.0, 7.0])
+
+
+def test_broadcast(members):
+    outs = ray_tpu.get([m.do_broadcast.remote() for m in members])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((3,), 100.0))
+
+
+def test_send_recv(members):
+    outs = ray_tpu.get([m.do_sendrecv.remote() for m in members])
+    np.testing.assert_allclose(outs[1], [42.0])
+
+
+def test_barrier_and_rank(members):
+    assert sorted(ray_tpu.get([m.do_barrier.remote() for m in members])) == [0, 1]
+    infos = ray_tpu.get([m.rank_info.remote() for m in members])
+    assert infos == [(0, 2), (1, 2)]
