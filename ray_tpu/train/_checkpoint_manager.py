@@ -81,14 +81,19 @@ class CheckpointManager:
         return Checkpoint(pick(scored, key=lambda t: t[0])[2])
 
 
-def _json_safe(d: dict) -> dict:
-    out = {}
-    for k, v in d.items():
-        try:
-            import json
+def json_safe(obj):
+    """Recursively replace non-JSON-serializable values with their repr."""
+    import json
 
-            json.dumps(v)
-            out[k] = v
-        except (TypeError, ValueError):
-            out[k] = repr(v)
-    return out
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [json_safe(v) for v in obj]
+        return repr(obj)
+
+
+_json_safe = json_safe  # internal alias

@@ -1,0 +1,174 @@
+"""ray_tpu.tune tests — mirror the reference's tune test strategy: variant
+generation, trial execution, ASHA early stopping, PBT exploit/explore,
+checkpoint resume, failure retries, ResultGrid."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, FailureConfig, RunConfig
+
+
+@pytest.fixture
+def storage(tmp_path):
+    return str(tmp_path / "tune_results")
+
+
+def test_variant_generation():
+    gen = tune.BasicVariantGenerator(seed=0)
+    cfgs = gen.generate(
+        {"lr": tune.grid_search([0.1, 0.2]), "wd": tune.uniform(0, 1), "c": 5},
+        num_samples=3,
+    )
+    assert len(cfgs) == 6
+    assert {c["lr"] for c in cfgs} == {0.1, 0.2}
+    assert all(0 <= c["wd"] <= 1 and c["c"] == 5 for c in cfgs)
+
+
+def test_nested_space_and_choice():
+    gen = tune.BasicVariantGenerator(seed=1)
+    cfgs = gen.generate({"opt": {"lr": tune.choice([1, 2]), "name": "adam"}}, num_samples=4)
+    assert len(cfgs) == 4
+    assert all(c["opt"]["lr"] in (1, 2) and c["opt"]["name"] == "adam" for c in cfgs)
+
+
+def test_basic_tune_run(ray_start_regular, storage):
+    def trainable(config):
+        score = (config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="min"),
+        run_config=RunConfig(name="basic", storage_path=storage),
+    ).fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 0
+
+
+def test_asha_early_stops(ray_start_regular, storage):
+    def trainable(config):
+        for i in range(8):
+            # bad configs plateau high; good ones descend
+            loss = config["base"] - i * 0.5 if config["base"] < 5 else config["base"]
+            tune.report({"loss": loss})
+
+    grid = tune.run(
+        trainable,
+        config={"base": tune.grid_search([1.0, 2.0, 10.0, 12.0])},
+        metric="loss",
+        mode="min",
+        scheduler=tune.ASHAScheduler(metric="loss", mode="min", grace_period=1, max_t=8, reduction_factor=2),
+        storage_path=storage,
+        name="asha",
+    )
+    iters = {r.metrics["trial_id"]: r.metrics["training_iteration"] for r in grid}
+    assert len(grid) == 4
+    # the bad trials must not run all 8 iterations
+    stopped_early = [v for v in iters.values() if v < 8]
+    assert stopped_early, iters
+
+
+def test_pbt_exploits_checkpoint(ray_start_regular, storage):
+    def trainable(config):
+        import tempfile
+
+        ckpt = tune.get_checkpoint()
+        level = 0.0
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                with open(os.path.join(d, "lvl")) as f:
+                    level = float(f.read())
+        for i in range(6):
+            level += config["rate"]
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "lvl"), "w") as f:
+                f.write(str(level))
+            tune.report({"reward": level}, checkpoint=Checkpoint.from_directory(d))
+
+    pbt = tune.PopulationBasedTraining(
+        metric="reward",
+        mode="max",
+        perturbation_interval=2,
+        hyperparam_mutations={"rate": tune.uniform(0.1, 2.0)},
+        seed=0,
+    )
+    grid = tune.run(
+        trainable,
+        config={"rate": tune.grid_search([0.1, 2.0])},
+        metric="reward",
+        mode="max",
+        scheduler=pbt,
+        storage_path=storage,
+        name="pbt",
+    )
+    best = grid.get_best_result()
+    assert best.metrics["reward"] > 2.0  # high-rate path dominates
+
+
+def test_trial_failure_retry(ray_start_regular, storage, tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def trainable(config):
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("1")
+            os._exit(1)
+        tune.report({"ok": 1})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(
+            name="retry", storage_path=storage, failure_config=FailureConfig(max_failures=1)
+        ),
+    ).fit()
+    assert grid[0].error is None
+    assert grid[0].metrics["ok"] == 1
+
+
+def test_experiment_state_written(ray_start_regular, storage):
+    def trainable(config):
+        tune.report({"m": config["x"]})
+
+    tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(name="state", storage_path=storage),
+    ).fit()
+    state_file = os.path.join(storage, "state", "experiment_state.json")
+    assert os.path.exists(state_file)
+    import json
+
+    state = json.load(open(state_file))
+    assert len(state["trials"]) == 2
+    assert all(t["state"] == "TERMINATED" for t in state["trials"])
+
+
+def test_trainer_in_tuner(ray_start_regular, storage):
+    """Reference: BaseTrainer.fit runs as a 1-trial Tune experiment; ours
+    composes the other way — a Trainer is tunable via as_trainable()."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"val": config["v"] * 2})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=storage),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"v": tune.grid_search([1, 5])},
+        tune_config=tune.TuneConfig(metric="val", mode="max"),
+        run_config=RunConfig(name="outer", storage_path=storage),
+    ).fit()
+    assert grid.get_best_result().metrics["val"] == 10
