@@ -1,0 +1,115 @@
+"""ray_tpu.data: lazy distributed datasets feeding TPU training.
+
+Reference: ``python/ray/data/__init__.py`` public surface (read_* /
+from_* constructors, Dataset, DataIterator, aggregate fns, DataContext).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.data import aggregate  # noqa: F401
+from ray_tpu.data.aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum  # noqa: F401
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset  # noqa: F401
+from ray_tpu.data.datasource import (  # noqa: F401
+    BinaryDatasource,
+    BlocksDatasource,
+    CSVDatasource,
+    Datasource,
+    FileBasedDatasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+    TFRecordsDatasource,
+)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.plan import LogicalPlan, Read
+
+
+def _from_source(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset(LogicalPlan([Read(datasource=ds, parallelism=parallelism)]))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    """ds.range(10) → rows {'id': 0..9} (reference: ``ray.data.range``)."""
+    return _from_source(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    return _from_source(RangeDatasource(n, use_tensor=True, tensor_shape=tuple(shape)), parallelism)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    return _from_source(ItemsDatasource(list(items)), parallelism)
+
+
+def from_numpy(arr, *, column: Optional[str] = None) -> Dataset:
+    import numpy as np
+
+    from ray_tpu.data.block import TENSOR_COLUMN
+
+    arr = np.asarray(arr)
+    block = BlockAccessor.batch_to_block({column or TENSOR_COLUMN: arr})
+    return _from_source(BlocksDatasource([block]))
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    blocks = [BlockAccessor.batch_to_block(df) for df in dfs]
+    return _from_source(BlocksDatasource(blocks))
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _from_source(BlocksDatasource(list(tables)))
+
+
+def read_parquet(paths, *, parallelism: int = -1, columns: Optional[list] = None, **kwargs) -> Dataset:
+    kw = dict(kwargs)
+    if columns is not None:
+        kw["columns"] = columns
+    return _from_source(ParquetDatasource(paths, kw), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(CSVDatasource(paths, kwargs), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(JSONDatasource(paths, kwargs), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(TextDatasource(paths, kwargs), parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = False, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(BinaryDatasource(paths, {"include_paths": include_paths, **kwargs}), parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB", include_paths: bool = False, parallelism: int = -1) -> Dataset:
+    return _from_source(
+        ImageDatasource(paths, {"size": size, "mode": mode, "include_paths": include_paths}),
+        parallelism,
+    )
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(NumpyDatasource(paths, kwargs), parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_source(TFRecordsDatasource(paths, kwargs), parallelism)
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _from_source(datasource, parallelism)
