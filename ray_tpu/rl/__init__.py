@@ -1,0 +1,31 @@
+"""ray_tpu.rl: reinforcement learning on the task/actor runtime.
+
+Reference: ``rllib/`` — Algorithm/AlgorithmConfig driver, EnvRunner sampling
+actors, Learner/LearnerGroup updates, replay buffers, spaces, env registry.
+Compute is jax end-to-end: policies jit on CPU inside env runners; learner
+updates pjit over the local device mesh (DP axis ≈ the reference's DDP).
+"""
+
+from ray_tpu.rl.algorithm import (  # noqa: F401
+    Algorithm,
+    AlgorithmConfig,
+    get_algorithm_class,
+    register_algorithm,
+)
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.env import (  # noqa: F401
+    CartPoleEnv,
+    Env,
+    GridWorldEnv,
+    PendulumEnv,
+    SyncVectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
+from ray_tpu.rl.learner import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
+from ray_tpu.rl.rl_module import ActorCriticModule, QModule, RLModuleSpec  # noqa: F401
+from ray_tpu.rl.sample_batch import SampleBatch, compute_gae  # noqa: F401
+from ray_tpu.rl import spaces  # noqa: F401

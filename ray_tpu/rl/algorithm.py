@@ -1,0 +1,281 @@
+"""Algorithm + AlgorithmConfig: the RL training driver.
+
+Reference: ``rllib/algorithms/algorithm.py:192`` (Algorithm(Trainable):
+``step``/``training_step``, save/restore, evaluate) and
+``algorithm_config.py`` (fluent builder: ``.environment().env_runners()
+.training()``). An Algorithm owns N EnvRunner actors + a LearnerGroup;
+``train()`` = one ``training_step`` plus result bookkeeping; algorithms
+register themselves so ``tune.run("PPO")`` resolves by name.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Optional, Type
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.sample_batch import SampleBatch
+
+_ALGORITHMS: dict[str, Type["Algorithm"]] = {}
+
+
+def register_algorithm(name: str, cls: Type["Algorithm"]) -> None:
+    _ALGORITHMS[name] = cls
+
+
+def get_algorithm_class(name: str) -> Type["Algorithm"]:
+    if name not in _ALGORITHMS:
+        raise KeyError(f"Unknown algorithm {name!r}; registered: {sorted(_ALGORITHMS)}")
+    return _ALGORITHMS[name]
+
+
+class AlgorithmConfig:
+    """Fluent builder, ``.build()`` → Algorithm.
+
+    Subset of the reference's surface that the algorithms here consume;
+    unknown keys pass through ``.training(**kwargs)`` into ``self.extra``.
+    """
+
+    algo_class: Optional[Type["Algorithm"]] = None
+
+    def __init__(self):
+        self.env: Any = None
+        self.num_env_runners = 0          # 0 = sample in-process (local mode)
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 8
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.seed: Optional[int] = 0
+        self.hidden = (64, 64)
+        self.remote_learner = False
+        self.grad_clip: Optional[float] = 0.5
+        self.extra: dict[str, Any] = {}
+
+    # builder steps (each returns self, reference style) --------------------
+
+    def environment(self, env=None, **kwargs) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        self.extra.update(kwargs)
+        return self
+
+    def env_runners(
+        self,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        self.extra.update(kwargs)
+        return self
+
+    # reference alias
+    rollouts = env_runners
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def debugging(self, seed: Optional[int] = None, **kwargs) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        self.extra.update(kwargs)
+        return self
+
+    def framework(self, *_args, **_kwargs) -> "AlgorithmConfig":
+        return self  # jax only — kept for call-site parity
+
+    def resources(self, **kwargs) -> "AlgorithmConfig":
+        self.extra.update(kwargs)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in vars(self).items() if not k.startswith("_")}
+
+    def update_from_dict(self, d: dict) -> "AlgorithmConfig":
+        return self.training(**d)
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self.env = env
+        assert self.algo_class is not None, "Use a concrete config (PPOConfig, DQNConfig)"
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """Base driver. Subclasses implement ``_setup()`` and ``training_step()``."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_return_mean: Optional[float] = None
+        self._runner_actors: list = []
+        self._local_runner: Optional[EnvRunner] = None
+        self._make_runners()
+        self._setup()
+
+    # -- runner management (WorkerSet equivalent) ---------------------------
+
+    def _runner_kwargs(self) -> dict:
+        return dict(
+            env_spec=self.config.env,
+            num_envs=self.config.num_envs_per_env_runner,
+            rollout_fragment_length=self.config.rollout_fragment_length,
+            seed=self.config.seed,
+            hidden=tuple(self.config.hidden),
+            module_cls=self._module_cls(),
+        )
+
+    def _module_cls(self):
+        from ray_tpu.rl.rl_module import ActorCriticModule
+
+        return ActorCriticModule
+
+    def _make_runners(self):
+        n = self.config.num_env_runners
+        if n <= 0:
+            self._local_runner = EnvRunner(**self._runner_kwargs())
+            return
+        cls = ray_tpu.remote(EnvRunner)
+        for i in range(n):
+            kw = self._runner_kwargs()
+            kw["worker_index"] = i
+            kw["seed"] = None if self.config.seed is None else self.config.seed + i
+            self._runner_actors.append(cls.remote(**kw))
+        ray_tpu.get([a.ping.remote() for a in self._runner_actors])
+
+    def foreach_runner(self, method: str, *args) -> list:
+        """Fan a method out to all runners (reference:
+        ``WorkerSet.foreach_worker``)."""
+        if self._local_runner is not None:
+            return [getattr(self._local_runner, method)(*args)]
+        return ray_tpu.get([getattr(a, method).remote(*args) for a in self._runner_actors])
+
+    def sync_weights(self, params) -> None:
+        self.foreach_runner("set_weights", params)
+
+    # -- Trainable surface --------------------------------------------------
+
+    def _setup(self):
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def train(self) -> dict:
+        t0 = time.time()
+        result = self.training_step()
+        self.iteration += 1
+        stats = [s for s in self.foreach_runner("episode_stats") if s["episodes"]]
+        if stats:
+            self._episode_return_mean = float(
+                np.average(
+                    [s["episode_return_mean"] for s in stats],
+                    weights=[s["episodes"] for s in stats],
+                )
+            )
+        result.update(
+            {
+                "training_iteration": self.iteration,
+                "episode_return_mean": self._episode_return_mean,
+                # reference's legacy key name, used by its tuned examples
+                "episode_reward_mean": self._episode_return_mean,
+                "num_env_steps_sampled_lifetime": self._timesteps_total,
+                "timesteps_total": self._timesteps_total,
+                "time_this_iter_s": time.time() - t0,
+            }
+        )
+        return result
+
+    def stop(self):
+        for a in self._runner_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        lg = getattr(self, "learner_group", None)
+        if lg is not None:
+            lg.shutdown()
+
+    # -- checkpointing ------------------------------------------------------
+
+    def get_state(self) -> dict:
+        return {
+            "weights": self.get_weights(),
+            "iteration": self.iteration,
+            "timesteps": self._timesteps_total,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.set_weights(state["weights"])
+        self.iteration = state.get("iteration", 0)
+        self._timesteps_total = state.get("timesteps", 0)
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, params):
+        raise NotImplementedError
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            self.set_state(pickle.load(f))
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig) -> Callable:
+        """Function trainable for ray_tpu.tune: loops train() forever,
+        reporting each iteration with a checkpoint (reference: Algorithm IS
+        a class Trainable; tune here runs function trainables)."""
+
+        def trainable(config: dict):
+            import tempfile
+
+            from ray_tpu import tune
+            from ray_tpu.train import Checkpoint
+
+            cfg = base_config.copy().update_from_dict(config or {})
+            algo = cfg.build()
+            ckpt = tune.get_checkpoint()
+            if ckpt:
+                algo.restore(ckpt.path)
+            try:
+                while True:
+                    result = algo.train()
+                    d = tempfile.mkdtemp(prefix="rl_ckpt_")
+                    algo.save(d)
+                    tune.report(result, checkpoint=Checkpoint(d))
+            finally:
+                algo.stop()
+
+        trainable.__name__ = cls.__name__
+        return trainable

@@ -1,0 +1,2 @@
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401

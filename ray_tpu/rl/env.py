@@ -1,0 +1,196 @@
+"""Environments: gymnasium-API base class, classic-control built-ins,
+vectorization, and a registry.
+
+Reference: RLlib consumes external gym envs (``rllib/env/``); this image has
+no gym, so the classic-control dynamics used by the reference's smoke/learning
+tests (CartPole for PPO/DQN/IMPALA, Pendulum for continuous control) are
+implemented natively with the same physics constants as gymnasium's
+``cartpole.py`` / ``pendulum.py`` public formulas. API:
+``reset(seed) -> (obs, info)``, ``step(a) -> (obs, r, terminated, truncated,
+info)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.rl.spaces import Box, Discrete, Space
+
+
+class Env:
+    observation_space: Space
+    action_space: Space
+    spec_max_episode_steps: Optional[int] = None
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class CartPoleEnv(Env):
+    """Pole balancing; reward 1 per step; terminates past ±12° / ±2.4m."""
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.observation_space = Box(-np.inf, np.inf, shape=(4,))
+        self.action_space = Discrete(2)
+        self.spec_max_episode_steps = max_episode_steps
+        self._rng = np.random.default_rng()
+        self._state = None
+        self._t = 0
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32), {}
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        # Standard cart-pole dynamics (masscart 1.0, masspole 0.1, len 0.5).
+        temp = (force + 0.05 * th_dot**2 * sinth) / 1.1
+        th_acc = (9.8 * sinth - costh * temp) / (0.5 * (4.0 / 3.0 - 0.1 * costh**2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * costh / 1.1
+        tau = 0.02
+        x, x_dot = x + tau * x_dot, x_dot + tau * x_acc
+        th, th_dot = th + tau * th_dot, th_dot + tau * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._t += 1
+        terminated = bool(abs(x) > 2.4 or abs(th) > 12 * np.pi / 180)
+        truncated = self._t >= self.spec_max_episode_steps
+        return self._state.astype(np.float32), 1.0, terminated, truncated, {}
+
+
+class PendulumEnv(Env):
+    """Continuous control: swing up; reward = -(angle² + .1ω² + .001u²)."""
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.observation_space = Box(-np.inf, np.inf, shape=(3,))
+        self.action_space = Box(-2.0, 2.0, shape=(1,))
+        self.spec_max_episode_steps = max_episode_steps
+        self._rng = np.random.default_rng()
+        self._th = self._thdot = 0.0
+        self._t = 0
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return np.array([np.cos(self._th), np.sin(self._th), self._thdot], dtype=np.float32)
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        th, thdot = self._th, self._thdot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        reward = -(norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2)
+        thdot = thdot + (3 * 9.81 / 2 * np.sin(th) + 3.0 * u) * 0.05
+        thdot = float(np.clip(thdot, -8.0, 8.0))
+        th = th + thdot * 0.05
+        self._th, self._thdot = th, thdot
+        self._t += 1
+        return self._obs(), float(reward), False, self._t >= self.spec_max_episode_steps, {}
+
+
+class GridWorldEnv(Env):
+    """Tiny deterministic 1-D corridor (debug env; reference uses similar
+    toy envs for unit tests)."""
+
+    def __init__(self, n: int = 8):
+        self.n = n
+        self.observation_space = Box(0.0, float(n), shape=(1,))
+        self.action_space = Discrete(2)
+        self.spec_max_episode_steps = 4 * n
+        self._pos = 0
+        self._t = 0
+
+    def reset(self, *, seed=None):
+        self._pos, self._t = 0, 0
+        return np.array([0.0], dtype=np.float32), {}
+
+    def step(self, action):
+        self._pos = max(0, min(self.n - 1, self._pos + (1 if action == 1 else -1)))
+        self._t += 1
+        done = self._pos == self.n - 1
+        return (
+            np.array([float(self._pos)], dtype=np.float32),
+            1.0 if done else -0.01,
+            done,
+            self._t >= self.spec_max_episode_steps,
+            {},
+        )
+
+
+_REGISTRY: dict[str, Callable[[], Env]] = {
+    "CartPole-v1": CartPoleEnv,
+    "Pendulum-v1": PendulumEnv,
+    "GridWorld-v0": GridWorldEnv,
+}
+
+
+def register_env(name: str, creator: Callable[[], Env]) -> None:
+    """Reference: ``ray.tune.registry.register_env``."""
+    _REGISTRY[name] = creator
+
+
+def make_env(spec) -> Env:
+    if isinstance(spec, Env):
+        return spec
+    if isinstance(spec, str):
+        if spec not in _REGISTRY:
+            raise KeyError(f"Unknown env {spec!r}; registered: {sorted(_REGISTRY)}")
+        return _REGISTRY[spec]()
+    if callable(spec):
+        return spec()
+    raise TypeError(f"Cannot build env from {spec!r}")
+
+
+class SyncVectorEnv:
+    """N envs stepped in lockstep with auto-reset (reference:
+    ``rllib/env/vector_env.py``). Obs/rewards/dones are stacked numpy arrays
+    ready for one batched policy forward — the policy runs ONE jitted call
+    per vector step regardless of N."""
+
+    def __init__(self, creator: Callable[[], Env], n: int, seed: Optional[int] = None):
+        self.envs = [make_env(creator) for _ in range(n)]
+        self.n = n
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+        self._seed = seed
+
+    def reset(self):
+        obs = []
+        for i, e in enumerate(self.envs):
+            o, _ = e.reset(seed=None if self._seed is None else self._seed + i)
+            obs.append(o)
+        return np.stack(obs)
+
+    def step(self, actions):
+        obs, rews, terms, truncs = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, term, trunc, _info = e.step(a)
+            if term or trunc:
+                o, _ = e.reset()
+            obs.append(o)
+            rews.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        return (
+            np.stack(obs),
+            np.asarray(rews, np.float32),
+            np.asarray(terms, bool),
+            np.asarray(truncs, bool),
+        )

@@ -1,0 +1,186 @@
+"""EnvRunner: rollout collection (the sampling half of the RL loop).
+
+Reference: ``rllib/env/single_agent_env_runner.py`` + the older
+``RolloutWorker`` (``rllib/evaluation/rollout_worker.py:159``). One runner
+drives a vectorized env with ONE jitted policy call per vector step; N
+runners are spawned as ray_tpu actors by the algorithm and sampled in
+parallel (``WorkerSet.foreach_worker`` equivalent is a list of futures).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.rl.env import SyncVectorEnv, make_env
+from ray_tpu.rl.rl_module import ActorCriticModule, RLModuleSpec
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class EnvRunner:
+    """Collects fixed-length rollout fragments with policy outputs attached.
+
+    Used both in-process (local mode / unit tests) and as an actor body.
+    """
+
+    def __init__(
+        self,
+        env_spec: Any,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        seed: Optional[int] = None,
+        hidden: tuple = (64, 64),
+        worker_index: int = 0,
+        module_cls: Callable = ActorCriticModule,
+    ):
+        import jax
+
+        self.vec = SyncVectorEnv(env_spec, num_envs, seed=seed)
+        self.fragment = rollout_fragment_length
+        self.spec = RLModuleSpec(self.vec.observation_space, self.vec.action_space, hidden=hidden)
+        self.module = module_cls(self.spec)
+        self._rng = jax.random.PRNGKey(0 if seed is None else seed + 1000 * worker_index)
+        self.params = self.module.init(self._rng)
+        self._sample_fn = jax.jit(self.module.sample_action)
+        self._obs = self.vec.reset()
+        # episode stats
+        self._ep_ret = np.zeros(num_envs, np.float32)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: list[tuple[float, int]] = []
+
+    # -- weights -----------------------------------------------------------
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def get_spaces(self):
+        return self.spec.observation_space, self.spec.action_space
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
+        """Returns a (T*N,)-flattened SampleBatch with advantages computed.
+
+        Keeps (T, N) structure internally so GAE can bootstrap per-env.
+        """
+        import jax
+
+        T = num_steps or self.fragment
+        N = self.vec.n
+        obs_buf = np.zeros((T, N) + self.vec.observation_space.shape, np.float32)
+        act_shape = () if self.module.discrete else self.vec.action_space.shape
+        act_buf = np.zeros((T, N) + act_shape, np.float32 if not self.module.discrete else np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), bool)
+        trunc_buf = np.zeros((T, N), bool)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._sample_fn(self.params, self._obs, key)
+            action = np.asarray(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            step_actions = action if self.module.discrete else np.asarray(action)
+            self._obs, rew, term, trunc = self.vec.step(step_actions)
+            rew_buf[t], term_buf[t], trunc_buf[t] = rew, term, trunc
+            self._ep_ret += rew
+            self._ep_len += 1
+            done = term | trunc
+            for i in np.nonzero(done)[0]:
+                self._completed.append((float(self._ep_ret[i]), int(self._ep_len[i])))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+
+        # Bootstrap values for the final obs.
+        self._rng, key = jax.random.split(self._rng)
+        _, _, last_values = self._sample_fn(self.params, self._obs, key)
+        adv, targets = sb.compute_gae(
+            rew_buf, val_buf, term_buf, trunc_buf, np.asarray(last_values)
+        )
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            {
+                sb.OBS: flat(obs_buf),
+                sb.ACTIONS: flat(act_buf),
+                sb.REWARDS: flat(rew_buf),
+                sb.TERMINATEDS: flat(term_buf),
+                sb.TRUNCATEDS: flat(trunc_buf),
+                sb.LOGP: flat(logp_buf),
+                sb.VF_PREDS: flat(val_buf),
+                sb.ADVANTAGES: flat(adv),
+                sb.VALUE_TARGETS: flat(targets),
+            }
+        )
+
+    def sample_transitions(self, num_steps: int) -> SampleBatch:
+        """(s, a, r, s', done) tuples for off-policy algos (DQN)."""
+        import jax
+
+        N = self.vec.n
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS)}
+        for _ in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            action, _, _ = self._sample_fn(self.params, self._obs, key)
+            action = np.asarray(action)
+            prev_obs = self._obs
+            self._obs, rew, term, trunc = self.vec.step(action)
+            rows[sb.OBS].append(prev_obs)
+            rows[sb.ACTIONS].append(action)
+            rows[sb.REWARDS].append(rew)
+            rows[sb.NEXT_OBS].append(self._obs)
+            rows[sb.TERMINATEDS].append(term)
+            self._ep_ret += rew
+            self._ep_len += 1
+            done = term | trunc
+            for i in np.nonzero(done)[0]:
+                self._completed.append((float(self._ep_ret[i]), int(self._ep_len[i])))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+        return SampleBatch({k: np.concatenate(v) for k, v in rows.items()})
+
+    def set_epsilon(self, eps: float) -> bool:
+        """ε-greedy override used by DQN runners (wraps sample_action)."""
+        import jax
+
+        base = self.module.sample_action
+
+        def eps_greedy(params, obs, rng):
+            action, logp, value = base(params, obs, rng)
+            k1, k2 = jax.random.split(jax.random.fold_in(rng, 7))
+            import jax.numpy as jnp
+
+            rand_a = jax.random.randint(k1, action.shape, 0, self.module.act_dim)
+            explore = jax.random.uniform(k2, action.shape) < eps
+            return jnp.where(explore, rand_a, action), logp, value
+
+        self._sample_fn = jax.jit(eps_greedy)
+        return True
+
+    def episode_stats(self, clear: bool = True) -> dict:
+        eps = self._completed
+        if clear:
+            self._completed = []
+        if not eps:
+            return {"episodes": 0, "episode_return_mean": None, "episode_len_mean": None}
+        rets = [r for r, _ in eps]
+        lens = [l for _, l in eps]
+        return {
+            "episodes": len(eps),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def ping(self) -> bool:
+        return True

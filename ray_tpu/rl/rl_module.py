@@ -1,0 +1,151 @@
+"""RLModule: the policy/value network abstraction.
+
+Reference: ``rllib/core/rl_module/rl_module.py`` (framework-agnostic module
+with forward_exploration / forward_train). TPU-first: a module is a pair of
+pure functions over a parameter pytree — ``init(rng) -> params`` and
+``apply(params, obs) -> outputs`` — so the same code jits for a single CPU
+worker (env runners) and pjits over a device mesh (learners). No framework
+classes to wrap/unwrap; distribution math lives here as jax functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.spaces import Box, Discrete, Space
+
+
+def _mlp_init(rng, sizes: list[int], final_scale: float = 0.01):
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, k in enumerate(keys):
+        fan_in = sizes[i]
+        scale = final_scale if i == len(keys) - 1 else 1.0
+        w = jax.random.normal(k, (sizes[i], sizes[i + 1]), jnp.float32) * scale * (fan_in**-0.5)
+        b = jnp.zeros((sizes[i + 1],), jnp.float32)
+        params.append({"w": w, "b": b})
+    return params
+
+
+def _mlp_apply(params, x, activation=jax.nn.tanh):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = activation(x)
+    return x
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: ``rllib/core/rl_module/rl_module.py`` SingleAgentRLModuleSpec."""
+
+    observation_space: Space
+    action_space: Space
+    hidden: tuple = (64, 64)
+    free_log_std: bool = True  # continuous: state-independent log-std
+
+
+class ActorCriticModule:
+    """Shared-nothing actor + critic MLPs; discrete (categorical) or
+    continuous (diagonal gaussian) heads."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.obs_dim = int(np.prod(spec.observation_space.shape))
+        self.discrete = isinstance(spec.action_space, Discrete)
+        self.act_dim = (
+            spec.action_space.n if self.discrete else int(np.prod(spec.action_space.shape))
+        )
+
+    def init(self, rng: jax.Array) -> dict:
+        k_pi, k_v = jax.random.split(rng)
+        h = list(self.spec.hidden)
+        params = {
+            "pi": _mlp_init(k_pi, [self.obs_dim] + h + [self.act_dim]),
+            "v": _mlp_init(k_v, [self.obs_dim] + h + [1], final_scale=1.0),
+        }
+        if not self.discrete:
+            params["log_std"] = jnp.zeros((self.act_dim,), jnp.float32)
+        return params
+
+    def apply(self, params: dict, obs: jax.Array) -> dict:
+        """obs (B, obs_dim) → {'logits'|'mean'+'log_std', 'value' (B,)}."""
+        pi_out = _mlp_apply(params["pi"], obs)
+        value = _mlp_apply(params["v"], obs)[..., 0]
+        if self.discrete:
+            return {"logits": pi_out, "value": value}
+        return {"mean": pi_out, "log_std": params["log_std"], "value": value}
+
+    # -- distribution ops (pure jax; used by runners and learners) ---------
+
+    def sample_action(self, params: dict, obs: jax.Array, rng: jax.Array):
+        out = self.apply(params, obs)
+        if self.discrete:
+            action = jax.random.categorical(rng, out["logits"], axis=-1)
+            logp = _categorical_logp(out["logits"], action)
+        else:
+            std = jnp.exp(out["log_std"])
+            eps = jax.random.normal(rng, out["mean"].shape)
+            action = out["mean"] + eps * std
+            logp = _gaussian_logp(out["mean"], out["log_std"], action)
+        return action, logp, out["value"]
+
+    def logp_entropy_value(self, params: dict, obs: jax.Array, actions: jax.Array):
+        out = self.apply(params, obs)
+        if self.discrete:
+            logp = _categorical_logp(out["logits"], actions)
+            p = jax.nn.softmax(out["logits"], axis=-1)
+            entropy = -jnp.sum(p * jnp.log(p + 1e-8), axis=-1)
+        else:
+            logp = _gaussian_logp(out["mean"], out["log_std"], actions)
+            entropy = jnp.sum(out["log_std"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1) * jnp.ones(
+                out["mean"].shape[:-1]
+            )
+        return logp, entropy, out["value"]
+
+
+def _categorical_logp(logits, actions):
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp_all, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def _gaussian_logp(mean, log_std, actions):
+    std = jnp.exp(log_std)
+    return jnp.sum(
+        -0.5 * (((actions - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1
+    )
+
+
+class QModule:
+    """Q-network (+ target) for DQN-family algorithms."""
+
+    discrete = True
+
+    def __init__(self, spec: RLModuleSpec):
+        assert isinstance(spec.action_space, Discrete), "DQN requires a Discrete action space"
+        self.spec = spec
+        self.obs_dim = int(np.prod(spec.observation_space.shape))
+        self.act_dim = spec.action_space.n
+
+    def init(self, rng: jax.Array) -> dict:
+        k = jax.random.split(rng, 1)[0]
+        h = list(self.spec.hidden)
+        q = _mlp_init(k, [self.obs_dim] + h + [self.act_dim], final_scale=1.0)
+        return {"q": q, "target_q": jax.tree_util.tree_map(jnp.copy, q)}
+
+    def q_values(self, params: dict, obs: jax.Array, target: bool = False) -> jax.Array:
+        return _mlp_apply(params["target_q" if target else "q"], obs, activation=jax.nn.relu)
+
+    def sample_action(self, params: dict, obs: jax.Array, rng: jax.Array):
+        """Greedy argmax policy (runners layer ε-greedy on top via
+        ``EnvRunner.set_epsilon``); logp/value slots are zeros for interface
+        parity with ActorCriticModule."""
+        q = self.q_values(params, obs)
+        action = jnp.argmax(q, axis=-1)
+        zeros = jnp.zeros(action.shape, jnp.float32)
+        return action, zeros, zeros

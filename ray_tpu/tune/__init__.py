@@ -21,6 +21,7 @@ from ray_tpu.train._config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.train._session import get_checkpoint, get_context, report  # noqa: F401
 from ray_tpu.train.trainer import Result
 from ray_tpu.tune.controller import ERROR, TERMINATED, TuneController
+from ray_tpu.tune.registry import register_trainable, resolve_trainable  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     choice,
@@ -101,6 +102,10 @@ class Tuner:
         tune_config: Optional[TuneConfig] = None,
         run_config: Optional[RunConfig] = None,
     ):
+        if isinstance(trainable, str):  # "PPO" etc. (reference: tune registry)
+            from ray_tpu.tune.registry import resolve_trainable
+
+            trainable = resolve_trainable(trainable)
         resources = getattr(trainable, "_tune_resources", None)
         if hasattr(trainable, "as_trainable"):  # a Trainer instance
             trainable = trainable.as_trainable()

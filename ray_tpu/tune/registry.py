@@ -1,0 +1,33 @@
+"""Trainable registry: resolve string names passed to Tuner/tune.run.
+
+Reference: ``python/ray/tune/registry.py`` (``register_trainable``, RLlib
+algorithms resolvable by name, e.g. ``tune.run("PPO")``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+_TRAINABLES: dict[str, Callable] = {}
+
+
+def register_trainable(name: str, trainable: Callable) -> None:
+    _TRAINABLES[name] = trainable
+
+
+def resolve_trainable(trainable: Union[str, Callable]) -> Callable:
+    if not isinstance(trainable, str):
+        return trainable
+    if trainable in _TRAINABLES:
+        return _TRAINABLES[trainable]
+    # RL algorithms are resolvable by name, reference-style.
+    try:
+        from ray_tpu.rl import get_algorithm_class
+
+        cls = get_algorithm_class(trainable)
+        return cls.as_trainable(cls.get_default_config())
+    except KeyError:
+        raise KeyError(
+            f"Unknown trainable {trainable!r}; registered: {sorted(_TRAINABLES)} "
+            "plus RL algorithm names"
+        ) from None
