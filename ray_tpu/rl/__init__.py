@@ -13,6 +13,7 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
     register_algorithm,
 )
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.env import (  # noqa: F401
     CartPoleEnv,

@@ -49,6 +49,9 @@ class AlgorithmConfig:
         self.num_env_runners = 0          # 0 = sample in-process (local mode)
         self.num_envs_per_env_runner = 1
         self.rollout_fragment_length = 200
+        # Reference: AlgorithmConfig.fault_tolerance(restart_failed_env_runners=)
+        # — a dead runner actor is replaced in-place and training continues.
+        self.restart_failed_env_runners = True
         self.train_batch_size = 4000
         self.minibatch_size = 128
         self.num_epochs = 8
@@ -169,10 +172,46 @@ class Algorithm:
 
     def foreach_runner(self, method: str, *args) -> list:
         """Fan a method out to all runners (reference:
-        ``WorkerSet.foreach_worker``)."""
+        ``WorkerSet.foreach_worker`` with fault-tolerant apply). A runner
+        that died is restarted in-place (``restart_failed_env_runners``) and
+        its result for this round is skipped — mirroring the reference's
+        ``FaultAwareApply`` semantics."""
+        from ray_tpu.exceptions import RayActorError
+
         if self._local_runner is not None:
             return [getattr(self._local_runner, method)(*args)]
-        return ray_tpu.get([getattr(a, method).remote(*args) for a in self._runner_actors])
+        futures = [getattr(a, method).remote(*args) for a in self._runner_actors]
+        results = []
+        for i, f in enumerate(futures):
+            try:
+                results.append(ray_tpu.get(f))
+            except RayActorError:
+                if not self.config.restart_failed_env_runners:
+                    raise
+                self.restart_runner(i)
+        if not results:
+            raise RuntimeError(f"All {len(futures)} env runners failed in {method!r}")
+        return results
+
+    def restart_runner(self, index: int) -> None:
+        """Replace a dead runner actor with a fresh one carrying the current
+        weights (reference: EnvRunnerGroup._restored_workers path)."""
+        try:
+            ray_tpu.kill(self._runner_actors[index])
+        except Exception:
+            pass
+        cls = ray_tpu.remote(EnvRunner)
+        kw = self._runner_kwargs()
+        kw["worker_index"] = index
+        kw["seed"] = None if self.config.seed is None else self.config.seed + index
+        actor = cls.remote(**kw)
+        try:
+            weights = self.get_weights()
+        except (AttributeError, NotImplementedError):
+            weights = None  # during _setup, before the learner exists
+        if weights is not None:
+            actor.set_weights.remote(weights)
+        self._runner_actors[index] = actor
 
     def sync_weights(self, params) -> None:
         self.foreach_runner("set_weights", params)

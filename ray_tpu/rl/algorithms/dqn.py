@@ -54,7 +54,14 @@ def dqn_loss(gamma: float, double_q: bool):
         weights = batch.get("weights")
         per_sample = huber(td)
         loss = jnp.mean(per_sample * weights) if weights is not None else jnp.mean(per_sample)
-        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)), "q_mean": jnp.mean(q)}
+        # Per-sample |td| flows back as an aux array so prioritized replay
+        # can set PER-SAMPLE priorities (reference: dqn updates priorities
+        # with each sample's TD error, not a batch statistic).
+        return loss, {
+            "td_error_mean": jnp.mean(jnp.abs(td)),
+            "q_mean": jnp.mean(q),
+            "td_abs": jnp.abs(td),
+        }
 
     return loss_fn
 
@@ -125,9 +132,14 @@ class DQN(Algorithm):
 
     def training_step(self) -> dict:
         cfg: DQNConfig = self.config
-        # 1) sample transitions from all runners
-        per_runner = max(1, cfg.sample_steps_per_iter // max(1, len(self._runner_actors) or 1))
-        outs = self.foreach_runner("sample_transitions", per_runner)
+        # 1) sample transitions from all runners. sample_steps_per_iter counts
+        # TOTAL env steps per iteration (across runners AND their vector
+        # slots), so epsilon decay / replay-ratio tuning is independent of the
+        # runner topology.
+        n_runners = max(1, len(self._runner_actors) or 1)
+        n_envs = max(1, self.config.num_envs_per_env_runner)
+        vec_steps = max(1, cfg.sample_steps_per_iter // (n_runners * n_envs))
+        outs = self.foreach_runner("sample_transitions", vec_steps)
         for b in outs:
             self.buffer.add(b)
             self._timesteps_total += b.count
@@ -138,12 +150,10 @@ class DQN(Algorithm):
             for _ in range(cfg.updates_per_iter):
                 batch = self.buffer.sample(cfg.train_batch_size)
                 metrics = self.learner_group.update(batch)
-                if cfg.prioritized_replay and "batch_indexes" in batch:
-                    # priority = |td| proxy from metrics mean is too coarse;
-                    # recompute per-sample priorities cheaply on host
+                td_abs = metrics.pop("td_abs", None)
+                if cfg.prioritized_replay and "batch_indexes" in batch and td_abs is not None:
                     self.buffer.update_priorities(
-                        batch["batch_indexes"],
-                        np.full(len(batch["batch_indexes"]), metrics["td_error_mean"]),
+                        batch["batch_indexes"], np.asarray(td_abs)
                     )
             # 3) periodic target network sync + weight broadcast
             if self._steps_since_target_sync >= cfg.target_update_freq:

@@ -179,18 +179,29 @@ class SyncVectorEnv:
         return np.stack(obs)
 
     def step(self, actions):
-        obs, rews, terms, truncs = [], [], [], []
+        """Returns ``(obs, rewards, terminateds, truncateds, final_obs)``.
+
+        ``obs`` is the post-auto-reset observation (what the policy acts on
+        next); ``final_obs`` is the TRUE next observation of the transition —
+        the pre-reset terminal obs for done envs (gymnasium's
+        ``final_observation`` info field). Off-policy algorithms must
+        bootstrap from ``final_obs``, never from a reset state.
+        """
+        obs, rews, terms, truncs, finals = [], [], [], [], []
         for e, a in zip(self.envs, actions):
             o, r, term, trunc, _info = e.step(a)
+            final = o
             if term or trunc:
                 o, _ = e.reset()
             obs.append(o)
             rews.append(r)
             terms.append(term)
             truncs.append(trunc)
+            finals.append(final)
         return (
             np.stack(obs),
             np.asarray(rews, np.float32),
             np.asarray(terms, bool),
             np.asarray(truncs, bool),
+            np.stack(finals),
         )

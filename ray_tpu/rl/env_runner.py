@@ -43,7 +43,11 @@ class EnvRunner:
         self.module = module_cls(self.spec)
         self._rng = jax.random.PRNGKey(0 if seed is None else seed + 1000 * worker_index)
         self.params = self.module.init(self._rng)
-        self._sample_fn = jax.jit(self.module.sample_action)
+        # Jitted once each; epsilon is a TRACED argument of the eps-greedy
+        # variant so updating it never triggers an XLA recompile.
+        self._base_fn = jax.jit(self.module.sample_action)
+        self._eps_fn = None  # built lazily on first set_epsilon
+        self._eps: Optional[float] = None
         self._obs = self.vec.reset()
         # episode stats
         self._ep_ret = np.zeros(num_envs, np.float32)
@@ -62,7 +66,72 @@ class EnvRunner:
     def get_spaces(self):
         return self.spec.observation_space, self.spec.action_space
 
+    # -- policy invocation -------------------------------------------------
+
+    def _policy(self, params, obs, key):
+        if self._eps is None:
+            return self._base_fn(params, obs, key)
+        return self._eps_fn(params, obs, key, self._eps)
+
+    def _values_of(self, obs_batch: np.ndarray) -> np.ndarray:
+        """Critic value of arbitrary observations (used to bootstrap at
+        truncations from the TRUE final obs rather than the reset obs)."""
+        import jax
+
+        _, _, values = self._base_fn(self.params, obs_batch, jax.random.PRNGKey(0))
+        return np.asarray(values)
+
     # -- sampling ----------------------------------------------------------
+
+    def _rollout(self, T: int) -> dict[str, np.ndarray]:
+        """Shared (T, N)-buffer rollout collector behind all three samplers.
+
+        Steps the vector env T times with the current policy, maintaining
+        episode-return bookkeeping. ``final`` holds each transition's TRUE
+        next obs (pre-auto-reset for done envs).
+        """
+        import jax
+
+        N = self.vec.n
+        obs_shape = self.vec.observation_space.shape
+        act_shape = () if self.module.discrete else self.vec.action_space.shape
+        buf = {
+            "obs": np.zeros((T, N) + obs_shape, np.float32),
+            "act": np.zeros((T, N) + act_shape, np.int64 if self.module.discrete else np.float32),
+            "rew": np.zeros((T, N), np.float32),
+            "term": np.zeros((T, N), bool),
+            "trunc": np.zeros((T, N), bool),
+            "logp": np.zeros((T, N), np.float32),
+            "val": np.zeros((T, N), np.float32),
+            "final": np.zeros((T, N) + obs_shape, np.float32),
+        }
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._policy(self.params, self._obs, key)
+            action = np.asarray(action)
+            buf["obs"][t] = self._obs
+            buf["act"][t] = action
+            buf["logp"][t] = np.asarray(logp)
+            buf["val"][t] = np.asarray(value)
+            self._obs, rew, term, trunc, final = self.vec.step(action)
+            buf["rew"][t], buf["term"][t], buf["trunc"][t] = rew, term, trunc
+            buf["final"][t] = final
+            self._ep_ret += rew
+            self._ep_len += 1
+            for i in np.nonzero(term | trunc)[0]:
+                self._completed.append((float(self._ep_ret[i]), int(self._ep_len[i])))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+        return buf
+
+    def _truncation_values(self, buf) -> Optional[np.ndarray]:
+        """Critic values of the true final obs, (T, N), where truncated."""
+        if not buf["trunc"].any():
+            return None
+        T, N = buf["rew"].shape
+        obs_shape = self.vec.observation_space.shape
+        tv = self._values_of(buf["final"].reshape((T * N,) + obs_shape))
+        return tv.reshape(T, N)
 
     def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
         """Returns a (T*N,)-flattened SampleBatch with advantages computed.
@@ -73,97 +142,104 @@ class EnvRunner:
 
         T = num_steps or self.fragment
         N = self.vec.n
-        obs_buf = np.zeros((T, N) + self.vec.observation_space.shape, np.float32)
-        act_shape = () if self.module.discrete else self.vec.action_space.shape
-        act_buf = np.zeros((T, N) + act_shape, np.float32 if not self.module.discrete else np.int64)
-        rew_buf = np.zeros((T, N), np.float32)
-        term_buf = np.zeros((T, N), bool)
-        trunc_buf = np.zeros((T, N), bool)
-        logp_buf = np.zeros((T, N), np.float32)
-        val_buf = np.zeros((T, N), np.float32)
-
-        for t in range(T):
-            self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._sample_fn(self.params, self._obs, key)
-            action = np.asarray(action)
-            obs_buf[t] = self._obs
-            act_buf[t] = action
-            logp_buf[t] = np.asarray(logp)
-            val_buf[t] = np.asarray(value)
-            step_actions = action if self.module.discrete else np.asarray(action)
-            self._obs, rew, term, trunc = self.vec.step(step_actions)
-            rew_buf[t], term_buf[t], trunc_buf[t] = rew, term, trunc
-            self._ep_ret += rew
-            self._ep_len += 1
-            done = term | trunc
-            for i in np.nonzero(done)[0]:
-                self._completed.append((float(self._ep_ret[i]), int(self._ep_len[i])))
-                self._ep_ret[i] = 0.0
-                self._ep_len[i] = 0
-
+        buf = self._rollout(T)
         # Bootstrap values for the final obs.
         self._rng, key = jax.random.split(self._rng)
-        _, _, last_values = self._sample_fn(self.params, self._obs, key)
+        _, _, last_values = self._base_fn(self.params, self._obs, key)
+        # At truncated steps GAE must bootstrap from the critic's value of
+        # the TRUE final obs (pre-reset), not the stored value of the reset
+        # obs; one extra batched forward over the rollout supplies it.
         adv, targets = sb.compute_gae(
-            rew_buf, val_buf, term_buf, trunc_buf, np.asarray(last_values)
+            buf["rew"], buf["val"], buf["term"], buf["trunc"], np.asarray(last_values),
+            truncation_values=self._truncation_values(buf),
         )
         flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
         return SampleBatch(
             {
-                sb.OBS: flat(obs_buf),
-                sb.ACTIONS: flat(act_buf),
-                sb.REWARDS: flat(rew_buf),
-                sb.TERMINATEDS: flat(term_buf),
-                sb.TRUNCATEDS: flat(trunc_buf),
-                sb.LOGP: flat(logp_buf),
-                sb.VF_PREDS: flat(val_buf),
+                sb.OBS: flat(buf["obs"]),
+                sb.ACTIONS: flat(buf["act"]),
+                sb.REWARDS: flat(buf["rew"]),
+                sb.TERMINATEDS: flat(buf["term"]),
+                sb.TRUNCATEDS: flat(buf["trunc"]),
+                sb.LOGP: flat(buf["logp"]),
+                sb.VF_PREDS: flat(buf["val"]),
                 sb.ADVANTAGES: flat(adv),
                 sb.VALUE_TARGETS: flat(targets),
             }
         )
 
     def sample_transitions(self, num_steps: int) -> SampleBatch:
-        """(s, a, r, s', done) tuples for off-policy algos (DQN)."""
+        """(s, a, r, s', done) tuples for off-policy algos (DQN).
+
+        NEXT_OBS is the TRUE next observation (the pre-reset terminal obs for
+        done envs), so Q-targets never bootstrap from a reset state; TRUNCATEDS
+        is stored so losses can distinguish time-limit cuts from termination.
+        """
+        T, N = num_steps, self.vec.n
+        buf = self._rollout(T)
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            {
+                sb.OBS: flat(buf["obs"]),
+                sb.ACTIONS: flat(buf["act"]),
+                sb.REWARDS: flat(buf["rew"]),
+                sb.NEXT_OBS: flat(buf["final"]),
+                sb.TERMINATEDS: flat(buf["term"]),
+                sb.TRUNCATEDS: flat(buf["trunc"]),
+            }
+        )
+
+    def sample_sequences(self, num_steps: Optional[int] = None, gamma: float = 0.99) -> SampleBatch:
+        """Time-major rollout kept as (N, T, ...) sequences for V-trace
+        (IMPALA). Truncated steps fold the critic's value of the true final
+        obs into the reward (the standard time-limit bootstrap trick), so the
+        V-trace scan can treat every boundary as a hard cut.
+
+        Extra keys: ``bootstrap_value`` (N,) — critic value of the obs after
+        the last step of each slot.
+        """
         import jax
 
-        N = self.vec.n
-        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS)}
-        for _ in range(num_steps):
-            self._rng, key = jax.random.split(self._rng)
-            action, _, _ = self._sample_fn(self.params, self._obs, key)
-            action = np.asarray(action)
-            prev_obs = self._obs
-            self._obs, rew, term, trunc = self.vec.step(action)
-            rows[sb.OBS].append(prev_obs)
-            rows[sb.ACTIONS].append(action)
-            rows[sb.REWARDS].append(rew)
-            rows[sb.NEXT_OBS].append(self._obs)
-            rows[sb.TERMINATEDS].append(term)
-            self._ep_ret += rew
-            self._ep_len += 1
-            done = term | trunc
-            for i in np.nonzero(done)[0]:
-                self._completed.append((float(self._ep_ret[i]), int(self._ep_len[i])))
-                self._ep_ret[i] = 0.0
-                self._ep_len[i] = 0
-        return SampleBatch({k: np.concatenate(v) for k, v in rows.items()})
+        T = num_steps or self.fragment
+        buf = self._rollout(T)
+        rew, done = buf["rew"], buf["term"]
+        tv = self._truncation_values(buf)
+        if tv is not None:
+            rew = np.where(buf["trunc"], rew + gamma * tv, rew)
+            done = done | buf["trunc"]
+        self._rng, key = jax.random.split(self._rng)
+        _, _, boot = self._base_fn(self.params, self._obs, key)
+        tm = lambda a: np.swapaxes(a, 0, 1)  # (T,N,..) -> (N,T,..)  # noqa: E731
+        return SampleBatch(
+            {
+                sb.OBS: tm(buf["obs"]),
+                sb.ACTIONS: tm(buf["act"]),
+                sb.REWARDS: tm(rew),
+                sb.TERMINATEDS: tm(done),
+                sb.LOGP: tm(buf["logp"]),
+                "bootstrap_value": np.asarray(boot),
+            }
+        )
 
     def set_epsilon(self, eps: float) -> bool:
-        """ε-greedy override used by DQN runners (wraps sample_action)."""
+        """ε-greedy override used by DQN runners. The wrapper is jitted ONCE
+        with ε as a traced argument — per-iteration ε decay is free."""
         import jax
+        import jax.numpy as jnp
 
-        base = self.module.sample_action
+        if self._eps_fn is None:
+            base = self.module.sample_action
+            act_dim = self.module.act_dim
 
-        def eps_greedy(params, obs, rng):
-            action, logp, value = base(params, obs, rng)
-            k1, k2 = jax.random.split(jax.random.fold_in(rng, 7))
-            import jax.numpy as jnp
+            def eps_greedy(params, obs, rng, eps):
+                action, logp, value = base(params, obs, rng)
+                k1, k2 = jax.random.split(jax.random.fold_in(rng, 7))
+                rand_a = jax.random.randint(k1, action.shape, 0, act_dim)
+                explore = jax.random.uniform(k2, action.shape) < eps
+                return jnp.where(explore, rand_a, action), logp, value
 
-            rand_a = jax.random.randint(k1, action.shape, 0, self.module.act_dim)
-            explore = jax.random.uniform(k2, action.shape) < eps
-            return jnp.where(explore, rand_a, action), logp, value
-
-        self._sample_fn = jax.jit(eps_greedy)
+            self._eps_fn = jax.jit(eps_greedy)
+        self._eps = float(eps)
         return True
 
     def episode_stats(self, clear: bool = True) -> dict:

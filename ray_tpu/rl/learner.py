@@ -84,18 +84,28 @@ class Learner:
         arrays = {k: np.asarray(v) for k, v in batch.items()}
         if self._sharding is not None:
             n = len(jax.devices())
-            # Pad to a multiple of the data axis so the shard is even.
+            # Pad (by cycling rows) to a multiple of the data axis so the
+            # shard is even — works even when the batch is SMALLER than the
+            # device count (e.g. few-env IMPALA sequence batches).
             rows = len(next(iter(arrays.values())))
-            pad = (-rows) % n
-            if pad:
-                arrays = {k: np.concatenate([v, v[:pad]]) for k, v in arrays.items()}
+            target = -(-rows // n) * n
+            if target != rows:
+                idx = np.arange(target) % rows
+                arrays = {k: v[idx] for k, v in arrays.items()}
             return {k: jax.device_put(v, self._sharding) for k, v in arrays.items()}
         return {k: jax.device_put(v) for k, v in arrays.items()}
 
     def update(self, batch: SampleBatch) -> dict:
+        rows = batch.count
         dev_batch = self._device_batch(batch)
         self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, dev_batch)
-        return {k: float(np.asarray(v)) for k, v in metrics.items()}
+        out = {}
+        for k, v in metrics.items():
+            a = np.asarray(v)
+            # Per-sample aux outputs (e.g. DQN |td| for prioritized replay)
+            # pass through as arrays, trimmed of any data-axis padding rows.
+            out[k] = float(a) if a.ndim == 0 else a[:rows]
+        return out
 
     def get_weights(self):
         return self.params

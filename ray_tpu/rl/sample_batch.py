@@ -64,14 +64,17 @@ def compute_gae(
     last_values: np.ndarray,
     gamma: float = 0.99,
     lam: float = 0.95,
+    truncation_values: Optional[np.ndarray] = None,
 ):
     """Generalized advantage estimation over (T, N) rollout arrays.
 
     Matches the reference's GAE (``postprocessing.py compute_advantages``):
     at a TERMINATED step the bootstrap value is 0; at a TRUNCATED step the
-    trajectory is cut but bootstrapped with the critic's value of the next
-    state (approximated by the stored value of the reset obs — standard
-    vectorized-PPO practice).
+    trajectory is cut but bootstrapped with the critic's value of the TRUE
+    next state — pass ``truncation_values`` (T, N), the critic's value of
+    each step's pre-reset final obs, to supply it (EnvRunner.sample does);
+    without it the stored value of the reset obs is the fallback
+    approximation.
     Returns (advantages, value_targets), both (T, N) float32.
     """
     T, N = rewards.shape
@@ -79,8 +82,10 @@ def compute_gae(
     last_gae = np.zeros(N, np.float32)
     next_values = np.concatenate([values[1:], last_values[None]], axis=0)
     for t in range(T - 1, -1, -1):
-        done = terminateds[t]
-        nv = np.where(done, 0.0, next_values[t])
+        nv = next_values[t]
+        if truncation_values is not None:
+            nv = np.where(truncateds[t], truncation_values[t], nv)
+        nv = np.where(terminateds[t], 0.0, nv)
         delta = rewards[t] + gamma * nv - values[t]
         # Cut the GAE recursion at ANY episode boundary (term or trunc).
         boundary = terminateds[t] | truncateds[t]
