@@ -60,8 +60,11 @@ def main():
     dev = jax.devices()[0]
     on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
     if on_tpu:
+        # 406M-param GPT, bf16, flash attention (Pallas), remat per block.
+        # batch 16 keeps the MXU fed (batch 8 left ~2x on the table, r1
+        # verdict); larger batches exceed this chip's compile envelope.
         cfg = GPTConfig(vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16)
-        batch = 8
+        batch = 16
         steps = 10
     else:  # smoke config for CPU-only environments
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128, n_layers=2, n_heads=4)

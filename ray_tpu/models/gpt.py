@@ -35,6 +35,7 @@ class GPTConfig:
     n_heads: int = 12
     dtype: str = "bfloat16"           # activation/compute dtype
     remat: bool = True
+    attn_impl: str = "auto"           # auto|xla|flash|ring (see ops/attention)
 
     # GPT-J-6B shape (reference north star):
     # vocab 50400→50432, seq 2048, d_model 4096, 28 layers, 16 heads
@@ -102,22 +103,49 @@ def _block(cfg: GPTConfig, x, layer, mesh=None):
 
     ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
     qkv = ln1 @ layer["attn_qkv"]["kernel"].astype(dt) + layer["attn_qkv"]["bias"].astype(dt)
-    qkv = c(qkv, P(("dp", "fsdp"), None, "tp"))
+    # seq stays sharded over sp end-to-end (sequence parallelism); sp=1
+    # meshes make these the same constraints as before.
+    qkv = c(qkv, P(("dp", "fsdp"), "sp", "tp"))
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):
         return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
 
-    att = causal_attention(heads(q), heads(k), heads(v))
+    impl = cfg.attn_impl
+    if impl == "ring" or (
+        impl == "auto" and mesh is not None and mesh.shape.get("sp", 1) > 1
+    ):
+        # sequence sharded over sp: ring attention rotates KV over ICI
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        att = ring_attention_sharded(heads(q), heads(k), heads(v), mesh)
+    elif (
+        impl in ("auto", "flash")
+        and mesh is not None
+        and mesh.size > 1
+        and s >= 128
+        and s % 128 == 0
+    ):
+        # multi-device pjit: shard_map the Pallas kernel so it runs on each
+        # chip's dp/tp shard instead of being replicated (no GSPMD rule for
+        # a bare pallas_call)
+        from ray_tpu.ops.flash_attention import flash_attention_sharded
+
+        try:
+            att = flash_attention_sharded(heads(q), heads(k), heads(v), mesh)
+        except ValueError:  # shapes don't divide the mesh — XLA partitions fine
+            att = causal_attention(heads(q), heads(k), heads(v), impl="xla")
+    else:
+        att = causal_attention(heads(q), heads(k), heads(v), impl=impl)
     att = att.transpose(0, 2, 1, 3).reshape(b, s, d)
     att = att @ layer["attn_out"]["kernel"].astype(dt) + layer["attn_out"]["bias"].astype(dt)
-    x = x + c(att, P(("dp", "fsdp"), None, None))
+    x = x + c(att, P(("dp", "fsdp"), "sp", None))
 
     ln2 = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
     hmid = jax.nn.gelu(ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt))
-    hmid = c(hmid, P(("dp", "fsdp"), None, "tp"))
+    hmid = c(hmid, P(("dp", "fsdp"), "sp", "tp"))
     out = hmid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
-    return x + c(out, P(("dp", "fsdp"), None, None))
+    return x + c(out, P(("dp", "fsdp"), "sp", None))
 
 
 def gpt_forward(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:

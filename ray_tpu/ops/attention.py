@@ -1,11 +1,19 @@
-"""Causal multi-head attention.
+"""Causal multi-head attention — impl dispatcher.
 
-Baseline path is pure XLA (einsum + online softmax is fused well by the TPU
-compiler for moderate sequence lengths); a Pallas flash-attention kernel and
-the ring-attention sequence-parallel variant plug in behind the same
-signature. Reference framework has no attention op of its own (compute is
-user torch code); this is part of the "long-context first-class" mandate
-(SURVEY.md §5.7).
+Three interchangeable paths behind one signature (the reference framework has
+no attention op of its own — compute is user torch code; this is part of the
+"long-context first-class" mandate, SURVEY.md §5.7):
+
+* ``xla``   — einsum + masked softmax; fine for short sequences, O(seq²)
+  memory (the mask/score matrix materializes).
+* ``flash`` — Pallas blockwise online-softmax kernel with custom-VJP
+  backward (``ops/flash_attention.py``); O(seq) memory, MXU-dense.
+* ``ring``  — sequence-parallel flash over the ``sp`` mesh axis
+  (``ops/ring_attention.py``), selected by the model layer when the mesh
+  shards sequence.
+
+``auto`` picks flash whenever the shape tiles cleanly (TPU: always for the
+model shapes here; other backends run the same kernels interpreted).
 """
 
 from __future__ import annotations
@@ -14,12 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """q,k,v: (batch, heads, seq, head_dim) → (batch, heads, seq, head_dim).
-
-    Computed in bf16 with fp32 softmax accumulation (MXU-friendly); the causal
-    mask is applied as an additive bias so XLA keeps one fused loop.
-    """
+def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     *_, seq, head_dim = q.shape
     scale = 1.0 / (head_dim**0.5)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -28,3 +31,21 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "auto"
+) -> jax.Array:
+    """q,k,v: (batch, heads, seq, head_dim) → (batch, heads, seq, head_dim).
+
+    bf16-friendly with fp32 softmax accumulation on every path.
+    """
+    if impl == "xla":
+        return _xla_attention(q, k, v)
+    seq = q.shape[2]
+    if impl == "auto" and (seq < 128 or seq % 128):
+        # too small/ragged to tile the Pallas grid — XLA fuses these fine
+        return _xla_attention(q, k, v)
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v)

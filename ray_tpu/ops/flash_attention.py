@@ -1,0 +1,313 @@
+"""Pallas TPU flash attention (causal) with a full custom-VJP backward.
+
+The blockwise online-softmax formulation (Flash Attention 2) — no (seq, seq)
+score matrix ever reaches HBM, so memory is O(seq) and the MXU stays fed from
+VMEM. Forward saves only out + logsumexp per row; backward recomputes scores
+blockwise with two kernels (dQ, then dK/dV). All accumulation fp32, inputs
+bf16/fp32.
+
+TPU tiling notes: the logsumexp rows live as ``(bh, 8, seq)`` — value
+broadcast over 8 sublanes so the (sublane, lane) block shape ``(8, block_q)``
+satisfies Mosaic's (8, 128) fp32 tile constraint; backward consumes the
+single meaningful sublane as ``(bh, 1, seq)`` full-dim blocks. Sequence
+lengths must tile by 128 on the TPU path (the public entry falls back to the
+XLA implementation otherwise).
+
+This is the hot op behind ``ray_tpu.ops.attention.causal_attention`` — the
+reference has no attention kernel of its own (user torch code runs inside
+``train_loop_per_worker``); SURVEY.md §5.7 makes long-context attention a
+first-class mandate for the TPU build. On non-TPU backends the same kernels
+run under ``interpret=True`` so CI (virtual CPU mesh) exercises identical
+code paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k):
+    """One (bh, q-block) cell: online softmax over causal kv blocks."""
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    q_start = qi * block_q
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    # only kv blocks at-or-before the diagonal contribute
+    num_kv = (q_start + block_q + block_k - 1) // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l)  # (BQ,)
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
+
+
+def _flash_fwd(q, k, v, *, block_q, block_k):
+    bh, seq, d = q.shape
+    scale = 1.0 / (d**0.5)
+    grid = (bh, seq // block_q)
+    out, lse8 = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, seq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse8[:, :1, :]  # (bh, 1, seq)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]      # (BQ,)
+    delta = delta_ref[0, 0]  # (BQ,)
+    q_start = qi * block_q
+    num_kv = (q_start + block_q + block_k - 1) // block_k
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(cols <= rows, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, seq_len
+):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_start = ki * block_k
+    num_q = seq_len // block_q
+    first_q = k_start // block_q  # earliest q block the diagonal touches
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(cols <= rows, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, *, block_q, block_k):
+    bh, seq, d = q.shape
+    scale = 1.0 / (d**0.5)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # (bh, seq)
+    delta = delta[:, None, :]  # (bh, 1, seq) — full-dim minor blocks tile fine
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block_k),
+        grid=(bh, seq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, seq_len=seq),
+        grid=(bh, seq // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+def _pick_blocks(seq: int, block_q: int, block_k: int) -> tuple[int, int]:
+    bq = min(block_q, seq)
+    bk = min(block_k, seq)
+    while seq % bq:
+        bq //= 2
+    while seq % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_core_fwd(q, k, v, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, block_q=block_q, block_k=block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, block_q: int = 256, block_k: int = 512
+) -> jax.Array:
+    """Causal flash attention. q,k,v: (batch, heads, seq, head_dim).
+
+    O(seq) memory; differentiable (custom VJP with blockwise-recompute
+    backward). On TPU, seq must tile by 128 (Mosaic lane constraint) — falls
+    back to the XLA path otherwise; interpret mode (CPU CI) accepts any
+    power-of-two-friendly blocking.
+    """
+    b, h, s, d = q.shape
+    bq, bk = _pick_blocks(s, block_q, block_k)
+    if not _interpret() and (bq % 128 or bk % 128):
+        from ray_tpu.ops.attention import _xla_attention
+
+        return _xla_attention(q, k, v)
+    merge = lambda t: t.reshape(b * h, s, d)  # noqa: E731
+    out = _flash_core(merge(q), merge(k), merge(v), bq, bk)
+    return out.reshape(b, h, s, d)
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh) -> jax.Array:
+    """Flash attention inside a dp/fsdp/tp-sharded pjit program.
+
+    A bare ``pallas_call`` has no GSPMD partitioning rule, so calling
+    ``flash_attention`` directly under a multi-device pjit makes XLA
+    all-gather q/k/v and replicate the kernel on every chip. This wrapper
+    shard_maps it — batch over (dp, fsdp), heads over tp, seq/head_dim local
+    — so each chip runs the kernel on exactly its shard (attention has no
+    cross-batch/cross-head communication). Falls back to the caller's XLA
+    path via ValueError when shapes don't divide the mesh.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    b, h, s, d = q.shape
+    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    tp = mesh.shape.get("tp", 1)
+    if b % dp or h % tp:
+        raise ValueError(f"batch {b} / heads {h} don't divide mesh axes dp*fsdp={dp}, tp={tp}")
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = jax.shard_map(
+        flash_attention, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
