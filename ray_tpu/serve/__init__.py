@@ -1,0 +1,25 @@
+"""ray_tpu.serve: online model serving on the task/actor runtime.
+
+Reference: ``python/ray/serve/`` (65.8k LoC) — the capability surface here:
+``@serve.deployment`` + ``.bind()`` + ``serve.run`` (api.py), controller
+reconciliation into replica actors (controller.py / replica.py), handle-side
+power-of-two-choices routing (handle.py), ``@serve.batch`` coalescing
+(batching.py — the TPU-critical piece: concurrent requests meet the jitted
+model as ONE batch), queue-depth autoscaling, composition via handles, and
+an HTTP JSON ingress (proxy.py).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve._private.common import AutoscalingConfig  # noqa: F401

@@ -1,0 +1,57 @@
+"""Serve config/data types.
+
+Reference: ``python/ray/serve/config.py`` (DeploymentConfig pydantic schemas)
+and ``serve/_private/common.py`` (DeploymentID, ReplicaState). Plain
+dataclasses here — configs travel through actor boundaries constantly, so
+they stay cheap to pickle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: ``serve/config.py`` AutoscalingConfig /
+    ``_private/autoscaling_policy.py`` (decisions from ongoing-request
+    telemetry vs a per-replica target)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 1.0
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DeploymentSpec:
+    """What the controller needs to materialize one deployment."""
+
+    name: str
+    app_name: str
+    callable_factory: Any      # cloudpickled zero-arg factory -> user callable
+    init_args: tuple = ()
+    init_kwargs: dict = dataclasses.field(default_factory=dict)
+    config: DeploymentConfig = dataclasses.field(default_factory=DeploymentConfig)
+    is_ingress: bool = False
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    replica_id: str
+    actor: Any                 # ray_tpu actor handle
+    healthy: bool = True

@@ -1,0 +1,297 @@
+"""ServeController: the control-plane actor reconciling target deployment
+state into replica actors.
+
+Reference: ``serve/_private/controller.py:91`` (ServeController run loop),
+``_private/deployment_state.py:1221`` (DeploymentState reconciliation:
+target replicas vs running, starting/stopping), ``autoscaling_policy.py``
+(ongoing-request-driven replica counts). One controller actor per cluster
+(named actor ``SERVE_CONTROLLER``); a background reconcile thread diffs
+target vs actual every ``RECONCILE_PERIOD_S``, restarts dead replicas,
+applies autoscaling decisions from replica metrics, and bumps a version
+counter that handle-side routers long-poll to refresh their replica sets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ray_tpu.serve._private.common import (
+    AutoscalingConfig,
+    DeploymentSpec,
+    ReplicaInfo,
+)
+
+RECONCILE_PERIOD_S = 0.25
+
+
+class _DeploymentState:
+    def __init__(self, spec: DeploymentSpec):
+        self.spec = spec
+        self.replicas: list[ReplicaInfo] = []
+        self.target_replicas = spec.config.num_replicas
+        if spec.config.autoscaling_config:
+            self.target_replicas = max(
+                spec.config.autoscaling_config.min_replicas, 1
+            )
+        # autoscaling bookkeeping
+        self._scale_pressure_since: Optional[float] = None
+        self._scale_direction = 0
+
+
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._apps: dict[str, list[str]] = {}   # app -> deployment names
+        self._ingress: dict[str, str] = {}      # app -> ingress deployment
+        self._version = 0
+        self._proxy = None
+        self._shutdown = False
+        self._reconciler = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._reconciler.start()
+
+    # -- deploy API --------------------------------------------------------
+
+    def deploy_application(self, app_name: str, specs: list[DeploymentSpec]) -> bool:
+        """Set target state for an app (idempotent; re-deploy replaces)."""
+        with self._lock:
+            old = self._apps.get(app_name, [])
+            new_names = {s.name for s in specs}
+            for name in old:
+                if name not in new_names:
+                    self._stop_deployment(name)
+            self._apps[app_name] = [s.name for s in specs]
+            for spec in specs:
+                existing = self._deployments.get(spec.name)
+                if existing is not None:
+                    existing.spec = spec
+                    if spec.config.autoscaling_config is None:
+                        existing.target_replicas = spec.config.num_replicas
+                    for r in existing.replicas:  # push new user_config live
+                        if spec.config.user_config is not None:
+                            r.actor.reconfigure.remote(spec.config.user_config)
+                else:
+                    self._deployments[spec.name] = _DeploymentState(spec)
+                if spec.is_ingress:
+                    self._ingress[app_name] = spec.name
+            self._version += 1
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            for name in self._apps.pop(app_name, []):
+                self._stop_deployment(name)
+            self._ingress.pop(app_name, None)
+            self._version += 1
+        return True
+
+    def _stop_deployment(self, name: str):
+        state = self._deployments.pop(name, None)
+        if state is None:
+            return
+        import ray_tpu
+
+        for r in state.replicas:
+            try:
+                ray_tpu.kill(r.actor)
+            except Exception:
+                pass
+
+    # -- queries (handles / proxy / status) --------------------------------
+
+    def get_replicas(self, deployment_name: str) -> tuple[int, list, int]:
+        """(version, [actor handles], max_ongoing) — routers cache and
+        re-pull on change; max_ongoing is the per-replica admission cap."""
+        with self._lock:
+            state = self._deployments.get(deployment_name)
+            if state is None:
+                return self._version, [], 1
+            return (
+                self._version,
+                [r.actor for r in state.replicas if r.healthy],
+                max(state.spec.config.max_ongoing_requests, 1),
+            )
+
+    def get_version(self) -> int:
+        return self._version
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            return self._ingress.get(app_name)
+
+    def list_apps(self) -> dict:
+        with self._lock:
+            return {app: list(names) for app, names in self._apps.items()}
+
+    def get_deployment_status(self, name: str) -> dict:
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return {"exists": False}
+            return {
+                "exists": True,
+                "target_replicas": state.target_replicas,
+                "running_replicas": len([r for r in state.replicas if r.healthy]),
+                "replica_ids": [r.replica_id for r in state.replicas],
+            }
+
+    def ready(self) -> bool:
+        """True once every deployment has its target replica count healthy."""
+        with self._lock:
+            return all(
+                len([r for r in s.replicas if r.healthy]) >= s.target_replicas
+                for s in self._deployments.values()
+            )
+
+    # -- HTTP proxy --------------------------------------------------------
+
+    def ensure_proxy(self, port: int) -> int:
+        with self._lock:
+            if self._proxy is None:
+                import ray_tpu
+                from ray_tpu.serve._private.proxy import ProxyActor
+
+                cls = ray_tpu.remote(ProxyActor)
+                self._proxy = cls.options(max_concurrency=128).remote(port)
+                self._proxy_port = ray_tpu.get(self._proxy.ready.remote())
+            return self._proxy_port
+
+    def get_proxy_port(self) -> Optional[int]:
+        with self._lock:
+            return getattr(self, "_proxy_port", None)
+
+    # -- reconciliation ----------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self):
+        import ray_tpu
+
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            self._autoscale(state)
+            with self._lock:
+                spec = state.spec
+                # health-check existing replicas (cheap ping with timeout)
+                for r in state.replicas:
+                    try:
+                        ray_tpu.get(r.actor.check_health.remote(), timeout=5.0)
+                    except Exception:
+                        r.healthy = False
+                dead = [r for r in state.replicas if not r.healthy]
+                if dead:
+                    state.replicas = [r for r in state.replicas if r.healthy]
+                    self._version += 1
+                # start missing
+                missing = state.target_replicas - len(state.replicas)
+                for _ in range(max(0, missing)):
+                    self._start_replica(state)
+                    self._version += 1
+                # stop excess (highest-index first)
+                excess = len(state.replicas) - state.target_replicas
+                for _ in range(max(0, excess)):
+                    victim = state.replicas.pop()
+                    try:
+                        ray_tpu.kill(victim.actor)
+                    except Exception:
+                        pass
+                    self._version += 1
+
+    def _start_replica(self, state: _DeploymentState):
+        import ray_tpu
+        from ray_tpu.serve._private.replica import Replica
+
+        spec = state.spec
+        rid = f"{spec.name}#{uuid.uuid4().hex[:6]}"
+        cls = ray_tpu.remote(Replica)
+        opts = dict(spec.config.ray_actor_options)
+        # +2 headroom threads so control-plane RPCs (health, metrics,
+        # reconfigure) never starve behind a saturated request queue; the
+        # router enforces the actual max_ongoing_requests admission limit.
+        opts["max_concurrency"] = max(spec.config.max_ongoing_requests, 1) + 2
+        actor = cls.options(**opts).remote(
+            rid,
+            spec.callable_factory,
+            spec.init_args,
+            spec.init_kwargs,
+            spec.config.user_config,
+        )
+        state.replicas.append(ReplicaInfo(replica_id=rid, actor=actor))
+
+    # -- autoscaling -------------------------------------------------------
+
+    def _autoscale(self, state: _DeploymentState):
+        import ray_tpu
+
+        cfg: Optional[AutoscalingConfig] = state.spec.config.autoscaling_config
+        if cfg is None:
+            return
+        with self._lock:
+            replicas = [r for r in state.replicas if r.healthy]
+        if not replicas:
+            return
+        total_ongoing = 0
+        for r in replicas:
+            try:
+                m = ray_tpu.get(r.actor.get_metrics.remote(), timeout=5.0)
+                total_ongoing += m["num_ongoing_requests"]
+            except Exception:
+                pass
+        desired = max(
+            cfg.min_replicas,
+            min(
+                cfg.max_replicas,
+                -(-int(total_ongoing) // max(int(cfg.target_ongoing_requests), 1)) or cfg.min_replicas,
+            ),
+        )
+        now = time.time()
+        with self._lock:
+            current = state.target_replicas
+            direction = (desired > current) - (desired < current)
+            if direction == 0:
+                state._scale_pressure_since = None
+                state._scale_direction = 0
+                return
+            if state._scale_direction != direction:
+                state._scale_direction = direction
+                state._scale_pressure_since = now
+                return
+            delay = cfg.upscale_delay_s if direction > 0 else cfg.downscale_delay_s
+            if now - (state._scale_pressure_since or now) >= delay:
+                state.target_replicas = desired
+                state._scale_pressure_since = None
+                state._scale_direction = 0
+
+    # -- shutdown ----------------------------------------------------------
+
+    def shutdown(self) -> bool:
+        import ray_tpu
+
+        with self._lock:
+            self._shutdown = True
+            for app in list(self._apps):
+                for name in self._apps[app]:
+                    self._stop_deployment(name)
+            self._apps.clear()
+            if self._proxy is not None:
+                try:
+                    ray_tpu.get(self._proxy.stop.remote(), timeout=5)
+                    ray_tpu.kill(self._proxy)
+                except Exception:
+                    pass
+                self._proxy = None
+        return True
+
+    def check_health(self) -> bool:
+        return True

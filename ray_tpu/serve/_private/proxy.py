@@ -1,0 +1,89 @@
+"""HTTP ingress proxy.
+
+Reference: ``serve/_private/proxy.py:1115`` (ProxyActor per node wrapping an
+HTTP server that resolves routes to app ingress deployments and awaits the
+handle response). stdlib ``ThreadingHTTPServer`` here — one thread per
+in-flight request, each blocking on its DeploymentResponse; JSON in/out.
+
+Routes: ``POST/GET /<app_name>`` → the app's ingress deployment. Body (JSON)
+becomes the request payload: the ingress callable is invoked as
+``__call__(payload)``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+
+class ProxyActor:
+    def __init__(self, port: int):
+        self.port = port
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self):
+                try:
+                    app = self.path.strip("/").split("/")[0] or "default"
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(length)) if length else None
+                    result = proxy._route(app, payload)
+                    body = json.dumps(result).encode()
+                    self.send_response(200)
+                except KeyError as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(404)
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            request_queue_size = 256  # default 5 resets bursty clients
+
+        self._server = _Server(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]  # resolves port=0
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self._handles: dict[str, object] = {}
+
+    def _route(self, app: str, payload):
+        import ray_tpu
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = self._handles.get(app)
+        if handle is None:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            ingress = ray_tpu.get(controller.get_ingress.remote(app), timeout=30)
+            if ingress is None:
+                raise KeyError(f"no app {app!r}")
+            handle = DeploymentHandle(ingress)
+            self._handles[app] = handle
+        return handle.remote(payload).result(timeout=60)
+
+    def ready(self) -> int:
+        return self.port
+
+    def get_port(self) -> int:
+        return self.port
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        return True
+
+    def check_health(self) -> bool:
+        return True

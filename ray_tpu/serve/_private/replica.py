@@ -1,0 +1,71 @@
+"""Replica: the actor that hosts one copy of a deployment's user callable.
+
+Reference: ``serve/_private/replica.py:233`` (ReplicaActor wraps the user
+callable via UserCallableWrapper, tracks ongoing requests, exposes
+reconfigure/health hooks). TPU-first notes: a replica is the natural unit
+that owns a jitted model — concurrent requests enter on the actor's thread
+pool (``max_concurrency = max_ongoing_requests``) and meet the model through
+``@serve.batch`` so the MXU sees one large batched call instead of N
+singles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class Replica:
+    """Actor body. Spawned by the controller with
+    ``max_concurrency=max_ongoing_requests`` so requests execute in parallel
+    threads up to the configured limit."""
+
+    def __init__(self, replica_id: str, callable_cls, init_args, init_kwargs, user_config=None):
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        # always a class — function deployments are wrapped by the api layer
+        self._callable = callable_cls(*init_args, **init_kwargs)
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- request path ------------------------------------------------------
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._callable if method == "__call__" else getattr(self._callable, method)
+            if method == "__call__" and not callable(target):
+                raise TypeError(f"Deployment {type(self._callable).__name__} is not callable")
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane -----------------------------------------------------
+
+    def reconfigure(self, user_config) -> bool:
+        """Reference: replicas forward user_config updates to the user
+        class's ``reconfigure`` method without a restart."""
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def get_metrics(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "num_ongoing_requests": self._ongoing,
+            "num_total_requests": self._total,
+            "timestamp": time.time(),
+        }
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True

@@ -1,0 +1,236 @@
+"""Serve public API: ``@serve.deployment``, ``bind``, ``serve.run``.
+
+Reference: ``python/ray/serve/api.py:246`` (deployment decorator), ``:439``
+(serve.run). An ``Application`` is a bound deployment graph — ``.bind()``
+arguments may themselves be Applications, and ``serve.run`` materializes the
+graph bottom-up, injecting DeploymentHandles where child apps appear
+(model-composition, reference ``serve/handle.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve._private.common import (
+    CONTROLLER_NAME,
+    AutoscalingConfig,
+    DeploymentConfig,
+    DeploymentSpec,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+DEFAULT_HTTP_PORT = 8000
+
+
+def _wrap_function(fn: Callable) -> type:
+    """Function deployments become single-method callables."""
+
+    class _FuncDeployment:
+        def __call__(self, *args, **kwargs):
+            return fn(*args, **kwargs)
+
+    _FuncDeployment.__name__ = getattr(fn, "__name__", "func")
+    return _FuncDeployment
+
+
+@dataclasses.dataclass
+class Deployment:
+    """The decorated (not yet bound) deployment."""
+
+    callable_cls: type
+    name: str
+    config: DeploymentConfig
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, **kwargs) -> "Deployment":
+        new_cfg = dataclasses.replace(self.config)
+        name = kwargs.pop("name", self.name)
+        for k, v in kwargs.items():
+            if k == "autoscaling_config" and isinstance(v, dict):
+                v = AutoscalingConfig(**v)
+            if not hasattr(new_cfg, k):
+                raise TypeError(f"Unknown deployment option {k!r}")
+            setattr(new_cfg, k, v)
+        return Deployment(self.callable_cls, name, new_cfg)
+
+
+class Application:
+    """A deployment bound to init args; args may nest other Applications."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(
+    _cls: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[Union[int, str]] = None,
+    max_ongoing_requests: int = 8,
+    user_config: Any = None,
+    autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+    ray_actor_options: Optional[dict] = None,
+    health_check_period_s: float = 1.0,
+) -> Union[Deployment, Callable[..., Deployment]]:
+    """Reference: ``serve/api.py:246``. ``num_replicas="auto"`` enables
+    autoscaling with defaults."""
+
+    def build(target) -> Deployment:
+        cls = target if inspect.isclass(target) else _wrap_function(target)
+        nonlocal autoscaling_config, num_replicas
+        if num_replicas == "auto" and autoscaling_config is None:
+            autoscaling_config = AutoscalingConfig()
+        asc = (
+            AutoscalingConfig(**autoscaling_config)
+            if isinstance(autoscaling_config, dict)
+            else autoscaling_config
+        )
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas if isinstance(num_replicas, int) else 1,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=asc,
+            health_check_period_s=health_check_period_s,
+            ray_actor_options=ray_actor_options or {},
+        )
+        return Deployment(cls, name or getattr(target, "__name__", "deployment"), cfg)
+
+    if _cls is not None:
+        return build(_cls)
+    return build
+
+
+# ---------------------------------------------------------------------------
+# controller lifecycle + run
+# ---------------------------------------------------------------------------
+
+
+def _get_or_start_controller():
+    from ray_tpu.serve._private.controller import ServeController
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        cls = ray_tpu.remote(ServeController)
+        # detached: the controller outlives any one handle (reference:
+        # serve's controller is a detached named actor)
+        controller = cls.options(
+            name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
+            max_concurrency=16,
+        ).remote()
+        ray_tpu.get(controller.check_health.remote(), timeout=60)
+        return controller
+
+
+def _collect_specs(app: Application, app_name: str) -> tuple[list[DeploymentSpec], str]:
+    """DFS the bind graph; nested Applications in args become handles."""
+    specs: dict[int, DeploymentSpec] = {}
+    names_used: dict[str, int] = {}
+
+    def visit(node: Application) -> DeploymentHandle:
+        key = id(node)
+        if key in specs:
+            return DeploymentHandle(specs[key].name)
+        base = node.deployment.name
+        n = names_used.get(base, 0)
+        names_used[base] = n + 1
+        dep_name = f"{app_name}_{base}" if n == 0 else f"{app_name}_{base}_{n}"
+
+        def resolve(v):
+            return visit(v) if isinstance(v, Application) else v
+
+        args = tuple(resolve(a) for a in node.args)
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        specs[key] = DeploymentSpec(
+            name=dep_name,
+            app_name=app_name,
+            callable_factory=node.deployment.callable_cls,
+            init_args=args,
+            init_kwargs=kwargs,
+            config=node.deployment.config,
+        )
+        return DeploymentHandle(dep_name)
+
+    ingress_handle = visit(app)
+    ordered = list(specs.values())
+    # the root (first visited) is the ingress
+    for s in ordered:
+        s.is_ingress = s.name == ingress_handle.deployment_name
+    return ordered, ingress_handle.deployment_name
+
+
+def run(
+    app: Application,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    http: bool = False,
+    http_port: int = DEFAULT_HTTP_PORT,
+    _blocking: bool = True,
+) -> DeploymentHandle:
+    """Deploy an application; returns the ingress DeploymentHandle.
+
+    Reference: ``serve/api.py:439``. ``http=True`` also ensures the HTTP
+    proxy ingress is up (``GET/POST /<name>`` with a JSON body).
+    """
+    import time
+
+    controller = _get_or_start_controller()
+    specs, ingress = _collect_specs(app, name)
+    ray_tpu.get(controller.deploy_application.remote(name, specs), timeout=120)
+    if http:
+        ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
+    if _blocking:
+        deadline = time.time() + 120
+        while not ray_tpu.get(controller.ready.remote(), timeout=30):
+            if time.time() > deadline:
+                raise TimeoutError("Serve application failed to become ready")
+            time.sleep(0.1)
+    return DeploymentHandle(ingress)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ingress = ray_tpu.get(controller.get_ingress.remote(name), timeout=30)
+    if ingress is None:
+        raise KeyError(f"No serve application named {name!r}")
+    return DeploymentHandle(ingress)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(f"{app_name}_{deployment_name}")
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    apps = ray_tpu.get(controller.list_apps.remote(), timeout=30)
+    return {
+        app: {
+            d: ray_tpu.get(controller.get_deployment_status.remote(d), timeout=30)
+            for d in deps
+        }
+        for app, deps in apps.items()
+    }
+
+
+def delete(name: str) -> None:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,124 @@
+"""@serve.batch — opportunistic request batching inside a replica.
+
+Reference: ``python/ray/serve/batching.py`` (_BatchQueue: requests enqueue,
+a loop flushes up to max_batch_size after batch_wait_timeout_s). This is the
+op that makes TPU serving fast: N concurrent single requests entering a
+replica's thread pool coalesce into ONE jitted forward pass, so the MXU sees
+a real batch dimension instead of N matmuls of batch 1.
+
+Threaded implementation (replica concurrency is thread-based here, not
+asyncio): callers enqueue (args, Future) and block on the Future; the first
+waiter becomes the flusher — it waits until the batch fills or the timeout
+lapses, calls the wrapped function ONCE with lists of arguments, and
+distributes results/exceptions.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional
+
+
+_CREATE_LOCK = threading.Lock()
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._queue: list[tuple[Any, Future]] = []
+        self._flusher_active = False
+
+    def submit(self, item: Any) -> Future:
+        fut: Future = Future()
+        flush_now = False
+        with self._lock:
+            self._queue.append((item, fut))
+            if not self._flusher_active:
+                self._flusher_active = True
+                flush_now = True
+        if flush_now:
+            threading.Thread(target=self._flush_loop, daemon=True).start()
+        return fut
+
+    def _flush_loop(self):
+        while True:
+            deadline = time.time() + self.timeout
+            while time.time() < deadline:
+                with self._lock:
+                    if len(self._queue) >= self.max_batch_size:
+                        break
+                time.sleep(min(0.001, self.timeout / 10 or 0.001))
+            with self._lock:
+                batch = self._queue[: self.max_batch_size]
+                self._queue = self._queue[self.max_batch_size :]
+                if not batch:
+                    self._flusher_active = False
+                    return
+            items = [b[0] for b in batch]
+            try:
+                results = self.fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"batched function returned {len(results)} results for "
+                        f"{len(items)} inputs"
+                    )
+                for (_, fut), res in zip(batch, results):
+                    fut.set_result(res)
+            except Exception as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+):
+    """Decorate a method taking a LIST of requests (returning a list of
+    results) so concurrent single-request callers are transparently batched.
+
+    Usage::
+
+        class Model:
+            @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.005)
+            def predict(self, inputs: list) -> list: ...
+
+        # callers invoke predict(single_input) and get a single result
+    """
+
+    def wrap(fn):
+        # The queue hangs off the INSTANCE (created lazily at first call) so
+        # the decorated class stays cloudpickle-able — a closure-held lock or
+        # queue dict would break shipping the deployment to replica actors.
+        attr = f"__serve_batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, item):
+            import ray_tpu.serve.batching as _b
+
+            q = getattr(self, attr, None)
+            if q is None:
+                with _b._CREATE_LOCK:
+                    q = getattr(self, attr, None)
+                    if q is None:
+                        q = _BatchQueue(
+                            lambda items: fn(self, items),
+                            max_batch_size,
+                            batch_wait_timeout_s,
+                        )
+                        setattr(self, attr, q)
+            return q.submit(item).result()
+
+        wrapper._is_serve_batch = True  # noqa: SLF001
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

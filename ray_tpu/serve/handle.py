@@ -1,0 +1,210 @@
+"""DeploymentHandle: the client-side request path.
+
+Reference: ``serve/handle.py:830`` (DeploymentHandle / DeploymentResponse),
+``_private/router.py:36,326`` (Router.assign_request) and
+``_private/replica_scheduler/pow_2_scheduler.py:44`` (power-of-two-choices:
+sample two replicas, pick the one with the shorter queue). The router keeps
+a local in-flight count per replica (updated at submit/complete) and
+refreshes its replica set from the controller when the controller's version
+counter moves — the long-poll-lite equivalent of the reference's
+LongPollHost.
+
+Handles pickle cleanly (they carry only the deployment name): deployment
+composition passes handles through replica init args, and any process that
+can reach the named controller actor can route.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef.
+
+    If the backing replica died before producing a result, ``result()``
+    re-routes the request once through a fresh replica (the reference
+    router's retry-on-replica-failure semantics).
+    """
+
+    def __init__(self, ref, router: "_Router", replica_idx: int, retry=None):
+        self._ref = ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._retry = retry  # zero-arg callable re-submitting the request
+        self._done = False
+
+    MAX_RETRIES = 2
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        import ray_tpu
+        from ray_tpu.exceptions import RayActorError
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except RayActorError:
+            self._settle()
+            self._router.drop()
+            if self._retry is None:
+                raise  # retry budget exhausted — surface the failure
+            return self._retry().result(timeout)
+        finally:
+            self._settle()
+
+    def _to_object_ref(self):
+        """Pass-through so responses can feed other task/actor calls."""
+        self._settle()
+        return self._ref
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router._complete(self._replica_idx)
+
+
+class _Router:
+    """Per-handle replica set + pow-2 picker."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._inflight: list[int] = []
+        self._max_ongoing = 1
+        self._version = -1
+        self._last_refresh = 0.0
+
+    def _controller(self):
+        import ray_tpu
+
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+
+        now = time.time()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < 0.5:
+                return
+        version, replicas, max_ongoing = ray_tpu.get(
+            self._controller().get_replicas.remote(self.deployment_name), timeout=30
+        )
+        with self._lock:
+            self._last_refresh = now
+            self._max_ongoing = max_ongoing
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = [0] * len(replicas)
+
+    def pick(self) -> tuple[Any, int]:
+        """Power-of-two-choices over local in-flight counts, honoring the
+        per-replica max_ongoing_requests admission cap (backpressure —
+        reference: pow_2_scheduler queue-length caps)."""
+        deadline = time.time() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                n = len(self._replicas)
+                if n:
+                    if n == 1:
+                        idx = 0
+                    else:
+                        i, j = random.sample(range(n), 2)
+                        idx = i if self._inflight[i] <= self._inflight[j] else j
+                    if self._inflight[idx] < self._max_ongoing:
+                        self._inflight[idx] += 1
+                        return self._replicas[idx], idx
+                    # chosen replica at capacity: try the global minimum
+                    idx = min(range(n), key=self._inflight.__getitem__)
+                    if self._inflight[idx] < self._max_ongoing:
+                        self._inflight[idx] += 1
+                        return self._replicas[idx], idx
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"No replica capacity for deployment {self.deployment_name!r}"
+                )
+            time.sleep(0.02)
+
+    def _complete(self, idx: int):
+        with self._lock:
+            if 0 <= idx < len(self._inflight) and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    def drop(self):
+        """Force-refresh after a replica failure."""
+        with self._lock:
+            self._version = -1
+            self._replicas = []
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._remote(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._router: Optional[_Router] = None
+
+    # picklability: the router (with live actor handles) stays local
+    def __getstate__(self):
+        return {"deployment_name": self.deployment_name}
+
+    def __setstate__(self, state):
+        self.deployment_name = state["deployment_name"]
+        self._router = None
+
+    def _get_router(self) -> _Router:
+        if self._router is None:
+            self._router = _Router(self.deployment_name)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._remote("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_") or name in ("deployment_name",):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def _remote(
+        self, method: str, args: tuple, kwargs: dict, _retries: Optional[int] = None
+    ) -> DeploymentResponse:
+        from ray_tpu.exceptions import RayActorError
+
+        if _retries is None:
+            _retries = DeploymentResponse.MAX_RETRIES
+        router = self._get_router()
+        # unwrap nested responses so composition chains pass values not refs
+        args = tuple(a.result() if isinstance(a, DeploymentResponse) else a for a in args)
+        kwargs = {
+            k: (v.result() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        # bounded budget: a request that kills every replica it touches must
+        # eventually surface its RayActorError, not loop forever
+        retry = (
+            (lambda: self._remote(method, args, kwargs, _retries - 1))
+            if _retries > 0
+            else None
+        )
+        for attempt in range(3):
+            replica, idx = router.pick()
+            try:
+                ref = replica.handle_request.remote(method, args, kwargs)
+                return DeploymentResponse(ref, router, idx, retry=retry)
+            except RayActorError:
+                router._complete(idx)
+                router.drop()
+        raise RuntimeError(f"Could not submit to deployment {self.deployment_name!r}")
