@@ -128,6 +128,7 @@ class WorkerHandle:
 class NodeState:
     def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
         self.node_id = node_id
+        self.created_at = time.monotonic()
         self.resources_total = dict(resources)
         self.resources_avail = dict(resources)
         self.labels = labels or {}
@@ -263,6 +264,12 @@ class Head:
                 conn = self._listener.accept()
             except (OSError, EOFError):
                 return
+            except Exception:
+                # A client that died mid-handshake (AuthenticationError) or
+                # sent garbage must not kill the accept loop — that would
+                # silently stop ALL future worker registration. Drop the
+                # connection and keep accepting.
+                continue
             t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             t.start()
 
@@ -1405,6 +1412,65 @@ class Head:
     def rpc_task_events(self):
         with self.lock:
             return list(self.task_events)
+
+    def rpc_autoscaler_demand(self):
+        """Autoscaler feed: unplaceable resource demand + per-node load.
+
+        Reference: the GCS load report consumed by
+        ``autoscaler/_private/autoscaler.py:373`` (resource_demand_scheduler
+        bin-packs pending shapes against node types).
+        """
+        with self.lock:
+            demand = [dict(rec["spec"].get("resources") or {}) for rec in self.pending_sched]
+            # actor creations waiting for resources count too
+            for a in self.actors.values():
+                if a.state == ACTOR_PENDING and a.worker is None:
+                    demand.append(dict(a.create_spec.get("resources") or {}))
+            nodes = []
+            now = time.monotonic()
+            for n in self.nodes.values():
+                busy = bool(n.assigned) or any(
+                    w.current_task is not None or w.actor_id is not None
+                    for w in n.all_workers
+                )
+                idle_s = 0.0
+                if not busy:
+                    # a node with no workers yet is "idle since registration",
+                    # never infinitely idle (workers spawn lazily on first
+                    # task — inf would get fresh nodes reaped instantly)
+                    last = max(
+                        (w.idle_since for w in n.all_workers), default=n.created_at
+                    )
+                    idle_s = now - last
+                nodes.append(
+                    {
+                        "node_id": n.node_id.hex(),
+                        "alive": n.alive,
+                        "resources_total": dict(n.resources_total),
+                        "resources_available": dict(n.resources_avail),
+                        "busy": busy,
+                        "idle_s": idle_s,
+                        "labels": dict(n.labels),
+                    }
+                )
+            return {"pending_demand": demand, "nodes": nodes}
+
+    def rpc_list_placement_groups(self):
+        with self.lock:
+            names = {0: "PENDING", 1: "CREATED", 2: "REMOVED"}
+            return [
+                {
+                    "placement_group_id": pg.pg_id.hex(),
+                    "name": pg.name,
+                    "strategy": pg.strategy,
+                    "state": names.get(pg.state, str(pg.state)),
+                    "bundles": list(pg.bundles),
+                    "bundle_nodes": [
+                        n.hex() if n is not None else None for n in pg.bundle_nodes
+                    ],
+                }
+                for pg in self.placement_groups.values()
+            ]
 
     # -------------------------------------------------------------- shutdown
 

@@ -1,0 +1,264 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: ``python/ray/util/metrics.py`` (the user-facing wrappers over the
+C++ OpenCensus stats pipeline, ``src/ray/stats/metric_defs.cc``). TPU-first
+shape: no per-node metrics agent daemon — each process records locally and a
+daemon flusher publishes aggregated snapshots into the head's KV store under
+``__metrics__/<process-tag>``; ``collect()`` merges all snapshots, giving
+every driver/worker a cluster-wide view through the control plane that
+already exists. ``prometheus_text()`` renders the standard exposition format
+for scraping.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Optional, Sequence
+
+_FLUSH_INTERVAL_S = 2.0
+_KV_PREFIX = "__metrics__/"
+
+_registry_lock = threading.Lock()
+_registry: list["Metric"] = []
+_flusher_started = False
+
+
+def _tag_key(tags: Optional[dict]) -> str:
+    if not tags:
+        return ""
+    return json.dumps(dict(sorted(tags.items())), separators=(",", ":"))
+
+
+class Metric:
+    """Base: named, tagged, locally aggregated."""
+
+    kind = "metric"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name or any(c in name for c in " /"):
+            raise ValueError(f"Invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        self._data: dict[str, float | list] = defaultdict(float)
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[dict]) -> str:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"Unknown tag(s) {sorted(extra)} for metric {self.name!r}")
+        return _tag_key(merged)
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            snap = {
+                "name": self.name,
+                "kind": self.kind,
+                "description": self.description,
+                "data": {k: v for k, v in self._data.items()},
+            }
+            bounds = getattr(self, "boundaries", None)
+            if bounds is not None:
+                snap["boundaries"] = list(bounds)
+            return snap
+
+
+class Counter(Metric):
+    """Monotonically increasing count (reference: util/metrics.py Counter)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires a non-negative value")
+        key = self._tags(tags)
+        with self._lock:
+            self._data[key] += value
+
+
+class Gauge(Metric):
+    """Last-value-wins measurement."""
+
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        key = self._tags(tags)
+        with self._lock:
+            self._data[key] = float(value)
+
+
+DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+
+class Histogram(Metric):
+    """Bucketed distribution; records per-bucket counts + sum + count."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = DEFAULT_BOUNDARIES,
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        key = self._tags(tags)
+        with self._lock:
+            cur = self._data.get(key)
+            if not isinstance(cur, list):
+                cur = [0] * (len(self.boundaries) + 1) + [0.0, 0]  # buckets+sum+count
+                self._data[key] = cur
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            cur[idx] += 1
+            cur[-2] += value
+            cur[-1] += 1
+
+    record = observe  # reference alias
+
+
+# ---------------------------------------------------------------------------
+# publication + collection
+# ---------------------------------------------------------------------------
+
+
+def _process_tag() -> str:
+    return f"pid-{os.getpid()}"
+
+
+def flush() -> None:
+    """Publish this process's metric snapshots into the head KV."""
+    from ray_tpu._private.runtime import get_ctx
+
+    try:
+        ctx = get_ctx()
+    except Exception:
+        return  # not initialized (yet/anymore) — metrics are best-effort
+    with _registry_lock:
+        snaps = [m._snapshot() for m in _registry]
+    if not snaps:
+        return
+    try:
+        ctx.call(
+            "kv_put",
+            key=_KV_PREFIX + _process_tag(),
+            value=json.dumps({"time": time.time(), "metrics": snaps}).encode(),
+        )
+    except Exception:
+        pass  # head gone (shutdown) — metrics are best-effort
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            flush()
+
+    threading.Thread(target=loop, daemon=True, name="metrics-flusher").start()
+    atexit.register(flush)
+
+
+def collect() -> dict:
+    """Cluster-wide merged view: {metric_name: {tagset: value-or-histogram}}.
+
+    Counters/histograms sum across processes; gauges last-write-wins by
+    publish time.
+    """
+    from ray_tpu._private.runtime import get_ctx
+
+    flush()
+    try:
+        ctx = get_ctx()
+    except Exception:
+        return {}
+    keys = ctx.call("kv_keys", prefix=_KV_PREFIX)
+    snapshots = []
+    for key in keys:
+        raw = ctx.call("kv_get", key=key)
+        if raw:
+            snapshots.append(json.loads(raw.decode()))
+    snapshots.sort(key=lambda s: s["time"])
+    merged: dict[str, dict] = {}
+    kinds: dict[str, str] = {}
+    boundaries: dict[str, list] = {}
+    for snap in snapshots:
+        for m in snap["metrics"]:
+            name, kind = m["name"], m["kind"]
+            kinds[name] = kind
+            if "boundaries" in m:
+                boundaries[name] = m["boundaries"]
+            out = merged.setdefault(name, {})
+            for tagset, val in m["data"].items():
+                if kind == "gauge":
+                    out[tagset] = val
+                elif kind == "counter":
+                    out[tagset] = out.get(tagset, 0.0) + val
+                else:  # histogram: elementwise sum
+                    prev = out.get(tagset)
+                    out[tagset] = (
+                        [a + b for a, b in zip(prev, val)] if prev else list(val)
+                    )
+    return {"kinds": kinds, "metrics": merged, "boundaries": boundaries}
+
+
+def prometheus_text() -> str:
+    """Render collect() in the Prometheus exposition format (histograms as
+    cumulative ``_bucket{le=...}`` series + ``_sum``/``_count``)."""
+    data = collect()
+    lines = []
+    for name, series in data.get("metrics", {}).items():
+        kind = data["kinds"].get(name, "counter")
+        prom_kind = {"gauge": "gauge", "histogram": "histogram"}.get(kind, "counter")
+        lines.append(f"# TYPE ray_tpu_{name} {prom_kind}")
+        bounds = data.get("boundaries", {}).get(name, [])
+        for tagset, val in series.items():
+            tags = json.loads(tagset) if tagset else {}
+
+            def fmt(extra=None):
+                merged_tags = dict(tags)
+                if extra:
+                    merged_tags.update(extra)
+                if not merged_tags:
+                    return ""
+                return "{" + ",".join(f'{k}="{v}"' for k, v in merged_tags.items()) + "}"
+
+            if isinstance(val, list):
+                cum = 0
+                for b, count in zip(bounds, val):
+                    cum += count
+                    lines.append(f'ray_tpu_{name}_bucket{fmt({"le": b})} {cum}')
+                lines.append(f'ray_tpu_{name}_bucket{fmt({"le": "+Inf"})} {val[-1]}')
+                lines.append(f"ray_tpu_{name}_sum{fmt()} {val[-2]}")
+                lines.append(f"ray_tpu_{name}_count{fmt()} {val[-1]}")
+            else:
+                lines.append(f"ray_tpu_{name}{fmt()} {val}")
+    return "\n".join(lines) + "\n"

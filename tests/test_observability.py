@@ -1,0 +1,149 @@
+"""State API + metrics + timeline tests.
+
+Reference coverage themes: ``python/ray/tests/test_state_api*.py``,
+``test_metrics_agent.py``, ``ray timeline``.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, collect, prometheus_text
+
+
+def test_list_and_summarize_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    ray_tpu.get([work.remote(i) for i in range(5)])
+
+    events = state.get_task_events()
+    finished = [e for e in events if e["state"] == "FINISHED"]
+    assert len(finished) >= 5
+    # every finished task has a matching RUNNING event with an earlier time
+    runs = {e["task_id"]: e["time"] for e in events if e["state"] == "RUNNING"}
+    for ev in finished:
+        assert ev["task_id"] in runs
+        assert ev["time"] >= runs[ev["task_id"]]
+
+    summ = state.summarize_tasks()
+    assert summ["by_state"].get("FINISHED", 0) >= 5
+    assert any("work" in fn for fn in summ["by_func"])
+
+
+def test_list_actors_and_nodes(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="obs_actor").remote()
+    ray_tpu.get(a.ping.remote())
+
+    actors = state.list_actors()
+    mine = [x for x in actors if x["name"] == "obs_actor"]
+    assert mine and mine[0]["state"] == "ALIVE" and mine[0]["class_name"] == "A"
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+
+    summ = state.summary()
+    assert summ["actors"]["by_state"].get("ALIVE", 0) >= 1
+
+
+def test_failed_task_event(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    events = state.get_task_events()
+    assert any(e["state"] == "FAILED" for e in events)
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(3)])
+    path = tmp_path / "trace.json"
+    trace = state.timeline(str(path))
+    assert len(trace) >= 3
+    ev = trace[0]
+    assert ev["ph"] == "X" and ev["dur"] > 0
+    loaded = json.loads(path.read_text())
+    assert len(loaded) == len(trace)
+    slow_evs = [e for e in loaded if "slow" in (e["name"] or "")]
+    assert slow_evs and all(e["dur"] >= 40_000 for e in slow_evs)  # >=40ms in us
+
+
+def test_placement_group_listing(ray_start_regular):
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready())
+    pgs = state.list_placement_groups()
+    assert len(pgs) == 1
+    assert pgs[0]["state"] == "CREATED"
+    assert len(pgs[0]["bundles"]) == 2
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    c = Counter("obs_requests", "requests served", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    g = Gauge("obs_queue_depth", "queue depth")
+    g.set(3)
+    g.set(7)
+    h = Histogram("obs_latency", "latency s", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    data = collect()
+    metrics = data["metrics"]
+    route_a = json.dumps({"route": "/a"}, separators=(",", ":"))
+    route_b = json.dumps({"route": "/b"}, separators=(",", ":"))
+    assert metrics["obs_requests"][route_a] == 3
+    assert metrics["obs_requests"][route_b] == 5
+    assert metrics["obs_queue_depth"][""] == 7
+    hist = metrics["obs_latency"][""]
+    assert hist[:3] == [1, 1, 1]     # one obs per bucket (incl overflow)
+    assert hist[-1] == 3             # count
+    assert abs(hist[-2] - 5.55) < 1e-6  # sum
+
+    text = prometheus_text()
+    assert "ray_tpu_obs_requests" in text
+    assert 'route="/a"' in text
+
+
+def test_metrics_from_workers_merge(ray_start_regular):
+    @ray_tpu.remote
+    def record(i):
+        from ray_tpu.util.metrics import Counter, flush
+
+        c = Counter("obs_worker_hits", "per-worker counter")
+        c.inc(1)
+        flush()
+        return i
+
+    ray_tpu.get([record.remote(i) for i in range(4)])
+    data = collect()
+    total = sum(data["metrics"].get("obs_worker_hits", {}).values())
+    assert total == 4
+
+
+def test_metric_tag_validation(ray_start_regular):
+    c = Counter("obs_tagged", tag_keys=("a",))
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        Counter("bad name")
