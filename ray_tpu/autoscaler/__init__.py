@@ -1,0 +1,15 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Reference: ``python/ray/autoscaler/`` — ``StandardAutoscaler.update``
+(``_private/autoscaler.py:171,373``) driven by a monitor loop, launching
+nodes through pluggable cloud ``NodeProvider``s, with the in-process
+``FakeMultiNodeProvider`` (``_private/fake_multi_node/node_provider.py:237``)
+powering e2e tests on one machine.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeNodeProvider,
+    GKETPUNodeProvider,
+    NodeProvider,
+)

@@ -1,0 +1,134 @@
+"""Autoscaler-lite e2e: infeasible tasks trigger node launches through the
+FakeNodeProvider; idle autoscaled nodes are reaped.
+
+Reference: ``python/ray/tests/test_autoscaler_fake_multinode.py``.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeNodeProvider, GKETPUNodeProvider, Monitor, StandardAutoscaler
+
+
+@pytest.fixture
+def cluster(ray_start_cluster):
+    c = ray_start_cluster(num_cpus=1)
+    c.connect()
+    yield c
+
+
+def test_scale_up_for_infeasible_task(cluster):
+    provider = FakeNodeProvider(cluster)
+    scaler = StandardAutoscaler(
+        provider,
+        node_types={"big": {"resources": {"CPU": 4}, "max_workers": 2}},
+        idle_timeout_s=1.0,
+        launch_grace_s=0.0,
+        head=cluster.head,
+    )
+
+    @ray_tpu.remote(num_cpus=4)
+    def heavy():
+        return 42
+
+    ref = heavy.remote()  # infeasible on the 1-CPU head node
+    time.sleep(0.2)
+    result = scaler.update()
+    assert len(result["launched"]) == 1, result
+    assert ray_tpu.get(ref, timeout=60) == 42
+
+    # scale-down: node drains, goes idle past the timeout, gets reaped
+    deadline = time.time() + 30
+    terminated = []
+    while time.time() < deadline and not terminated:
+        time.sleep(0.3)
+        terminated = scaler.update()["terminated"]
+    assert terminated, "idle autoscaled node never reaped"
+    assert provider.non_terminated_nodes() == []
+
+
+def test_scale_respects_max_workers(cluster):
+    provider = FakeNodeProvider(cluster)
+    scaler = StandardAutoscaler(
+        provider,
+        node_types={"big": {"resources": {"CPU": 2}, "max_workers": 1}},
+        idle_timeout_s=60.0,
+        head=cluster.head,
+    )
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy(i):
+        time.sleep(0.5)
+        return i
+
+    refs = [heavy.remote(i) for i in range(4)]
+    time.sleep(0.2)
+    r1 = scaler.update()
+    r2 = scaler.update()
+    assert len(r1["launched"]) == 1
+    assert len(r2["launched"]) == 0  # capped at max_workers=1
+    assert ray_tpu.get(refs, timeout=120) == [0, 1, 2, 3]
+
+
+def test_min_workers_and_monitor(cluster):
+    provider = FakeNodeProvider(cluster)
+    scaler = StandardAutoscaler(
+        provider,
+        node_types={"std": {"resources": {"CPU": 2}, "min_workers": 1, "max_workers": 2}},
+        idle_timeout_s=60.0,
+        head=cluster.head,
+    )
+    monitor = Monitor(scaler, interval_s=0.1).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not provider.non_terminated_nodes():
+            time.sleep(0.1)
+        assert len(provider.non_terminated_nodes()) == 1  # min_workers satisfied
+    finally:
+        monitor.stop()
+
+
+def test_pending_actor_demand_counts(cluster):
+    provider = FakeNodeProvider(cluster)
+    scaler = StandardAutoscaler(
+        provider,
+        node_types={"big": {"resources": {"CPU": 4}, "max_workers": 1}},
+        idle_timeout_s=60.0,
+        head=cluster.head,
+    )
+
+    @ray_tpu.remote(num_cpus=4)
+    class Big:
+        def ping(self):
+            return True
+
+    a = Big.remote()  # pending: no node has 4 CPUs
+    time.sleep(0.2)
+    result = scaler.update()
+    assert len(result["launched"]) == 1
+    assert ray_tpu.get(a.ping.remote(), timeout=60)
+
+
+def test_gke_provider_requires_client():
+    p = GKETPUNodeProvider(project="p", zone="z", cluster_name="c")
+    with pytest.raises(RuntimeError, match="needs a GKE client"):
+        p.create_node("v5e-8", {"TPU": 8}, {})
+
+    class FakeGKE:
+        def __init__(self):
+            self.n = 0
+
+        def scale_up(self, node_pool, labels):
+            self.n += 1
+            return f"gke-{node_pool}-{self.n}"
+
+        def delete(self, pid):
+            self.n -= 1
+
+    p2 = GKETPUNodeProvider(project="p", zone="z", cluster_name="c", client=FakeGKE())
+    pid = p2.create_node("v5e-8", {"TPU": 8}, {})
+    assert p2.non_terminated_nodes() == [pid]
+    p2.terminate_node(pid)
+    assert p2.non_terminated_nodes() == []
