@@ -32,6 +32,12 @@ from ray_tpu.tune.search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from ray_tpu.tune.searcher import (  # noqa: F401
+    ConcurrencyLimiter,
+    RandomSearcher,
+    Searcher,
+    TPESearcher,
+)
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
@@ -120,8 +126,15 @@ class Tuner:
         cfg = self.tune_config
         name = self.run_config.name or f"tune_{int(time.time())}"
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
-        gen = cfg.search_alg or BasicVariantGenerator(seed=cfg.seed)
-        configs = gen.generate(self.param_space, num_samples=cfg.num_samples)
+        searcher = None
+        configs: list[dict] = []
+        if cfg.search_alg is not None and hasattr(cfg.search_alg, "suggest"):
+            # sequential Searcher plugin (reference: search_alg=OptunaSearch())
+            searcher = cfg.search_alg
+            searcher.set_search_properties(cfg.metric, cfg.mode, self.param_space)
+        else:
+            gen = cfg.search_alg or BasicVariantGenerator(seed=cfg.seed)
+            configs = gen.generate(self.param_space, num_samples=cfg.num_samples)
         resources = getattr(self.trainable, "_tune_resources", None)
         controller = TuneController(
             self.trainable,
@@ -135,6 +148,8 @@ class Tuner:
             failure_config=self.run_config.failure_config,
             checkpoint_config=self.run_config.checkpoint_config,
             verbose=self.run_config.verbose > 1,
+            searcher=searcher,
+            num_samples=cfg.num_samples,
         )
         trials = controller.run()
         results = []

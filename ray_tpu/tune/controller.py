@@ -31,8 +31,15 @@ PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR
 
 
 class Trial:
-    def __init__(self, idx: int, config: dict, exp_dir: str, ckpt_config: CheckpointConfig):
-        self.id = f"{idx:05d}_{uuid.uuid4().hex[:6]}"
+    def __init__(
+        self,
+        idx: int,
+        config: dict,
+        exp_dir: str,
+        ckpt_config: CheckpointConfig,
+        trial_id: Optional[str] = None,
+    ):
+        self.id = trial_id or f"{idx:05d}_{uuid.uuid4().hex[:6]}"
         self.idx = idx
         self.config = config
         self.state = PENDING
@@ -70,6 +77,8 @@ class TuneController:
         failure_config: Optional[FailureConfig] = None,
         checkpoint_config: Optional[CheckpointConfig] = None,
         verbose: int = 0,
+        searcher=None,
+        num_samples: int = 0,
     ):
         self.trainable = trainable
         self.exp_dir = exp_dir
@@ -81,6 +90,13 @@ class TuneController:
         self.failure_config = failure_config or FailureConfig()
         ckpt_config = checkpoint_config or CheckpointConfig()
         self.verbose = verbose
+        self._ckpt_config = ckpt_config
+        # sequential-searcher mode: trials are pulled from searcher.suggest
+        # lazily as slots free up (reference: SearchGenerator); batch mode:
+        # the pre-expanded config list.
+        self.searcher = searcher
+        self.num_samples = num_samples
+        self._searcher_done = searcher is None
         self.trials = [Trial(i, c, exp_dir, ckpt_config) for i, c in enumerate(configs)]
         for t in self.trials:
             t.retries_left = self.failure_config.max_failures
@@ -89,7 +105,11 @@ class TuneController:
 
     def run(self) -> list[Trial]:
         try:
-            while any(t.state in (PENDING, RUNNING) for t in self.trials):
+            while (
+                any(t.state in (PENDING, RUNNING) for t in self.trials)
+                or not self._searcher_done
+            ):
+                self._pull_suggestions()
                 self._launch_pending()
                 progressed = self._poll_running()
                 if not progressed:
@@ -99,6 +119,39 @@ class TuneController:
             for t in self.trials:
                 self._stop_actor(t)
             self._save_experiment_state()
+
+    def _pull_suggestions(self):
+        """Ask the sequential searcher for new trials while slots are free."""
+        if self._searcher_done:
+            return
+        from ray_tpu.tune.searcher import FINISHED
+
+        active = sum(1 for t in self.trials if t.state in (PENDING, RUNNING))
+        while len(self.trials) < self.num_samples and active < self.max_concurrent:
+            idx = len(self.trials)
+            trial_id = f"{idx:05d}_{uuid.uuid4().hex[:6]}"
+            out = self.searcher.suggest(trial_id)
+            if out is None:
+                return  # searcher wants to wait for completions
+            if out == FINISHED:
+                self._searcher_done = True
+                self.num_samples = len(self.trials)
+                return
+            trial = Trial(idx, out, self.exp_dir, self._ckpt_config, trial_id=trial_id)
+            trial.retries_left = self.failure_config.max_failures
+            self.trials.append(trial)
+            active += 1
+        if len(self.trials) >= self.num_samples:
+            self._searcher_done = True
+
+    def _notify_searcher_complete(self, trial: Trial, error: bool):
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_complete(
+                    trial.id, result=trial.last_result, error=error
+                )
+            except Exception:
+                pass
 
     def _launch_pending(self):
         running = sum(1 for t in self.trials if t.state == RUNNING)
@@ -158,6 +211,7 @@ class TuneController:
             elif kind == "done":
                 trial.state = TERMINATED
                 self._stop_actor(trial)
+                self._notify_searcher_complete(trial, error=False)
                 self._save_experiment_state()
             elif kind == "error":
                 self._on_trial_failure(trial, ev[1])
@@ -170,6 +224,11 @@ class TuneController:
         metrics.setdefault("trial_id", trial.id)
         trial.last_result = metrics
         trial.results.append(metrics)
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_result(trial.id, metrics)
+            except Exception:
+                pass
         if reported_ckpt is not None:
             trial.ckpt_manager.commit(reported_ckpt, metrics)
             trial.start_checkpoint = None  # own commit supersedes any override
@@ -180,6 +239,7 @@ class TuneController:
             self._ack(trial)
             trial.state = TERMINATED
             self._stop_actor(trial)
+            self._notify_searcher_complete(trial, error=False)
             if self.verbose:
                 print(f"[tune] trial {trial.id} early-stopped at iter {trial.iteration}")
         elif decision == sched_mod.EXPLOIT:
@@ -223,6 +283,7 @@ class TuneController:
         else:
             trial.state = ERROR
             trial.error = error
+            self._notify_searcher_complete(trial, error=True)
         self._save_experiment_state()
 
     # ------------------------------------------------------- state snapshot
