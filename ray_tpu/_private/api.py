@@ -53,6 +53,29 @@ def init(
             return _context_info()
         raise rex.RayError("ray_tpu.init() called twice; pass ignore_reinit_error=True to ignore")
     GLOBAL_CONFIG.apply_overrides(_system_config)
+    if object_store_memory:
+        # sizes both the node "memory" resource and the spill watermark
+        GLOBAL_CONFIG.object_store_memory = int(object_store_memory)
+    if (
+        address is not None
+        and _head is None
+        and ":" in address
+        and not address.startswith("ray-tpu://")
+    ):
+        # remote attach over TCP (reference: ray.init(address="host:port"))
+        from ray_tpu._private.config import resolve_authkey
+        from ray_tpu._private.runtime import RemoteDriverContext
+        from ray_tpu._private.worker_main import connect_head
+
+        conn = connect_head(address, resolve_authkey())
+        conn.send(("register_driver", {}))
+        kind, info = conn.recv()
+        if kind != "driver_ack":
+            raise rex.RayError(f"unexpected handshake reply {kind!r}")
+        ctx = RemoteDriverContext(conn, info["node_id"])
+        runtime.set_ctx(ctx)
+        atexit.register(_atexit_shutdown)
+        return _context_info()
     if address is not None and _head is None:
         from ray_tpu.cluster_utils import resolve_address
 

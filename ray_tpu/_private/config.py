@@ -25,6 +25,10 @@ class Config:
     #: Logical "memory" resource advertised by a node when ``ray.init`` is not
     #: given ``object_store_memory`` (reference: plasma store capacity).
     object_store_memory: int = 0  # 0 = auto (30% of system RAM)
+    #: shm arena watermark: above this, least-recently-used unpinned objects
+    #: spill to disk (reference: local_object_manager.h spill throttles).
+    #: 0 = auto (object_store_memory, else 2 GiB).
+    object_spilling_threshold_bytes: int = 0
 
     # -- scheduler ---------------------------------------------------------
     #: Hybrid scheduling policy: pack onto busiest feasible node until its
@@ -80,3 +84,21 @@ def _coerce(raw: str, typ: Any) -> Any:
 
 GLOBAL_CONFIG = Config()
 GLOBAL_CONFIG.apply_overrides()
+
+
+# ---------------------------------------------------------------------------
+# cluster auth (reference: the redis password / auth cookie the daemons share)
+# ---------------------------------------------------------------------------
+
+DEFAULT_AUTHKEY = b"ray-tpu-insecure-default"
+
+
+def resolve_authkey() -> bytes:
+    """Shared secret for the head's control-plane listeners. Set
+    ``RAY_TPU_AUTHKEY`` (hex) on every host of a real deployment; the
+    default only suits single-user/dev clusters (like the reference's
+    default-open gRPC ports)."""
+    import os
+
+    raw = os.environ.get("RAY_TPU_AUTHKEY")
+    return bytes.fromhex(raw) if raw else DEFAULT_AUTHKEY

@@ -50,7 +50,10 @@ from ray_tpu._private.shm_store import ShmLocation, ShmOwner
 
 
 class ObjectEntry:
-    __slots__ = ("small", "shm", "is_error", "refcount", "pins", "size")
+    __slots__ = (
+        "small", "shm", "is_error", "refcount", "pins", "size",
+        "spill_path", "last_access", "last_read", "borrow_nonces",
+    )
 
     def __init__(self):
         self.small: Optional[bytes] = None
@@ -59,10 +62,17 @@ class ObjectEntry:
         self.refcount = 0  # driver-side ObjectRef count
         self.pins = 0  # pending-task dependency pins
         self.size = 0
+        self.spill_path: Optional[str] = None  # on-disk copy (spilled)
+        self.last_access = 0.0
+        self.last_read = 0.0  # read lease: guards just-handed-out locators
+        # in-transit borrow nonces: a serialized ref holds one count until
+        # the (first) deserializer claims it (reference: borrower registration
+        # in core_worker/reference_count.h:61)
+        self.borrow_nonces: Optional[set] = None
 
     @property
     def ready(self) -> bool:
-        return self.small is not None or self.shm is not None
+        return self.small is not None or self.shm is not None or self.spill_path is not None
 
     def locator(self):
         if self.small is not None:
@@ -108,12 +118,34 @@ class WorkerHandle:
     def __init__(self, node: "NodeState", proc, conn=None):
         self.wid = next(WorkerHandle._ids)
         self.node = node
-        self.proc = proc  # _WorkerProc (None for remote attach)
+        self.proc = proc  # _WorkerProc (None for remote workers)
         self.conn = conn  # set at registration
         self.alive = True
         self.current_task: Optional[dict] = None
         self.actor_id: Optional[bytes] = None
         self.idle_since = time.monotonic()
+        self.created_at = time.monotonic()
+        self.send_lock = threading.Lock()
+        # startup token: matches a spawned process to its pre-created handle
+        # at registration (reference: worker_pool.h startup_token) — the only
+        # correlation that works for workers spawned on REMOTE hosts, where
+        # the head never sees a pid
+        self.token: Optional[str] = None
+
+    def send(self, msg) -> bool:
+        try:
+            with self.send_lock:
+                self.conn.send(msg)
+            return True
+        except (OSError, ValueError, BrokenPipeError):
+            return False
+
+
+class AgentHandle:
+    """Connection to a remote node's agent daemon (spawns workers there)."""
+
+    def __init__(self, conn):
+        self.conn = conn
         self.send_lock = threading.Lock()
 
     def send(self, msg) -> bool:
@@ -129,6 +161,7 @@ class NodeState:
     def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
         self.node_id = node_id
         self.created_at = time.monotonic()
+        self.agent: Optional[AgentHandle] = None  # set for remote nodes
         self.resources_total = dict(resources)
         self.resources_avail = dict(resources)
         self.labels = labels or {}
@@ -240,6 +273,8 @@ class Head:
 
         self._shutdown = False
         self._listener = None
+        self._tcp_listener = None
+        self.tcp_address: Optional[tuple] = None
         self._threads: list[threading.Thread] = []
         self._conn_worker: dict[Any, WorkerHandle] = {}
         self.task_events: list[dict] = []  # observability feed (state API)
@@ -251,17 +286,38 @@ class Head:
         from multiprocessing.connection import Listener
 
         self._listener = Listener(self.socket_path, family="AF_UNIX", authkey=self.authkey)
-        t = threading.Thread(target=self._accept_loop, name="head-accept", daemon=True)
+        t = threading.Thread(
+            target=self._accept_loop, args=(self._listener, False),
+            name="head-accept", daemon=True,
+        )
         t.start()
         self._threads.append(t)
         h = threading.Thread(target=self._health_loop, name="head-health", daemon=True)
         h.start()
         self._threads.append(h)
 
-    def _accept_loop(self):
+    def listen_tcp(self, host: str = "0.0.0.0", port: int = 0) -> tuple[str, int]:
+        """Open the TCP control plane beside the unix socket (same message
+        protocol; reference: the gRPC ports every daemon exposes,
+        ``services.py:1421``). Connections arriving here are REMOTE: object
+        locators are converted to inline payloads for them (no cross-host
+        shm)."""
+        from multiprocessing.connection import Listener
+
+        self._tcp_listener = Listener((host, port), authkey=self.authkey)
+        self.tcp_address = self._tcp_listener.address
+        t = threading.Thread(
+            target=self._accept_loop, args=(self._tcp_listener, True),
+            name="head-accept-tcp", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+        return self.tcp_address
+
+    def _accept_loop(self, listener, remote: bool):
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError):
                 return
             except Exception:
@@ -270,11 +326,14 @@ class Head:
                 # silently stop ALL future worker registration. Drop the
                 # connection and keep accepting.
                 continue
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn, remote), daemon=True
+            )
             t.start()
 
-    def _serve_conn(self, conn):
+    def _serve_conn(self, conn, remote: bool = False):
         worker: Optional[WorkerHandle] = None
+        agent_node: Optional[NodeID] = None
         try:
             while not self._shutdown:
                 try:
@@ -283,10 +342,14 @@ class Head:
                     break
                 kind = msg[0]
                 if kind == "register":
-                    worker = self._on_register(conn, msg[1])
+                    worker = self._on_register(conn, msg[1], remote=remote)
+                elif kind == "register_agent":
+                    agent_node = self._on_register_agent(conn, msg[1])
+                elif kind == "register_driver":
+                    conn.send(("driver_ack", {"node_id": self._any_node_id()}))
                 elif kind == "req":
                     _, seq, method, payload = msg
-                    self._dispatch_request(conn, worker, seq, method, payload)
+                    self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
                 elif kind == "task_done":
                     self._on_task_done(worker, msg[1])
                 elif kind == "actor_ready":
@@ -294,9 +357,35 @@ class Head:
         finally:
             if worker is not None:
                 self._on_worker_disconnect(worker)
+            if agent_node is not None:
+                # agent death = node death (reference: raylet disconnect)
+                try:
+                    self.remove_node(agent_node)
+                except Exception:
+                    pass
 
-    def _dispatch_request(self, conn, worker, seq, method, payload):
+    def _any_node_id(self) -> bytes:
+        with self.lock:
+            for n in self.nodes.values():
+                if n.alive:
+                    return n.node_id.binary()
+        raise rex.RayError("cluster has no alive nodes")
+
+    def _on_register_agent(self, conn, info) -> NodeID:
+        """A remote host's node agent attached: register its node; workers
+        for it will be spawned THERE via spawn requests over this conn."""
+        node_id = self.add_node(info.get("resources") or {}, labels=info.get("labels"))
+        with self.lock:
+            self.nodes[node_id.binary()].agent = AgentHandle(conn)
+        conn.send(("agent_ack", {"node_id": node_id.binary()}))
+        with self.lock:
+            self._schedule()  # queued-infeasible work may now fit
+        return node_id
+
+    def _dispatch_request(self, conn, worker, seq, method, payload, remote: bool = False):
         handler = getattr(self, "rpc_" + method)
+        if remote and method == "get":
+            handler = self._rpc_get_remote
         blocking = method in ("get", "wait", "pg_ready", "get_actor_named")
         if blocking:
             threading.Thread(
@@ -304,6 +393,26 @@ class Head:
             ).start()
         else:
             self._run_request(conn, worker, seq, handler, payload)
+
+    def _rpc_get_remote(self, obj_ids, timeout=None):
+        """get for TCP clients: shm locators are unreadable across hosts, so
+        the head reads the segment and ships the bytes inline (first-cut
+        inter-node object transfer; reference: object_manager chunked pull)."""
+        from ray_tpu._private.shm_store import ShmReader
+
+        out = []
+        for loc in self.get_locators(obj_ids, timeout):
+            kind, payload, is_err = loc
+            if kind == "shm":
+                reader = ShmReader(payload)
+                try:
+                    data = reader.read_serialized_bytes()
+                finally:
+                    reader.close()
+                out.append(("inline", data, is_err))
+            else:
+                out.append(loc)
+        return out
 
     def _run_request(self, conn, worker, seq, handler, payload):
         try:
@@ -327,7 +436,21 @@ class Head:
         # point (`python -m ray_tpu._private.worker_main`), like the
         # reference's worker pool (worker_pool.h:152) execing default_worker.py
         # — NOT multiprocessing children, which would re-import the user's
-        # __main__ module (fatal for unguarded driver scripts).
+        # __main__ module (fatal for unguarded driver scripts). Remote nodes
+        # delegate the spawn to their agent daemon over TCP.
+        import uuid as _uuid
+
+        token = _uuid.uuid4().hex
+        if node.agent is not None:
+            wh = WorkerHandle(node, None)
+            wh.actor_id = actor_id
+            wh.token = token
+            with self.lock:
+                node.all_workers.add(wh)
+            if not node.agent.send(("spawn_worker", {"token": token})):
+                self._on_worker_dead(wh)
+            return
+
         import subprocess
         import sys
 
@@ -344,6 +467,7 @@ class Head:
                 self.socket_path,
                 self.authkey.hex(),
                 node.node_id.binary().hex(),
+                token,
             ],
             env=env,
             start_new_session=False,
@@ -351,20 +475,28 @@ class Head:
         proc = _WorkerProc(popen)
         wh = WorkerHandle(node, proc)
         wh.actor_id = actor_id
+        wh.token = token
         with self.lock:
             node.all_workers.add(wh)
         # registration arrives on its own connection; matched in _on_register
 
-    def _on_register(self, conn, info) -> WorkerHandle:
+    def _on_register(self, conn, info, remote: bool = False) -> WorkerHandle:
         node_id = info["node_id"]
         pid = info["pid"]
+        token = info.get("token")
         with self.lock:
             node = self.nodes[node_id]
             wh = None
-            for cand in node.all_workers:
-                if cand.conn is None and cand.proc is not None and cand.proc.pid == pid:
-                    wh = cand
-                    break
+            if token:
+                for cand in node.all_workers:
+                    if cand.conn is None and cand.token == token:
+                        wh = cand
+                        break
+            if wh is None:
+                for cand in node.all_workers:
+                    if cand.conn is None and cand.proc is not None and cand.proc.pid == pid:
+                        wh = cand
+                        break
             if wh is None:  # race-safe fallback
                 wh = WorkerHandle(node, None)
                 node.all_workers.add(wh)
@@ -675,6 +807,11 @@ class Head:
 
     def _on_task_done(self, wh: WorkerHandle, payload: dict):
         task_id = payload["task_id"]
+        if payload.get("results"):
+            # big inline results re-lay into shm BEFORE taking the head lock
+            payload["results"] = [
+                (rid, self._normalize_locator(loc)) for rid, loc in payload["results"]
+            ]
         with self.lock:
             rec = self.tasks.pop(task_id, None)
             if rec is None:
@@ -705,9 +842,11 @@ class Head:
             ent.small = payload
             ent.size = len(payload)
         else:
+            self._ensure_capacity(payload.total_size)
             ent.shm = payload
             ent.size = payload.total_size
             self.shm_owner.register(payload)
+        ent.last_access = time.monotonic()
         ent.is_error = is_err
         self._deps_ready(obj_id)
         self.cv.notify_all()
@@ -744,6 +883,15 @@ class Head:
                     for wh in list(node.all_workers):
                         if wh.alive and wh.proc is not None and not wh.proc.is_alive():
                             dead.append(wh)
+                        elif (
+                            wh.alive
+                            and wh.proc is None
+                            and wh.conn is None
+                            and now - wh.created_at > 60.0
+                        ):
+                            # agent-spawned worker never registered (crashed
+                            # on a remote host where we hold no proc handle)
+                            dead.append(wh)
                     # Reap workers idle beyond the keep-alive (reference:
                     # worker_pool idle worker killing), but never while work
                     # is queued for the node.
@@ -775,6 +923,10 @@ class Head:
             return
         wh.alive = False
         node = wh.node
+        if wh.actor_id is None and wh.conn is None:
+            # died before registering: return the spawn slot, or _maybe_spawn
+            # under-counts the pool forever (worst case: node stops spawning)
+            node.spawning = max(0, node.spawning - 1)
         node.all_workers.discard(wh)
         if wh in node.idle_workers:
             node.idle_workers.remove(wh)
@@ -1036,6 +1188,9 @@ class Head:
                 while True:
                     ent = self.objects.get(oid)
                     if ent is not None and ent.ready:
+                        if ent.small is None and ent.shm is None:
+                            self._restore_spilled(oid, ent)  # transparent
+                        ent.last_access = ent.last_read = time.monotonic()
                         out.append(ent.locator())
                         break
                     remaining = None if deadline is None else deadline - time.monotonic()
@@ -1078,6 +1233,98 @@ class Head:
             self.objects.pop(obj_id, None)
             if ent.shm is not None:
                 self.shm_owner.unlink(ent.shm.name)
+            if ent.spill_path is not None:
+                try:
+                    os.unlink(ent.spill_path)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------- spilling
+
+    def _spill_threshold(self) -> int:
+        t = GLOBAL_CONFIG.object_spilling_threshold_bytes
+        if t:
+            return t
+        return GLOBAL_CONFIG.object_store_memory or (2 << 30)
+
+    def _spill_dir(self) -> str:
+        d = os.path.join(os.path.dirname(self.socket_path), "spill")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _ensure_capacity(self, incoming: int) -> None:
+        """Lock held. Spill LRU shm objects to disk until ``incoming`` more
+        bytes fit under the watermark (reference:
+        ``raylet/local_object_manager.h:41-76`` spill-to-external-storage).
+        Pinned objects (in-flight task args) are exempt; existing reader
+        mappings survive the unlink — restore creates a fresh segment."""
+        limit = self._spill_threshold()
+        if self.shm_owner.bytes_used + incoming <= limit:
+            return
+        now = time.monotonic()
+        victims = sorted(
+            (
+                (oid, e)
+                for oid, e in self.objects.items()
+                # grace window: a locator handed out moments ago may not be
+                # attached yet — unlinking it would FileNotFoundError the
+                # reader (clients also re-fetch on that error as a backstop)
+                if e.shm is not None and e.pins <= 0 and now - e.last_read > 5.0
+            ),
+            key=lambda kv: kv[1].last_access,
+        )
+        for oid, ent in victims:
+            if self.shm_owner.bytes_used + incoming <= limit:
+                break
+            self._spill_one(oid, ent)
+
+    def _spill_one(self, obj_id: bytes, ent: ObjectEntry) -> None:
+        from ray_tpu._private.shm_store import ShmReader
+
+        try:
+            reader = ShmReader(ent.shm)
+            try:
+                data = reader.read_serialized_bytes()
+            finally:
+                reader.close()
+            path = os.path.join(self._spill_dir(), ObjectID(obj_id).hex())
+            with open(path, "wb") as f:
+                f.write(data)
+        except Exception:
+            return  # spill is best-effort; the object stays in shm
+        self.shm_owner.unlink(ent.shm.name)
+        ent.shm = None
+        ent.spill_path = path
+
+    def _restore_spilled(self, obj_id: bytes, ent: ObjectEntry) -> None:
+        """Lock held. Transparent restore on access (reference:
+        ``local_object_manager`` restore path). A lost/corrupt spill file
+        marks the object LOST (callers get ObjectLostError) instead of
+        raising an opaque I/O error on every get forever."""
+        from ray_tpu._private.shm_store import write_shm
+
+        try:
+            with open(ent.spill_path, "rb") as f:
+                data = f.read()
+            sv = ser.SerializedValue.from_bytes(data)
+        except Exception as e:
+            ent.spill_path = None
+            err = ser.serialize(
+                rex.ObjectLostError(
+                    f"spilled copy of {ObjectID(obj_id)} unreadable: {e!r}"
+                )
+            )
+            ent.small = err.to_bytes()
+            ent.is_error = True
+            return
+        self._ensure_capacity(sv.total_size)
+        ent.shm = write_shm(sv)
+        self.shm_owner.register(ent.shm)
+        try:
+            os.unlink(ent.spill_path)
+        except OSError:
+            pass
+        ent.spill_path = None
 
     def free_objects(self, obj_ids: list[bytes]):
         with self.lock:
@@ -1246,9 +1493,24 @@ class Head:
     # ------------------------------------------------------------------ rpcs
     # Thin adapters so worker processes hit the same logic over the socket.
 
+    def _normalize_locator(self, locator):
+        """Big inline payloads (remote worker puts/results over the socket)
+        re-lay into this node's shm so local readers stay zero-copy and the
+        head's heap doesn't hold object data. Runs OUTSIDE the head lock —
+        it's a full memcpy of the object."""
+        kind, payload, is_err = locator
+        if kind == "inline" and len(payload) > GLOBAL_CONFIG.max_direct_call_object_size:
+            from ray_tpu._private.shm_store import write_shm
+
+            sv = ser.SerializedValue.from_bytes(payload)
+            return ("shm", write_shm(sv), is_err)
+        return locator
+
     def rpc_put(self, obj_id, small, shm, is_error=False):
+        locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
+        locator = self._normalize_locator(locator)  # big memcpy outside lock
         with self.lock:
-            self._store_locator(obj_id, ("inline", small, is_error) if small is not None else ("shm", shm, is_error))
+            self._store_locator(obj_id, locator)
         return True
 
     def rpc_get(self, obj_ids, timeout=None):
@@ -1344,6 +1606,35 @@ class Head:
 
     def rpc_free_ref(self, obj_id):
         self.remove_ref(obj_id)
+        return True
+
+    def rpc_borrow_begin(self, obj_id, nonce):
+        """A ref is being serialized: hold one count for the transit window,
+        tagged so the deserializer can claim (not double-count) it
+        (reference: borrower bookkeeping, ``reference_count.h:61-115``)."""
+        with self.lock:
+            ent = self.objects.get(obj_id)
+            if ent is None:
+                ent = self.objects[obj_id] = ObjectEntry()
+            ent.refcount += 1
+            if ent.borrow_nonces is None:
+                ent.borrow_nonces = set()
+            ent.borrow_nonces.add(nonce)
+        return True
+
+    def rpc_borrow_claim(self, obj_id, nonce):
+        """A deserialized ref came alive. First claim of a nonce inherits
+        the transit count; later claims of the same nonce (the same pickle
+        deserialized again, e.g. a retried task's args) each add their own
+        count. Every claimed holder releases via free_ref on GC."""
+        with self.lock:
+            ent = self.objects.get(obj_id)
+            if ent is None:
+                ent = self.objects[obj_id] = ObjectEntry()
+            if ent.borrow_nonces and nonce in ent.borrow_nonces:
+                ent.borrow_nonces.discard(nonce)  # transit count transfers
+            else:
+                ent.refcount += 1
         return True
 
     def rpc_free(self, obj_ids):
@@ -1495,6 +1786,11 @@ class Head:
             self._listener.close()
         except Exception:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except Exception:
+                pass
         self.shm_owner.shutdown()
         try:
             os.unlink(self.socket_path)

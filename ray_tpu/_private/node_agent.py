@@ -1,0 +1,127 @@
+"""Node agent: the per-host daemon that joins a remote machine to a cluster.
+
+Counterpart of the reference's raylet + ``ray start --address=`` node
+launcher (``python/ray/scripts/scripts.py:566``, ``_private/services.py:1485``
+— the raylet registers the node with GCS and owns the local worker pool).
+TPU-first simplification: the agent is a thin spawn proxy — scheduling stays
+centralized in the head; the agent's only jobs are (a) registering this
+host's resources and (b) exec'ing worker processes when the head asks, each
+of which dials the head's TCP control plane itself.
+
+Run via ``python -m ray_tpu start --address=HOST:PORT``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+
+def shutdown_conn(conn) -> None:
+    """Force-close a multiprocessing Connection that another thread may be
+    blocked recv'ing on. ``conn.close()`` alone only drops the fd-table
+    entry — the in-flight read keeps the kernel file description open, so no
+    FIN is sent and BOTH sides block forever. SHUT_RDWR interrupts the read
+    and tears the TCP stream down immediately."""
+    try:
+        s = socket.socket(fileno=conn.fileno())
+    except OSError:
+        return
+    try:
+        s.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    finally:
+        s.detach()
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        address: str,
+        authkey: bytes,
+        resources: Optional[dict] = None,
+        labels: Optional[dict] = None,
+    ):
+        from ray_tpu._private.worker_main import connect_head
+
+        self.address = address
+        self.authkey = authkey
+        self.resources = dict(resources or {"CPU": float(os.cpu_count() or 1)})
+        self.labels = dict(labels or {})
+        self._procs: list[subprocess.Popen] = []
+        self._stop = threading.Event()
+        self.conn = connect_head(address, authkey)
+        self.conn.send(
+            (
+                "register_agent",
+                {"resources": self.resources, "labels": self.labels, "pid": os.getpid()},
+            )
+        )
+        kind, info = self.conn.recv()
+        assert kind == "agent_ack", kind
+        self.node_id_bin: bytes = info["node_id"]
+
+    # -- serve loop --------------------------------------------------------
+
+    def run(self) -> None:
+        """Blocks serving spawn requests until the head hangs up."""
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    break
+                if msg[0] == "spawn_worker":
+                    self._spawn(msg[1])
+                elif msg[0] == "exit":
+                    break
+        finally:
+            self.shutdown()
+
+    def start(self) -> "NodeAgent":
+        threading.Thread(target=self.run, daemon=True).start()
+        return self
+
+    def _spawn(self, info: dict) -> None:
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "ray_tpu._private.worker_main",
+                    self.address,
+                    self.authkey.hex(),
+                    self.node_id_bin.hex(),
+                    info.get("token", ""),
+                    "--remote",
+                ],
+                env=env,
+            )
+        )
+        self._procs = [p for p in self._procs if p.poll() is None]
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=3)
+            except Exception:
+                p.kill()
+        shutdown_conn(self.conn)

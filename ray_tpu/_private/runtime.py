@@ -48,13 +48,15 @@ class ObjectRef:
     """Handle to a (possibly pending) object (reference: ObjectRef /
     ``ObjectID`` + distributed refcount in ``reference_count.h``).
 
-    GC model (round-1, conservative): refs created by this process (put /
-    task-return) participate in the owner's refcount and the object is evicted
-    when the count plus pending-task pins reaches zero. A ref that crosses a
-    serialization boundary (returned from a task, stored inside another
-    object, sent to an actor) pins its object for the session — safe, at the
-    cost of holding such objects until shutdown. Full borrower accounting is a
-    later-round feature.
+    GC model: every live ObjectRef instance — including ones that crossed a
+    serialization boundary — holds one count at the owner, released on GC.
+    Serialization uses a borrow protocol (``reference_count.h:61-115``
+    borrower bookkeeping, simplified): ``__reduce__`` takes a nonce-tagged
+    transit count (``borrow_begin``); the first deserialization claims it
+    (``borrow_claim`` — no double count), later deserializations of the same
+    pickle (e.g. a retried task's args) each add their own. A serialized ref
+    that is never deserialized leaks its transit count — bounded by dropped
+    messages, vs. the reference's full borrower-death tracking.
     """
 
     __slots__ = ("_id", "_owned", "__weakref__")
@@ -86,12 +88,16 @@ class ObjectRef:
                 pass
 
     def __reduce__(self):
+        nonce = None
         if _ctx is not None and not _ctx.closed:
             try:
-                _ctx.call("add_ref", obj_id=self._id)  # permanent pin (see class doc)
+                import os as _os
+
+                nonce = _os.urandom(8)
+                _ctx.call("borrow_begin", obj_id=self._id, nonce=nonce)
             except Exception:
-                pass
-        return (_deserialized_ref, (self._id,))
+                nonce = None
+        return (_deserialized_ref, (self._id, nonce))
 
     def future(self):
         """concurrent.futures.Future view of this ref."""
@@ -109,8 +115,18 @@ class ObjectRef:
         return fut
 
 
-def _deserialized_ref(id_bytes: bytes) -> ObjectRef:
-    return ObjectRef(id_bytes, owned=False)
+def _deserialized_ref(id_bytes: bytes, nonce: bytes = None) -> ObjectRef:
+    if nonce is None:
+        return ObjectRef(id_bytes, owned=False)  # pre-borrow pickles / no ctx
+    ref = ObjectRef(id_bytes, owned=True)  # this holder releases on GC
+    if _ctx is not None and not _ctx.closed:
+        try:
+            _ctx.call("borrow_claim", obj_id=id_bytes, nonce=nonce)
+        except Exception:
+            ref._owned = False
+    else:
+        ref._owned = False
+    return ref
 
 
 # --------------------------------------------------------------------------
@@ -119,6 +135,7 @@ def _deserialized_ref(id_bytes: bytes) -> ObjectRef:
 class BaseContext:
     def __init__(self):
         self.closed = False
+        self.remote = False  # True = different host than the head (no shm)
         self._uploaded_funcs: set[bytes] = set()
         self._readers: dict[bytes, ShmReader] = {}
         self._readers_lock = threading.Lock()
@@ -157,15 +174,25 @@ class BaseContext:
             out.append(value)
         return out
 
-    def _materialize(self, obj_id: bytes, locator):
+    def _materialize(self, obj_id: bytes, locator, _retry: bool = True):
         kind, payload, is_err = locator
         if kind == "inline":
             return ser.deserialize_value(ser.SerializedValue.from_bytes(payload))
         with self._readers_lock:
             reader = self._readers.get(obj_id)
             if reader is None:
-                reader = ShmReader(payload)
-                self._readers[obj_id] = reader
+                try:
+                    reader = ShmReader(payload)
+                except FileNotFoundError:
+                    # segment spilled/unlinked between the head handing out
+                    # this locator and us attaching — re-fetch once (the head
+                    # restores spilled objects transparently)
+                    if not _retry:
+                        raise
+                    reader = None
+        if reader is None:
+            fresh = self.call("get", obj_ids=[obj_id], timeout=None)[0]
+            return self._materialize(obj_id, fresh, _retry=False)
         value = reader.read()
         self._sweep_readers()
         return value
@@ -263,12 +290,18 @@ class DriverContext(BaseContext):
 
 
 class WorkerContext(BaseContext):
-    """Runs in worker processes; control plane over the head socket."""
+    """Runs in worker processes; control plane over the head socket.
 
-    def __init__(self, conn, node_id_bin: bytes):
+    ``remote=True`` marks a process on a DIFFERENT host than the head: all
+    object payloads travel inline over the socket (the head's shm segments
+    are unreachable), and the head converts in both directions.
+    """
+
+    def __init__(self, conn, node_id_bin: bytes, remote: bool = False):
         super().__init__()
         self.conn = conn
         self.node_id_bin = node_id_bin
+        self.remote = remote
         self._seq = itertools.count(1)
         self._send_lock = threading.Lock()
         self._pending: dict[int, list] = {}
@@ -313,7 +346,9 @@ class WorkerContext(BaseContext):
 
     def put_serialized(self, sv, is_error=False) -> bytes:
         obj_id = ObjectID.for_put().binary()
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size or self.remote:
+            # remote: shm written here would be invisible to the head's host;
+            # ship bytes — the head re-lays oversized payloads into ITS shm
             self.call("put", obj_id=obj_id, small=sv.to_bytes(), shm=None, is_error=is_error)
         else:
             from ray_tpu._private.shm_store import write_shm
@@ -321,3 +356,33 @@ class WorkerContext(BaseContext):
             loc = write_shm(sv)
             self.call("put", obj_id=obj_id, small=None, shm=loc, is_error=is_error)
         return obj_id
+
+
+class RemoteDriverContext(WorkerContext):
+    """A driver attached to a head in ANOTHER process/host over TCP
+    (reference: ``ray.init(address=...)`` connecting to a running cluster).
+    Same RPC surface as a worker, plus its own response pump (workers get
+    theirs from worker_main's recv loop)."""
+
+    def __init__(self, conn, node_id_bin: bytes):
+        super().__init__(conn, node_id_bin, remote=True)
+        self._pump = threading.Thread(
+            target=self._pump_loop, name="driver-pump", daemon=True
+        )
+        self._pump.start()
+
+    def _pump_loop(self):
+        while not self.closed:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg[0] == "resp":
+                _, seq, ok, payload = msg
+                self.on_response(seq, ok, payload)
+
+    def shutdown(self):
+        super().shutdown()
+        from ray_tpu._private.node_agent import shutdown_conn
+
+        shutdown_conn(self.conn)  # interrupts the pump thread's recv too

@@ -45,14 +45,30 @@ class WorkerState:
         self.task_threads: dict[bytes, int] = {}
 
 
-def main(socket_path: str, authkey: bytes, node_id_bin: bytes):
+def connect_head(address: str, authkey: bytes):
+    """Open the head control socket: ``host:port`` → TCP, else AF_UNIX."""
     from multiprocessing.connection import Client
 
-    conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
-    ctx = WorkerContext(conn, node_id_bin)
+    if ":" in address and not address.startswith("/"):
+        host, port = address.rsplit(":", 1)
+        return Client((host, int(port)), authkey=authkey)
+    return Client(address, family="AF_UNIX", authkey=authkey)
+
+
+def main(
+    socket_path: str,
+    authkey: bytes,
+    node_id_bin: bytes,
+    token: str = "",
+    remote: bool = False,
+):
+    conn = connect_head(socket_path, authkey)
+    ctx = WorkerContext(conn, node_id_bin, remote=remote)
     set_ctx(ctx)
     state = WorkerState(ctx)
-    ctx.send_raw(("register", {"pid": os.getpid(), "node_id": node_id_bin}))
+    ctx.send_raw(
+        ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
+    )
 
     recv = threading.Thread(target=_recv_loop, args=(conn, ctx, state), daemon=True)
     recv.start()
@@ -175,7 +191,9 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
         except Exception as e:  # unserializable return
             sv = ser.serialize(rex.RayTaskError.from_exception(spec.get("name", "task"), e))
             is_error = True
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size or state.ctx.remote:
+            # remote workers always inline: their shm lives on another host;
+            # the head re-lays oversized inlines into ITS shm on receipt
             results.append((rid, ("inline", sv.to_bytes(), is_error)))
         else:
             from ray_tpu._private.shm_store import write_shm
@@ -229,7 +247,15 @@ def _cli_main():
     import sys
 
     socket_path, authkey_hex, node_id_hex = sys.argv[1], sys.argv[2], sys.argv[3]
-    main(socket_path, bytes.fromhex(authkey_hex), bytes.fromhex(node_id_hex))
+    token = sys.argv[4] if len(sys.argv) > 4 else ""
+    remote = len(sys.argv) > 5 and sys.argv[5] == "--remote"
+    main(
+        socket_path,
+        bytes.fromhex(authkey_hex),
+        bytes.fromhex(node_id_hex),
+        token=token,
+        remote=remote,
+    )
 
 
 def _run_actor_create(state: WorkerState, spec: dict):

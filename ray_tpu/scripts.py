@@ -1,0 +1,169 @@
+"""CLI: ``python -m ray_tpu <command>``.
+
+Reference: ``python/ray/scripts/scripts.py:566`` (``ray start --head`` /
+``ray start --address=`` node launcher) and the state CLI
+(``util/state/state_cli.py`` — ``ray summary``, ``ray list``, ``ray
+timeline``). The head command hosts the cluster head in THIS process
+(listening on unix socket + TCP); the node command joins this machine to a
+remote head via the node agent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+
+def _parse_resources(raw):
+    res = json.loads(raw) if raw else {}
+    if not isinstance(res, dict):
+        raise SystemExit("--resources must be a JSON object")
+    return res
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private.config import resolve_authkey
+
+    authkey = resolve_authkey()
+    if args.head:
+        from ray_tpu._private.head import Head
+
+        session = tempfile.mkdtemp(prefix="ray_tpu_head_")
+        head = Head(os.path.join(session, "head.sock"), authkey=authkey)
+        head.start()
+        host, port = head.listen_tcp(args.host, args.port)
+        res = _parse_resources(args.resources)
+        res.setdefault("CPU", float(args.num_cpus or os.cpu_count() or 1))
+        from ray_tpu.accelerators import tpu as tpu_accel
+
+        chips = tpu_accel.detect_num_chips()
+        if chips:
+            res.setdefault("TPU", float(chips))
+        head.add_node(res)
+        print(f"ray_tpu head listening on {host}:{port}")
+        print(f"  attach a node:   python -m ray_tpu start --address={host}:{port}")
+        print(f"  attach a driver: ray_tpu.init(address=\"{host}:{port}\")")
+        sys.stdout.flush()
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.2)
+        finally:
+            head.shutdown()
+        return 0
+
+    if not args.address:
+        raise SystemExit("pass --head to start a head, or --address=HOST:PORT to join one")
+    from ray_tpu._private.node_agent import NodeAgent
+
+    res = _parse_resources(args.resources)
+    if args.num_cpus:
+        res.setdefault("CPU", float(args.num_cpus))
+    agent = NodeAgent(args.address, authkey, resources=res or None)
+    print(f"ray_tpu node joined {args.address} as {agent.node_id_bin.hex()[:12]}")
+    sys.stdout.flush()
+    agent.run()
+    return 0
+
+
+def _attached(address):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    print(json.dumps(state.summary(), indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "objects": state.list_objects,
+        "nodes": state.list_nodes,
+        "placement-groups": state.list_placement_groups,
+    }[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    trace = state.timeline(args.output)
+    print(f"wrote {len(trace)} events to {args.output}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _attached(args.address)
+    print(json.dumps(
+        {
+            "nodes": ray_tpu.nodes(),
+            "cluster_resources": ray_tpu.cluster_resources(),
+            "available_resources": ray_tpu.available_resources(),
+        },
+        indent=2,
+        default=str,
+    ))
+    ray_tpu.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or join a cluster as a node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--address", help="HOST:PORT of a running head (node mode)")
+    p.add_argument("--num-cpus", type=int)
+    p.add_argument("--resources", help="JSON resource dict")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("summary", help="cluster state summary")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("list", help="list tasks/actors/objects/nodes/placement-groups")
+    p.add_argument("kind", choices=["tasks", "actors", "objects", "nodes", "placement-groups"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="export a chrome://tracing task timeline")
+    p.add_argument("--address", required=True)
+    p.add_argument("--output", default="ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("status", help="nodes + resource totals")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

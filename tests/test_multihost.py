@@ -1,0 +1,199 @@
+"""Multi-host control plane: TCP transport, node agents, remote drivers.
+
+Reference: ``python/ray/_private/services.py:1421,1485`` (head + node
+launchers), ``scripts/scripts.py:566`` (``ray start``), and the two-node
+cluster fixtures of ``python/ray/tests/conftest.py``. Here "hosts" are
+separate processes on loopback TCP — the same wire path a real second host
+uses (workers/agents never touch the head's unix socket or shm).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import resolve_authkey
+from ray_tpu._private.head import Head
+from ray_tpu._private.node_agent import NodeAgent
+
+
+@pytest.fixture
+def tcp_cluster():
+    """In-process head with a TCP listener + one agent 'host' (CPU:2);
+    the head node itself has no CPU so all tasks land on the agent node."""
+    authkey = resolve_authkey()
+    session = tempfile.mkdtemp(prefix="ray_tpu_tcp_")
+    head = Head(os.path.join(session, "head.sock"), authkey=authkey)
+    head.start()
+    host, port = head.listen_tcp("127.0.0.1", 0)
+    head.add_node({"CPU": 0.0})
+    agent = NodeAgent(f"{host}:{port}", authkey, resources={"CPU": 2.0}).start()
+    yield {"head": head, "agent": agent, "address": f"{host}:{port}"}
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    agent.shutdown()
+    head.shutdown()
+
+
+def test_tasks_run_on_remote_node(tcp_cluster):
+    ray_tpu.init(address=tcp_cluster["address"])
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(6)], timeout=60))
+    assert nodes == {tcp_cluster["agent"].node_id_bin.hex()}
+
+
+def test_large_objects_cross_the_wire(tcp_cluster):
+    ray_tpu.init(address=tcp_cluster["address"])
+    big = np.arange(400_000, dtype=np.float64)  # ~3.2MB >> inline threshold
+    ref = ray_tpu.put(big)
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=60), big)
+
+    @ray_tpu.remote
+    def transform(x):
+        return x * 2.0
+
+    out = ray_tpu.get(transform.remote(ref), timeout=60)
+    np.testing.assert_array_equal(out, big * 2.0)
+
+
+def test_actor_on_remote_node_with_state(tcp_cluster):
+    ray_tpu.init(address=tcp_cluster["address"])
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.v = 0
+
+        def add(self, k):
+            self.v += k
+            return self.v
+
+    a = Acc.remote()
+    assert ray_tpu.get(a.add.remote(3), timeout=60) == 3
+    assert ray_tpu.get(a.add.remote(4), timeout=60) == 7
+
+
+def test_agent_death_removes_node(tcp_cluster):
+    ray_tpu.init(address=tcp_cluster["address"])
+
+    @ray_tpu.remote
+    def ping():
+        return 1
+
+    assert ray_tpu.get(ping.remote(), timeout=60) == 1
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 2
+    tcp_cluster["agent"].shutdown()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1:
+            break
+        time.sleep(0.2)
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
+
+
+def test_train_spreads_across_hosts(tcp_cluster):
+    """JaxTrainer with num_workers=2 SPREAD: one train worker per 'host'."""
+    # give the head node capacity so SPREAD has two viable nodes
+    tcp_cluster["head"].add_node({"CPU": 2.0})
+    ray_tpu.init(address=tcp_cluster["address"])
+
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    marker_dir = tempfile.mkdtemp(prefix="mh_marks_")
+
+    def loop():
+        import os as _os
+
+        import ray_tpu as rt
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        rank = ctx.get_world_rank()
+        node = rt.get_runtime_context().get_node_id()
+        with open(_os.path.join(loop.marker_dir, f"rank{rank}"), "w") as f:
+            f.write(node)
+        train.report({"rank": rank})
+
+    loop.marker_dir = marker_dir
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2, placement_strategy="SPREAD", resources_per_worker={"CPU": 1}
+        ),
+        run_config=RunConfig(storage_path=tempfile.mkdtemp(prefix="mh_train_")),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    nodes = {open(os.path.join(marker_dir, f"rank{r}")).read() for r in range(2)}
+    assert len(nodes) == 2, f"train workers were not spread across hosts: {nodes}"
+
+
+CLI_ENV = dict(os.environ, PYTHONPATH="/root/repo" + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+
+def test_cli_head_node_driver_roundtrip(tmp_path):
+    """The real deployment shape: `ray_tpu start --head` in one process,
+    `ray_tpu start --address` in another, driver + state CLI attach over TCP."""
+    head_proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head", "--port", "0", "--num-cpus", "0"],
+        stdout=subprocess.PIPE,
+        text=True,
+        env=CLI_ENV,
+    )
+    node_proc = None
+    try:
+        line = head_proc.stdout.readline()
+        assert "listening on" in line, line
+        address = line.strip().rsplit(" ", 1)[-1]
+        node_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start", "--address", address,
+             "--num-cpus", "2"],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=CLI_ENV,
+        )
+        assert "joined" in node_proc.stdout.readline()
+
+        ray_tpu.init(address=address)
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(4)], timeout=60) == [1, 2, 3, 4]
+        ray_tpu.shutdown()
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "summary", "--address", address],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=CLI_ENV,
+        )
+        assert out.returncode == 0, out.stderr
+        summ = json.loads(out.stdout)
+        assert summ["tasks"]["by_state"].get("FINISHED", 0) >= 4
+        assert len(summ["nodes"]) == 2
+    finally:
+        for p in (node_proc, head_proc):
+            if p is not None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
