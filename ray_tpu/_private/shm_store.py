@@ -78,6 +78,14 @@ def write_shm(sv: SerializedValue) -> ShmLocation:
     return loc
 
 
+def _quiet_close(shm: shared_memory.SharedMemory) -> None:
+    try:
+        shm.close()
+    except BufferError:
+        shm._buf = None
+        shm._mmap = None
+
+
 class ShmReader:
     """Attach to a segment and expose zero-copy out-of-band buffers.
 
@@ -90,6 +98,15 @@ class ShmReader:
         self.shm = shared_memory.SharedMemory(name=loc.name)
         _untrack(self.shm)
         self.loc = loc
+        # If this reader is GC'd while deserialized values still hold views
+        # into the mapping, SharedMemory.__del__ would raise BufferError as
+        # an unraisable error (noisy at exit; pytest's unraisable capture
+        # even retains the raising frame, pinning ObjectRefs). Close quietly
+        # first, disarming on failure — the segment is unlinked by the head,
+        # so a leaked mapping dies with the last process.
+        import weakref
+
+        weakref.finalize(self, _quiet_close, self.shm)
 
     def read(self):
         loc = self.loc
@@ -102,6 +119,21 @@ class ShmReader:
             off = _align(off + n)
         value = pickle.loads(header, buffers=bufs)
         return value
+
+    def read_serialized_bytes(self) -> bytes:
+        """Copy the segment back into wire format (for shipping an object to
+        a REMOTE node over the control socket — no shm across hosts)."""
+        from ray_tpu._private.serialization import SerializedValue
+
+        loc = self.loc
+        mv = self.shm.buf
+        header = bytes(mv[: loc.header_len])
+        bufs = []
+        off = _align(loc.header_len)
+        for n in loc.buffer_lens:
+            bufs.append(pickle.PickleBuffer(bytes(mv[off : off + n])))
+            off = _align(off + n)
+        return SerializedValue(header, bufs).to_bytes()
 
     def close(self):
         try:
