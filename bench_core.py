@@ -1,0 +1,139 @@
+"""Core runtime microbenchmarks, named after the reference's harness.
+
+Reference: ``python/ray/_private/ray_perf.py:93-315`` — the nightly
+microbenchmark suite whose metric names (single-client tasks sync/async,
+1:1 / 1:n actor calls, put/get throughput, ``ray.wait``) BASELINE.md asks
+this build to reproduce. Prints one JSON line per metric plus a combined
+line; ``python bench_core.py`` runs everything on a local cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name: str, fn, unit: str = "per_s", warmup=True) -> dict:
+    if warmup:
+        fn()
+    t0 = time.perf_counter()
+    n = fn()
+    dt = time.perf_counter() - t0
+    rec = {"metric": name, "value": round(n / dt, 2), "unit": unit}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> list[dict]:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    results = []
+
+    # -- tasks (ray_perf: "single client tasks sync/async") ----------------
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    def tasks_sync(n=200):
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        return n
+
+    def tasks_async(n=1000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit("single_client_tasks_sync", tasks_sync))
+    results.append(timeit("single_client_tasks_async", tasks_async))
+
+    # -- actor calls (ray_perf: "1:1 actor calls sync/async", "1:n") -------
+    @ray_tpu.remote
+    class A:
+        def noop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.noop.remote())
+
+    def actor_sync(n=200):
+        for _ in range(n):
+            ray_tpu.get(a.noop.remote())
+        return n
+
+    def actor_async(n=1000):
+        ray_tpu.get([a.noop.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit("single_client_actor_calls_sync", actor_sync))
+    results.append(timeit("single_client_actor_calls_async", actor_async))
+
+    actors = [A.remote() for _ in range(4)]
+    ray_tpu.get([x.noop.remote() for x in actors])
+
+    def actor_one_to_n(n=250):
+        ray_tpu.get([x.noop.remote() for x in actors for _ in range(n)])
+        return n * len(actors)
+
+    results.append(timeit("client_1_to_4_actor_calls_async", actor_one_to_n))
+
+    # -- object plane (ray_perf: put/get GB/s) -----------------------------
+    small = np.zeros(1024, np.uint8)
+
+    def put_small(n=500):
+        for _ in range(n):
+            ray_tpu.put(small)
+        return n
+
+    results.append(timeit("single_client_put_calls_1kb", put_small))
+
+    big = np.zeros(10 * 1024 * 1024, np.uint8)  # 10 MB
+
+    def put_gigabytes(n=20):
+        refs = [ray_tpu.put(big) for _ in range(n)]
+        ray_tpu.get(refs[-1])
+        return n * big.nbytes / 1e9
+
+    results.append(timeit("single_client_put_gigabytes", put_gigabytes, unit="GB_per_s"))
+
+    refs_big = [ray_tpu.put(big) for _ in range(8)]
+
+    def get_gigabytes(n=40):
+        total = 0
+        for i in range(n):
+            out = ray_tpu.get(refs_big[i % len(refs_big)])
+            total += int(out[::65536].sum())  # touch pages: measure real reads
+        return n * big.nbytes / 1e9
+
+    results.append(timeit("single_client_get_gigabytes", get_gigabytes, unit="GB_per_s"))
+
+    # -- wait (ray_perf: "1:1 ray.wait on 1k refs") ------------------------
+    refs_1k = [noop.remote() for _ in range(1000)]
+    ray_tpu.get(refs_1k)
+
+    def wait_1k(n=100):
+        for _ in range(n):
+            ray_tpu.wait(refs_1k, num_returns=1000, timeout=10)
+        return n
+
+    results.append(timeit("single_client_wait_1k_refs", wait_1k))
+
+    ray_tpu.shutdown()
+    print(
+        json.dumps(
+            {
+                "metric": "core_microbench",
+                "value": len(results),
+                "unit": "metrics",
+                "detail": {r["metric"]: [r["value"], r["unit"]] for r in results},
+            }
+        ),
+        flush=True,
+    )
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -116,12 +116,16 @@ class BackendExecutor:
             # a worker can die before even acking start (instant user crash)
             raise TrainingWorkerError(-1, e, None) from e
 
-    def next_results(self, done_mask=None, timeout_per_wait: float = 1.0, deadline_s: float = 3600.0):
+    def next_results(self, done_mask=None, timeout_per_wait: float = 10.0, deadline_s: float = 3600.0):
         """One event from every not-yet-done worker (lockstep; reference
-        ``get_with_failure_handling``). Returns list of events (None for
-        workers already done); raises TrainingWorkerError on worker failure,
-        TimeoutError past ``deadline_s`` (guards against unequal report
-        counts across workers deadlocking the loop)."""
+        ``get_with_failure_handling``). Long-lived ``next_result`` futures
+        are consumed in completion order via ``ray_tpu.wait`` — one in-flight
+        call per worker instead of a 1 Hz poll per worker (the reference uses
+        futures the same way; a polling loop is a control-plane storm at
+        64-host scale). Returns list of events (None for workers already
+        done); raises TrainingWorkerError on worker failure, TimeoutError
+        past ``deadline_s`` (guards against unequal report counts across
+        workers deadlocking the loop)."""
         import time as _time
 
         assert self.wg is not None
@@ -129,6 +133,7 @@ class BackendExecutor:
         pending = {
             i for i in range(len(self.wg.workers)) if not (done_mask and done_mask[i])
         }
+        futures: dict = {}  # future -> worker index
         deadline = _time.monotonic() + deadline_s
         while pending:
             if _time.monotonic() > deadline:
@@ -137,18 +142,24 @@ class BackendExecutor:
                     f"{deadline_s}s — check that every worker calls "
                     f"ray_tpu.train.report() the same number of times"
                 )
-            for i in sorted(pending):
-                w = self.wg.workers[i]
-                try:
-                    ev = ray_tpu.get(w.next_result.remote(timeout_per_wait))
-                except Exception as e:  # actor died
-                    raise TrainingWorkerError(self.wg.ranks[i], e, None) from e
-                if ev is None:
-                    continue
-                if ev[0] == "error":
-                    raise TrainingWorkerError(self.wg.ranks[i], ev[1], ev[2])
-                events[i] = ev
-                pending.discard(i)
+            inflight = set(futures.values())
+            for i in sorted(pending - inflight):
+                futures[self.wg.workers[i].next_result.remote(timeout_per_wait)] = i
+            ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=5.0)
+            if not ready:
+                continue
+            fut = ready[0]
+            i = futures.pop(fut)
+            try:
+                ev = ray_tpu.get(fut)
+            except Exception as e:  # actor died
+                raise TrainingWorkerError(self.wg.ranks[i], e, None) from e
+            if ev is None:
+                continue  # worker had nothing within timeout_per_wait; re-arm
+            if ev[0] == "error":
+                raise TrainingWorkerError(self.wg.ranks[i], ev[1], ev[2])
+            events[i] = ev
+            pending.discard(i)
         return events
 
     def shutdown(self):
