@@ -230,7 +230,9 @@ class SplitCoordinator:
     Reference: ``python/ray/data/_internal/execution/streaming_split``
     (SplitCoordinator). Each epoch re-runs the plan; consumers pull bundles
     round-robin-by-arrival; with ``equal=True`` every consumer sees the same
-    number of bundles (the tail is truncated).
+    number of bundles, and the trailing partial group is re-sliced at row
+    granularity so each consumer also sees the same number of rows (only the
+    sub-``n`` row remainder is dropped).
     """
 
     def __init__(self, plan, n: int, equal: bool):
@@ -271,11 +273,46 @@ class SplitCoordinator:
                 else:
                     queues[i % self._n].put(bundle.blocks_ref)
                     i += 1
+            if self._equal and pending:
+                self._split_remainder_rows(queues, pending)
             for qi in queues:
                 qi.put(None)
         except BaseException as e:  # noqa: BLE001
             for qi in queues:
                 qi.put(("__err__", repr(e)))
+
+    def _split_remainder_rows(self, queues, pending):
+        """equal=True tail: fewer trailing bundles than consumers. The
+        reference equalizes at ROW granularity (``streaming_split``
+        SplitCoordinator) — slice the leftover bundles' rows evenly across
+        all consumers instead of silently dropping them (with coarse
+        bundles that tail can be a large fraction of the epoch)."""
+        import ray_tpu
+        from ray_tpu.data.block import BlockAccessor
+
+        blocks = []
+        for ref in pending:
+            blocks.extend(ray_tpu.get(ref))
+        total = sum(BlockAccessor.for_block(b).num_rows() for b in blocks)
+        per = total // self._n
+        if per <= 0:
+            return  # fewer rows than consumers — nothing equal to hand out
+        parts: list[list] = [[] for _ in range(self._n)]
+        qi, need = 0, per
+        for b in blocks:
+            acc = BlockAccessor.for_block(b)
+            off, n_rows = 0, acc.num_rows()
+            while off < n_rows and qi < self._n:
+                take = min(need, n_rows - off)
+                parts[qi].append(acc.slice(off, off + take))
+                off += take
+                need -= take
+                if need == 0:
+                    qi += 1
+                    need = per
+        for q, blks in zip(queues, parts):
+            if blks:
+                q.put(ray_tpu.put(blks))
 
     def next_bundle(self, split_idx: int, epoch: int):
         """Blocking pull; returns a blocks_ref or None at end of epoch."""

@@ -112,6 +112,12 @@ def _block(cfg: GPTConfig, x, layer, mesh=None):
         return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
 
     impl = cfg.attn_impl
+    if impl == "ring" and mesh is None:
+        raise ValueError(
+            "attn_impl='ring' needs a device mesh with an 'sp' axis; pass "
+            "mesh= (or use attn_impl='auto', which picks ring only when the "
+            "mesh shards sequence)"
+        )
     if impl == "ring" or (
         impl == "auto" and mesh is not None and mesh.shape.get("sp", 1) > 1
     ):
@@ -119,24 +125,31 @@ def _block(cfg: GPTConfig, x, layer, mesh=None):
         from ray_tpu.ops.ring_attention import ring_attention_sharded
 
         att = ring_attention_sharded(heads(q), heads(k), heads(v), mesh)
-    elif (
-        impl in ("auto", "flash")
-        and mesh is not None
-        and mesh.size > 1
-        and s >= 128
-        and s % 128 == 0
-    ):
-        # multi-device pjit: shard_map the Pallas kernel so it runs on each
-        # chip's dp/tp shard instead of being replicated (no GSPMD rule for
-        # a bare pallas_call)
-        from ray_tpu.ops.flash_attention import flash_attention_sharded
-
-        try:
-            att = flash_attention_sharded(heads(q), heads(k), heads(v), mesh)
-        except ValueError:  # shapes don't divide the mesh — XLA partitions fine
-            att = causal_attention(heads(q), heads(k), heads(v), impl="xla")
     else:
-        att = causal_attention(heads(q), heads(k), heads(v), impl=impl)
+        from ray_tpu.ops.flash_attention import _interpret, flash_shardable
+
+        want_flash = impl == "flash" or (impl == "auto" and not _interpret())
+        if (
+            want_flash
+            and mesh is not None
+            and mesh.size > 1
+            and s >= 128
+            and s % 128 == 0
+            and flash_shardable(b, h, mesh)
+        ):
+            # multi-device pjit: shard_map the Pallas kernel so it runs on
+            # each chip's dp/tp shard instead of being replicated (no GSPMD
+            # rule for a bare pallas_call)
+            from ray_tpu.ops.flash_attention import flash_attention_sharded
+
+            att = flash_attention_sharded(heads(q), heads(k), heads(v), mesh)
+        elif want_flash and mesh is not None and mesh.size > 1:
+            # multi-device but not shardable (batch/heads don't divide the
+            # mesh): a bare pallas_call would replicate on every chip — the
+            # XLA einsum partitions correctly instead
+            att = causal_attention(heads(q), heads(k), heads(v), impl="xla")
+        else:
+            att = causal_attention(heads(q), heads(k), heads(v), impl=impl)
     att = att.transpose(0, 2, 1, 3).reshape(b, s, d)
     att = att @ layer["attn_out"]["kernel"].astype(dt) + layer["attn_out"]["bias"].astype(dt)
     x = x + c(att, P(("dp", "fsdp"), "sp", None))

@@ -40,12 +40,23 @@ def causal_attention(
 
     bf16-friendly with fp32 softmax accumulation on every path.
     """
+    if impl not in ("auto", "xla", "flash"):
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected 'auto', 'xla' or 'flash' "
+            "(sequence-parallel ring attention is ops.ring_attention, selected "
+            "by the model layer when the mesh shards sequence)"
+        )
     if impl == "xla":
         return _xla_attention(q, k, v)
     seq = q.shape[2]
-    if impl == "auto" and (seq < 128 or seq % 128):
-        # too small/ragged to tile the Pallas grid — XLA fuses these fine
-        return _xla_attention(q, k, v)
+    if impl == "auto":
+        from ray_tpu.ops.flash_attention import _interpret
+
+        if seq < 128 or seq % 128 or _interpret():
+            # ragged shapes can't tile the Pallas grid, and off-TPU the
+            # kernel would run interpreted (orders of magnitude slower than
+            # compiled XLA) — auto only picks flash where it wins
+            return _xla_attention(q, k, v)
     from ray_tpu.ops.flash_attention import flash_attention
 
     return flash_attention(q, k, v)

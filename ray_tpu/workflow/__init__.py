@@ -1,0 +1,203 @@
+"""ray_tpu.workflow: durable DAG execution with storage-backed checkpoints.
+
+Reference: ``python/ray/workflow/`` (``workflow_executor.py`` step loop +
+``workflow_storage.py`` persisted step results). A workflow is a
+``ray_tpu.dag`` graph executed step-by-step with every completed step's
+result persisted; re-running (or ``resume``-ing after a crash) skips steps
+whose results already exist on storage, so a workflow survives driver death
+at the granularity of one step.
+
+    from ray_tpu import workflow
+
+    dag = train.bind(prepare.bind(cfg))
+    out = workflow.run(dag, workflow_id="exp1", storage="/data/wf")
+    # crash anywhere -> workflow.resume("exp1", storage="/data/wf")
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+
+_DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+STATUS_RUNNING = "RUNNING"
+STATUS_SUCCESSFUL = "SUCCESSFUL"
+STATUS_FAILED = "FAILED"
+
+
+class _Store:
+    def __init__(self, storage: str, workflow_id: str):
+        self.dir = os.path.join(storage, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _meta_path(self):
+        return os.path.join(self.dir, "meta.json")
+
+    def write_meta(self, **kwargs):
+        meta = self.read_meta()
+        meta.update(kwargs)
+        meta["updated_at"] = time.time()
+        with open(self._meta_path(), "w") as f:
+            json.dump(meta, f)
+
+    def read_meta(self) -> dict:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", step_id + ".pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any):
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))  # atomic commit
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_graph(self, dag: DAGNode, input_args: tuple):
+        import cloudpickle  # graphs close over user functions
+
+        with open(os.path.join(self.dir, "graph.pkl"), "wb") as f:
+            cloudpickle.dump((dag, input_args), f)
+
+    def load_graph(self):
+        with open(os.path.join(self.dir, "graph.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def _step_ids(dag: DAGNode) -> dict[int, str]:
+    """Deterministic id per node: function name + topological index +
+    structure hash — stable across process restarts for the same graph."""
+    order: list[DAGNode] = []
+    seen: set[int] = set()
+
+    def walk(node):
+        if not isinstance(node, DAGNode) or id(node) in seen:
+            return
+        seen.add(id(node))
+        for v in list(node._bound_args) + list(node._bound_kwargs.values()):
+            walk(v)
+        order.append(node)
+
+    walk(dag)
+    ids: dict[int, str] = {}
+    for idx, node in enumerate(order):
+        name = type(node).__name__
+        if isinstance(node, FunctionNode):
+            name = getattr(getattr(node, "_fn", None), "_name", None) or getattr(
+                getattr(node._fn, "_function", None), "__name__", "fn"
+            )
+        ids[id(node)] = f"{idx:03d}_{name}_{hashlib.sha1(name.encode()).hexdigest()[:6]}"
+    return ids
+
+
+def _execute_durable(dag: DAGNode, input_args: tuple, store: _Store) -> Any:
+    ids = _step_ids(dag)
+    memo: dict = {}
+    inputs = list(input_args)
+    for node in dag._collect_inputs():
+        memo[id(node)] = inputs.pop(0) if inputs else None
+
+    def run_node(node: DAGNode):
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        step_id = ids[key]
+        if store.has_step(step_id):
+            memo[key] = store.load_step(step_id)  # checkpointed — skip
+            return memo[key]
+        args = [run_node(a) if isinstance(a, DAGNode) else a for a in node._bound_args]
+        kwargs = {
+            k: (run_node(v) if isinstance(v, DAGNode) else v)
+            for k, v in node._bound_kwargs.items()
+        }
+        if isinstance(node, MultiOutputNode):
+            value = list(args)
+        elif isinstance(node, FunctionNode):
+            # each step runs as a task; its materialized result is the
+            # durability unit (reference: one checkpoint per workflow task)
+            value = ray_tpu.get(node._fn.remote(*args, **kwargs))
+        else:
+            value = node._execute_impl({})
+        store.save_step(step_id, value)
+        memo[key] = value
+        return value
+
+    return run_node(dag)
+
+
+def run(
+    dag: DAGNode,
+    *input_args,
+    workflow_id: Optional[str] = None,
+    storage: Optional[str] = None,
+) -> Any:
+    """Execute a DAG durably; returns the final result (reference:
+    ``workflow.run``)."""
+    workflow_id = workflow_id or f"wf_{int(time.time() * 1000):x}"
+    store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
+    store.save_graph(dag, input_args)
+    store.write_meta(status=STATUS_RUNNING, workflow_id=workflow_id)
+    try:
+        out = _execute_durable(dag, input_args, store)
+    except BaseException:
+        store.write_meta(status=STATUS_FAILED)
+        raise
+    store.write_meta(status=STATUS_SUCCESSFUL)
+    store.save_step("__output__", out)
+    return out
+
+
+def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Re-drive an interrupted workflow; completed steps are loaded from
+    storage, remaining steps execute (reference: ``workflow.resume``)."""
+    store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
+    if store.has_step("__output__"):
+        return store.load_step("__output__")
+    dag, input_args = store.load_graph()
+    store.write_meta(status=STATUS_RUNNING)
+    try:
+        out = _execute_durable(dag, input_args, store)
+    except BaseException:
+        store.write_meta(status=STATUS_FAILED)
+        raise
+    store.write_meta(status=STATUS_SUCCESSFUL)
+    store.save_step("__output__", out)
+    return out
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]:
+    return _Store(storage or _DEFAULT_STORAGE, workflow_id).read_meta().get("status")
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
+    if not store.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no output (not finished?)")
+    return store.load_step("__output__")
+
+
+def list_all(storage: Optional[str] = None) -> list[tuple[str, Optional[str]]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    if os.path.isdir(root):
+        for wid in sorted(os.listdir(root)):
+            out.append((wid, get_status(wid, root)))
+    return out

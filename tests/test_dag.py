@@ -1,0 +1,93 @@
+"""Lazy DAG tests (reference: ``python/ray/dag/tests`` themes: bind/execute,
+InputNode substitution, diamond graphs, MultiOutputNode)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+def test_bind_and_execute_chain(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), 10)
+    assert ray_tpu.get(dag.execute(), timeout=120) == 30
+
+
+def test_input_node_threads_runtime_value(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(5), timeout=120) == 11
+    assert ray_tpu.get(dag.execute(100), timeout=120) == 201  # reusable
+
+
+def test_diamond_executes_shared_node_once(ray_start_regular):
+    calls = []
+
+    @ray_tpu.remote
+    class Tracker:
+        def __init__(self):
+            self.n = 0
+
+        def hit(self):
+            self.n += 1
+            return self.n
+
+        def count(self):
+            return self.n
+
+    t = Tracker.remote()
+
+    @ray_tpu.remote
+    def source(tracker):
+        return ray_tpu.get(tracker.hit.remote())
+
+    @ray_tpu.remote
+    def left(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def right(x):
+        return x + 2
+
+    @ray_tpu.remote
+    def join(a, b):
+        return (a, b)
+
+    s = source.bind(t)
+    dag = join.bind(left.bind(s), right.bind(s))
+    out = ray_tpu.get(dag.execute(), timeout=120)
+    assert out == (2, 3)
+    # the shared upstream ran exactly once
+    assert ray_tpu.get(t.count.remote(), timeout=120) == 1
+
+
+def test_multi_output_node(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * x
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([f.bind(inp), f.bind(3)])
+    refs = dag.execute(2)
+    assert ray_tpu.get(refs, timeout=120) == [4, 9]
+
+
+def test_executing_input_node_directly_errors(ray_start_regular):
+    inp = InputNode()
+    with pytest.raises(RuntimeError, match="InputNode has no value"):
+        inp.execute()

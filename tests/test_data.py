@@ -329,3 +329,26 @@ def test_zip_tensor_shapes_and_collisions(ray_start_regular):
     batch = a.zip(b).take_batch(6)
     assert batch["data"].shape == (6, 2, 2)
     assert batch["data_1"].shape == (6, 3)
+
+
+def test_streaming_split_equal_splits_remainder_rows(ray_start_regular):
+    """equal=True with a bundle count not divisible by n: the trailing
+    bundles' ROWS are re-sliced across consumers instead of dropped
+    (reference: SplitCoordinator equalizes at row granularity)."""
+    import threading
+
+    # 5 bundles of 10 rows, 2 consumers: 2 full rounds (4 bundles) + 1
+    # leftover bundle whose 10 rows must split 5/5
+    ds = rd.range(50, parallelism=5)
+    its = ds.streaming_split(2, equal=True)
+    results = [[], []]
+
+    def consume(i):
+        for b in its[i].iter_batches(batch_size=100, prefetch_batches=0):
+            results[i].extend(int(x) for x in b["id"])
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert len(results[0]) == len(results[1]) == 25
+    assert sorted(results[0] + results[1]) == list(range(50))

@@ -1,0 +1,64 @@
+"""ray_tpu.util.queue tests (reference: ``python/ray/tests/test_queue.py``)."""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_fifo_and_sizes(ray_start_regular):
+    q = Queue()
+    assert q.empty()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5 and not q.empty()
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_nowait_and_bounds(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put_nowait(1)
+    q.put_nowait(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get_nowait() == 1
+    q.shutdown()
+    q2 = Queue()
+    with pytest.raises(Empty):
+        q2.get_nowait()
+    q2.shutdown()
+
+
+def test_blocking_get_with_timeout(ray_start_regular):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get(timeout=0.5)
+    q.shutdown()
+
+
+def test_cross_task_producer_consumer(ray_start_regular):
+    q = Queue(maxsize=8)
+
+    @ray_tpu.remote
+    def produce(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    fut = produce.remote(q, 20)
+    got = [q.get(timeout=30) for _ in range(20)]
+    assert got == list(range(20))
+    assert ray_tpu.get(fut, timeout=30) == 20
+    q.shutdown()
+
+
+def test_batch_ops(ray_start_regular):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3, 4])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    assert q.get_nowait_batch(10) == [4]
+    q.shutdown()

@@ -1,0 +1,98 @@
+"""Workflow durability tests (reference: ``python/ray/workflow/tests``
+themes: run, checkpoint-per-step, resume-skips-done-steps, status)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture
+def wf_storage(tmp_path, ray_start_regular):
+    return str(tmp_path / "wf")
+
+
+def test_run_and_output(wf_storage):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    dag = inc.bind(double.bind(5))
+    out = workflow.run(dag, workflow_id="w1", storage=wf_storage)
+    assert out == 11
+    assert workflow.get_status("w1", wf_storage) == workflow.STATUS_SUCCESSFUL
+    assert workflow.get_output("w1", wf_storage) == 11
+    assert ("w1", workflow.STATUS_SUCCESSFUL) in workflow.list_all(wf_storage)
+
+
+def test_input_args_flow(wf_storage):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(inp, 10)
+    assert workflow.run(dag, 7, workflow_id="w2", storage=wf_storage) == 17
+
+
+def test_resume_skips_completed_steps(wf_storage):
+    """A step that fails once leaves earlier checkpoints; resume reruns only
+    the unfinished tail."""
+    marker = os.path.join(wf_storage, "fail_once")
+
+    @ray_tpu.remote
+    def expensive(x):
+        # count executions via a side file
+        path = os.environ["WF_COUNT_FILE"]
+        n = int(open(path).read()) if os.path.exists(path) else 0
+        with open(path, "w") as f:
+            f.write(str(n + 1))
+        return x * 10
+
+    @ray_tpu.remote
+    def flaky(x, marker):
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("x")
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    os.makedirs(wf_storage, exist_ok=True)
+    count_file = os.path.join(wf_storage, "count")
+    os.environ["WF_COUNT_FILE"] = count_file
+
+    dag = flaky.bind(expensive.bind(4), marker)
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w3", storage=wf_storage)
+    assert workflow.get_status("w3", wf_storage) == workflow.STATUS_FAILED
+    assert int(open(count_file).read()) == 1  # expensive ran once
+
+    out = workflow.resume("w3", wf_storage)
+    assert out == 41
+    assert int(open(count_file).read()) == 1  # NOT re-executed on resume
+    assert workflow.get_status("w3", wf_storage) == workflow.STATUS_SUCCESSFUL
+
+
+def test_resume_of_finished_workflow_returns_output(wf_storage):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w4", storage=wf_storage)
+    assert workflow.resume("w4", wf_storage) == 1
+
+
+def test_multi_output_workflow(wf_storage):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    dag = MultiOutputNode([sq.bind(2), sq.bind(3)])
+    assert workflow.run(dag, workflow_id="w5", storage=wf_storage) == [4, 9]
