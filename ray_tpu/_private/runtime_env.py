@@ -1,0 +1,124 @@
+"""Runtime environments: per-task/actor env_vars + working_dir.
+
+Reference: ``python/ray/_private/runtime_env/`` — the env system whose two
+workhorse features are ``env_vars`` and ``working_dir`` (zipped through the
+GCS KV, ``packaging.py``; extracted per node by the runtime-env agent).
+TPU-first simplification: no per-node agent daemon — the submitting process
+zips the directory into the head KV once (content-addressed), and workers
+extract it lazily into a per-key cache directory. ``env_vars`` apply for the
+duration of a task (and for an actor's whole life, since actors own their
+worker process).
+
+Supported keys: ``env_vars`` (dict str->str), ``working_dir`` (local path).
+Unknown keys raise at submission (fail fast, like the reference's
+validation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Optional
+
+_ALLOWED = {"env_vars", "working_dir"}
+_KV_PREFIX = "__runtime_env_pkg__/"
+_EXTRACT_CACHE: dict[str, str] = {}  # kv key -> extracted dir (per process)
+
+
+def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
+    """Validate + normalize at submission: working_dir is zipped into the
+    head KV (content-addressed, uploaded once)."""
+    if not runtime_env:
+        return None
+    unknown = set(runtime_env) - _ALLOWED
+    if unknown:
+        raise ValueError(
+            f"Unsupported runtime_env key(s) {sorted(unknown)}; "
+            f"supported: {sorted(_ALLOWED)}"
+        )
+    out: dict[str, Any] = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
+            raise TypeError("runtime_env['env_vars'] must be a dict[str, str]")
+        out["env_vars"] = dict(env_vars)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        if not os.path.isdir(wd):
+            raise ValueError(f"runtime_env['working_dir'] {wd!r} is not a directory")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, files in os.walk(wd):
+                for name in files:
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.relpath(full, wd))
+        blob = buf.getvalue()
+        key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
+        if ctx.call("kv_get", key=key) is None:
+            ctx.call("kv_put", key=key, value=blob)
+        out["working_dir_key"] = key
+    return out or None
+
+
+def _extract(key: str, ctx) -> str:
+    path = _EXTRACT_CACHE.get(key)
+    if path is not None and os.path.isdir(path):
+        return path
+    blob = ctx.call("kv_get", key=key)
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {key!r} missing from cluster KV")
+    path = os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_env_{key.rsplit('/', 1)[-1][:16]}"
+    )
+    if not os.path.isdir(path):
+        tmp = path + f".tmp{os.getpid()}"
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.replace(tmp, path)  # atomic vs concurrent extractors
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    _EXTRACT_CACHE[key] = path
+    return path
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[dict], ctx, permanent: bool = False):
+    """Worker-side application. ``permanent=True`` (actors) leaves the env
+    in place — the actor owns its process for life."""
+    if not runtime_env:
+        yield
+        return
+    saved_env: dict[str, Optional[str]] = {}
+    saved_cwd = os.getcwd()
+    saved_path = list(sys.path)
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        key = runtime_env.get("working_dir_key")
+        if key:
+            wd = _extract(key, ctx)
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)  # reference: working_dir is importable
+        yield
+    finally:
+        if not permanent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+            sys.path[:] = saved_path

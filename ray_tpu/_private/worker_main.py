@@ -45,14 +45,28 @@ class WorkerState:
         self.task_threads: dict[bytes, int] = {}
 
 
-def connect_head(address: str, authkey: bytes):
-    """Open the head control socket: ``host:port`` → TCP, else AF_UNIX."""
+def connect_head(address: str, authkey: bytes, retries: int = 3):
+    """Open the head control socket: ``host:port`` → TCP, else AF_UNIX.
+
+    The hmac challenge handshake can spuriously fail under heavy concurrent
+    connect churn (observed rarely in CI as ``digest sent was rejected``);
+    retry a few times before giving up (reference: worker registration
+    retries in worker_pool).
+    """
+    import time as _time
     from multiprocessing.connection import Client
 
-    if ":" in address and not address.startswith("/"):
-        host, port = address.rsplit(":", 1)
-        return Client((host, int(port)), authkey=authkey)
-    return Client(address, family="AF_UNIX", authkey=authkey)
+    last: Exception = RuntimeError("unreachable")
+    for attempt in range(retries):
+        try:
+            if ":" in address and not address.startswith("/"):
+                host, port = address.rsplit(":", 1)
+                return Client((host, int(port)), authkey=authkey)
+            return Client(address, family="AF_UNIX", authkey=authkey)
+        except Exception as e:  # noqa: BLE001 - auth/conn races
+            last = e
+            _time.sleep(0.1 * (attempt + 1))
+    raise last
 
 
 def main(
@@ -62,7 +76,11 @@ def main(
     token: str = "",
     remote: bool = False,
 ):
-    conn = connect_head(socket_path, authkey)
+    try:
+        conn = connect_head(socket_path, authkey)
+    except FileNotFoundError:
+        # cluster shut down while this worker was spawning — exit quietly
+        os._exit(0)
     ctx = WorkerContext(conn, node_id_bin, remote=remote)
     set_ctx(ctx)
     state = WorkerState(ctx)
@@ -203,6 +221,8 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
 
 
 def _run_task(state: WorkerState, spec: dict):
+    from ray_tpu._private import runtime_env as renv
+
     task_id = spec["task_id"]
     state.current_task_id = task_id
     state.task_threads[task_id] = threading.get_ident()
@@ -217,7 +237,8 @@ def _run_task(state: WorkerState, spec: dict):
         else:
             fn = _resolve_function(state, spec["func_id"])
             args, kwargs = _load_args(state, spec)
-            value = fn(*args, **kwargs)
+            with renv.applied(spec.get("runtime_env"), state.ctx):
+                value = fn(*args, **kwargs)
     except BaseException as e:  # noqa: BLE001
         if isinstance(e, rex.TaskCancelledError):
             value = e
@@ -259,10 +280,15 @@ def _cli_main():
 
 
 def _run_actor_create(state: WorkerState, spec: dict):
+    from ray_tpu._private import runtime_env as renv
+
     try:
         cls = _resolve_function(state, spec["func_id"])
         args, kwargs = _load_args(state, spec)
-        state.actor_instance = cls(*args, **kwargs)
+        # permanent: the actor owns this worker process for life, so its
+        # runtime env applies to every subsequent method call too
+        with renv.applied(spec.get("runtime_env"), state.ctx, permanent=True):
+            state.actor_instance = cls(*args, **kwargs)
         state.actor_id = spec["actor_id"]
         state.ctx.current_actor = spec["actor_id"].hex()  # for get_runtime_context()
         if spec.get("max_concurrency", 1) > 1:

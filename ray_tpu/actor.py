@@ -178,6 +178,10 @@ class ActorClass:
         if not options.get("name"):
             spec["name"] = None  # anonymous actors are not registered by name
         spec["class_name"] = self._cls.__name__
+        if options.get("runtime_env"):
+            from ray_tpu._private import runtime_env as renv
+
+            spec["runtime_env"] = renv.package(options["runtime_env"], ctx)
         for rid in return_ids:
             ctx.call("add_ref", obj_id=rid)
         try:

@@ -63,6 +63,10 @@ class RemoteFunction:
             "max_retries": options.get("max_retries", GLOBAL_CONFIG.default_max_retries),
             "name": options.get("name") or getattr(self._fn, "__qualname__", "task"),
         }
+        if options.get("runtime_env"):
+            from ray_tpu._private import runtime_env as renv
+
+            spec["runtime_env"] = renv.package(options["runtime_env"], ctx)
         refs = ctx.submit_task(spec)
         if num_returns == 1:
             return refs[0]

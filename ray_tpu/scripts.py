@@ -130,6 +130,37 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    from ray_tpu import job as joblib
+
+    ray_tpu = _attached(args.address)
+    try:
+        if args.action == "submit":
+            entry = list(args.entrypoint or [])
+            if entry and entry[0] == "--":
+                entry = entry[1:]  # strip only argparse's leading separator
+            if not entry:
+                raise SystemExit("job submit needs an entrypoint after --")
+            import shlex
+
+            jid = joblib.submit_job(" ".join(shlex.quote(a) for a in entry))
+            print(jid)
+        elif args.action == "list":
+            print(json.dumps(joblib.list_jobs(), indent=2, default=str))
+        else:
+            if not args.job_id:
+                raise SystemExit("--job-id required")
+            if args.action == "status":
+                print(joblib.get_job_status(args.job_id))
+            elif args.action == "logs":
+                sys.stdout.write(joblib.get_job_logs(args.job_id))
+            elif args.action == "stop":
+                print(joblib.stop_job(args.job_id))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -160,6 +191,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="nodes + resource totals")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("job", help="submit/inspect jobs on a running cluster")
+    p.add_argument("action", choices=["submit", "status", "logs", "stop", "list"])
+    p.add_argument("--address", required=True)
+    p.add_argument("--job-id")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="(submit) shell command, after --")
+    p.set_defaults(fn=cmd_job)
 
     args = parser.parse_args(argv)
     return args.fn(args)
