@@ -39,6 +39,16 @@ class Config:
     #: Max queued-but-infeasible warning interval.
     infeasible_warn_interval_s: float = 30.0
 
+    # -- memory monitor ----------------------------------------------------
+    #: Host memory usage fraction above which the OOM killer picks a victim
+    #: worker (reference: memory_monitor.h usage threshold, default 0.95).
+    memory_usage_threshold: float = 0.95
+    #: Memory monitor sampling interval; 0 disables the monitor (the
+    #: reference defaults to 250ms — conservative default here so co-tenant
+    #: CI machines running hot don't see spurious kills; enable via
+    #: _system_config or RAY_TPU env override).
+    memory_monitor_refresh_ms: int = 0
+
     # -- workers -----------------------------------------------------------
     #: Idle (non-actor) workers are reaped by the health loop after this many
     #: seconds without a task, when nothing is queued (reference: worker_pool

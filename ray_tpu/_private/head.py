@@ -295,6 +295,12 @@ class Head:
         h = threading.Thread(target=self._health_loop, name="head-health", daemon=True)
         h.start()
         self._threads.append(h)
+        if GLOBAL_CONFIG.memory_monitor_refresh_ms > 0:
+            m = threading.Thread(
+                target=self._memory_monitor_loop, name="head-memmon", daemon=True
+            )
+            m.start()
+            self._threads.append(m)
 
     def listen_tcp(self, host: str = "0.0.0.0", port: int = 0) -> tuple[str, int]:
         """Open the TCP control plane beside the unix socket (same message
@@ -555,6 +561,7 @@ class Head:
             wh.node.idle_workers.remove(wh)
         rec["worker"] = wh
         rec["state"] = "RUNNING"
+        rec["started_at"] = time.monotonic()  # OOM policy: newest-first victim
         self._event(rec, "RUNNING")
         if not wh.send(("run_task", rec["spec"])):
             self._handle_worker_death_locked(wh)
@@ -907,6 +914,71 @@ class Head:
             for wh in dead:
                 self._on_worker_dead(wh)
 
+    # ------------------------------------------------------- memory monitor
+
+    def memory_usage_fraction(self) -> float:
+        """Host memory usage in [0, 1]. Tests inject ``_memory_sampler``
+        (reference: memory_monitor.h reads cgroup/proc the same way)."""
+        sampler = getattr(self, "_memory_sampler", None)
+        if sampler is not None:
+            return sampler()
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    parts = line.split()
+                    info[parts[0].rstrip(":")] = int(parts[1])
+            total = info.get("MemTotal", 1)
+            avail = info.get("MemAvailable", total)
+            return 1.0 - avail / total
+        except Exception:
+            return 0.0
+
+    def _memory_monitor_loop(self):
+        """Kill a victim worker when host memory crosses the threshold
+        (reference: ``memory_monitor.h:52`` + retriable-FIFO policy in
+        ``worker_killing_policy_retriable_fifo.h:31``)."""
+        interval = GLOBAL_CONFIG.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                if self.memory_usage_fraction() < GLOBAL_CONFIG.memory_usage_threshold:
+                    continue
+                self._kill_for_memory()
+            except Exception:
+                pass
+
+    def _kill_for_memory(self):
+        with self.lock:
+            candidates = [
+                (wh, rec)
+                for node in self.nodes.values()
+                for wh in node.all_workers
+                if wh.alive
+                and (rec := wh.current_task) is not None
+                and rec["spec"]["kind"] == "task"
+            ]
+            if not candidates:
+                return
+            # retriable-FIFO: prefer a victim whose task can retry; among
+            # those, the most recently started (preserve older progress)
+            def key(item):
+                wh, rec = item
+                retriable = rec.get("retries_left", 0) != 0
+                return (retriable, rec.get("started_at", 0.0))
+
+            wh, rec = max(candidates, key=key)
+            rec["oom_killed"] = True
+            self._event(rec, "OOM_KILLED")
+        if wh.proc is not None:
+            try:
+                wh.proc.terminate()
+            except Exception:
+                pass
+        else:
+            wh.send(("exit", None))
+        self._on_worker_dead(wh)
+
     def _on_worker_disconnect(self, wh: WorkerHandle):
         if wh.proc is not None and wh.proc.is_alive():
             # Graceful exit or crash; health loop would catch it, but react now.
@@ -933,7 +1005,15 @@ class Head:
         rec = wh.current_task
         if rec is not None and rec["task_id"] in self.tasks and rec["spec"]["kind"] == "task":
             self.tasks.pop(rec["task_id"], None)
-            self._requeue_or_fail(rec, rex.WorkerCrashedError())
+            cause = (
+                rex.OutOfMemoryError(
+                    f"Task {rec['spec'].get('name')} was killed by the memory "
+                    f"monitor to relieve host memory pressure"
+                )
+                if rec.get("oom_killed")
+                else rex.WorkerCrashedError()
+            )
+            self._requeue_or_fail(rec, cause)
         if wh.actor_id is not None:
             self._on_actor_worker_death(wh.actor_id)
 
@@ -953,6 +1033,7 @@ class Head:
             rec["retries_left"] -= 1
             rec["state"] = "PENDING"
             rec["worker"] = None
+            rec.pop("oom_killed", None)  # fresh attempt, fresh failure cause
             spec.pop("_pg_bundle", None)
             self._event(rec, "RETRY")
             self.tasks[rec["task_id"]] = rec
@@ -1311,7 +1392,7 @@ class Head:
             ent.spill_path = None
             err = ser.serialize(
                 rex.ObjectLostError(
-                    f"spilled copy of {ObjectID(obj_id)} unreadable: {e!r}"
+                    ObjectID(obj_id).hex(), f"spilled copy unreadable: {e!r}"
                 )
             )
             ent.small = err.to_bytes()
@@ -1607,6 +1688,14 @@ class Head:
     def rpc_free_ref(self, obj_id):
         self.remove_ref(obj_id)
         return True
+
+    def rpc_tcp_address(self):
+        return self.tcp_address
+
+    def rpc_auth_info(self):
+        """Authkey (hex) for attach-back flows (job entrypoints). Callers of
+        this RPC already authenticated with the same key — no escalation."""
+        return self.authkey.hex()
 
     def rpc_borrow_begin(self, obj_id, nonce):
         """A ref is being serialized: hold one count for the transit window,

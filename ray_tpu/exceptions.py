@@ -114,3 +114,9 @@ class RuntimeEnvSetupError(RayError):
 
 class PlacementGroupSchedulingError(RayError):
     pass
+
+
+class OutOfMemoryError(RayError):
+    """The memory monitor killed this task's worker to relieve host memory
+    pressure (reference: ``worker_killing_policy.h`` + OOM-killed task
+    errors)."""

@@ -1,0 +1,64 @@
+"""Memory monitor + OOM killing (reference: ``src/ray/common/
+memory_monitor.h:52`` + ``worker_killing_policy_retriable_fifo.h:31``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime import get_ctx
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+@pytest.fixture
+def oom_cluster():
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "memory_monitor_refresh_ms": 50,
+            "memory_usage_threshold": 0.9,
+        },
+    )
+    yield get_ctx().head
+    ray_tpu.shutdown()
+
+
+def test_oom_kill_retries_then_fails(oom_cluster):
+    head = oom_cluster
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        time.sleep(30)
+        return "finished"
+
+    fut = hog.remote()
+    time.sleep(0.5)  # let it start
+    head._memory_sampler = lambda: 0.99  # inject pressure
+    try:
+        with pytest.raises(OutOfMemoryError):
+            ray_tpu.get(fut, timeout=60)
+    finally:
+        head._memory_sampler = None
+    events = [e for e in head.task_events if e["state"] == "OOM_KILLED"]
+    assert len(events) >= 2  # first run + its retry both OOM-killed
+
+
+def test_no_kill_below_threshold(oom_cluster):
+    head = oom_cluster
+    head._memory_sampler = lambda: 0.5
+
+    @ray_tpu.remote
+    def quick():
+        time.sleep(0.3)
+        return 7
+
+    try:
+        assert ray_tpu.get(quick.remote(), timeout=60) == 7
+    finally:
+        head._memory_sampler = None
+    assert not [e for e in head.task_events if e["state"] == "OOM_KILLED"]
+
+
+def test_memory_usage_fraction_reads_proc(oom_cluster):
+    frac = oom_cluster.memory_usage_fraction()
+    assert 0.0 <= frac <= 1.0
