@@ -1,0 +1,227 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference: ``rllib/algorithms/sac/sac.py`` (off-policy replay + twin-Q +
+squashed-gaussian policy + learned entropy temperature). TPU-first shape:
+policy, twin Q, target Q and log-alpha live in ONE parameter pytree updated
+by ONE jitted step — the three SAC objectives compose into a single loss
+with stop-gradients where the textbook uses separate optimizers, so the
+Learner's machinery (single pjit'd adam step, data-axis sharding) is reused
+unchanged. Target networks update by Polyak averaging after each step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rl.sample_batch import SampleBatch
+from ray_tpu.rl.spaces import Box
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1500
+        self.sample_steps_per_iter = 400
+        self.updates_per_iter = 200
+        self.train_batch_size = 256
+        self.tau = 0.005                  # polyak target update rate
+        self.initial_alpha = 0.1
+        self.target_entropy = "auto"      # -act_dim
+
+    algo_class = None  # set below
+
+
+class SACModule:
+    """Squashed-gaussian policy + twin Q (+targets) + log_alpha."""
+
+    discrete = False
+
+    def __init__(self, spec):
+        assert isinstance(spec.action_space, Box), "SAC needs a Box action space"
+        self.spec = spec
+        self.obs_dim = int(np.prod(spec.observation_space.shape))
+        self.act_dim = int(np.prod(spec.action_space.shape))
+        self.act_low = np.asarray(spec.action_space.low, np.float32).reshape(-1)
+        self.act_high = np.asarray(spec.action_space.high, np.float32).reshape(-1)
+
+    def init(self, rng):
+        kp, k1, k2 = jax.random.split(rng, 3)
+        h = list(self.spec.hidden)
+        q_sizes = [self.obs_dim + self.act_dim] + h + [1]
+        q1 = _mlp_init(k1, q_sizes, final_scale=1.0)
+        q2 = _mlp_init(k2, q_sizes, final_scale=1.0)
+        return {
+            "pi": _mlp_init(kp, [self.obs_dim] + h + [2 * self.act_dim]),
+            "q1": q1,
+            "q2": q2,
+            "target_q1": jax.tree_util.tree_map(jnp.copy, q1),
+            "target_q2": jax.tree_util.tree_map(jnp.copy, q2),
+            "log_alpha": jnp.asarray(np.log(0.1), jnp.float32),
+        }
+
+    # -- distributions -----------------------------------------------------
+
+    def _pi(self, params, obs):
+        out = _mlp_apply(params["pi"], obs, activation=jax.nn.relu)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        return mean, log_std
+
+    def _squash(self, u):
+        scale = (self.act_high - self.act_low) / 2.0
+        center = (self.act_high + self.act_low) / 2.0
+        return jnp.tanh(u) * scale + center
+
+    def sample_action_logp(self, params, obs, rng):
+        mean, log_std = self._pi(params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(rng, mean.shape)
+        # log-prob with tanh change of variables
+        logp_u = jnp.sum(
+            -0.5 * (((u - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1
+        )
+        logp = logp_u - jnp.sum(2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+        return self._squash(u), logp
+
+    def sample_action(self, params, obs, rng):
+        """EnvRunner interface: (action, logp, value-placeholder)."""
+        a, logp = self.sample_action_logp(params, obs, rng)
+        return a, logp, jnp.zeros(a.shape[:-1], jnp.float32)
+
+    def q_values(self, params, obs, act, target=False):
+        x = jnp.concatenate([obs, act], axis=-1)
+        k1, k2 = ("target_q1", "target_q2") if target else ("q1", "q2")
+        q1 = _mlp_apply(params[k1], x, activation=jax.nn.relu)[..., 0]
+        q2 = _mlp_apply(params[k2], x, activation=jax.nn.relu)[..., 0]
+        return q1, q2
+
+
+def sac_loss(gamma: float, target_entropy: float):
+    def loss_fn(module: SACModule, params, batch):
+        obs, act = batch[sb.OBS], batch[sb.ACTIONS]
+        next_obs = batch[sb.NEXT_OBS]
+        rew = batch[sb.REWARDS]
+        done = batch[sb.TERMINATEDS].astype(jnp.float32)
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), batch["step"][0])
+        alpha = jnp.exp(params["log_alpha"])
+
+        # -- critic target (no gradients) ---------------------------------
+        next_a, next_logp = module.sample_action_logp(
+            jax.lax.stop_gradient(params), next_obs, jax.random.fold_in(rng, 1)
+        )
+        tq1, tq2 = module.q_values(params, next_obs, next_a, target=True)
+        target_v = jnp.minimum(tq1, tq2) - jax.lax.stop_gradient(alpha) * next_logp
+        target = jax.lax.stop_gradient(rew + gamma * (1.0 - done) * target_v)
+        q1, q2 = module.q_values(params, obs, act)
+        q_loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+        # -- actor (Q params frozen) --------------------------------------
+        pi_a, pi_logp = module.sample_action_logp(params, obs, jax.random.fold_in(rng, 2))
+        fq1, fq2 = module.q_values(jax.lax.stop_gradient(params), obs, pi_a)
+        pi_loss = jnp.mean(jax.lax.stop_gradient(alpha) * pi_logp - jnp.minimum(fq1, fq2))
+
+        # -- temperature ---------------------------------------------------
+        alpha_loss = -jnp.mean(
+            params["log_alpha"] * jax.lax.stop_gradient(pi_logp + target_entropy)
+        )
+
+        total = q_loss + pi_loss + alpha_loss
+        return total, {
+            "q_loss": q_loss,
+            "pi_loss": pi_loss,
+            "alpha": alpha,
+            "entropy": -jnp.mean(pi_logp),
+        }
+
+    return loss_fn
+
+
+def _polyak(tau: float):
+    def update(learner):
+        p = dict(learner.params)
+        for src, dst in (("q1", "target_q1"), ("q2", "target_q2")):
+            p[dst] = jax.tree_util.tree_map(
+                lambda t, s: (1.0 - tau) * t + tau * s, p[dst], p[src]
+            )
+        learner.params = p
+        return True
+
+    return update
+
+
+class SAC(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "SACConfig":
+        return SACConfig()
+
+    def _module_cls(self):
+        return SACModule
+
+    def _setup(self):
+        cfg: SACConfig = self.config
+        obs_space, act_space = self.foreach_runner("get_spaces")[0]
+        from ray_tpu.rl.rl_module import RLModuleSpec
+
+        spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
+        tgt_ent = (
+            -float(np.prod(act_space.shape))
+            if cfg.target_entropy == "auto"
+            else float(cfg.target_entropy)
+        )
+        self.learner_group = LearnerGroup(
+            dict(
+                module_factory=lambda: SACModule(spec),
+                loss_fn=sac_loss(cfg.gamma, tgt_ent),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed or 0,
+            ),
+            remote=cfg.remote_learner,
+        )
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._update_step = 0
+        self.sync_weights(self.learner_group.get_weights())
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params):
+        self.learner_group.set_weights(params)
+        self.sync_weights(params)
+
+    def training_step(self) -> dict:
+        cfg: SACConfig = self.config
+        n_runners = max(1, len(self._runner_actors) or 1)
+        n_envs = max(1, cfg.num_envs_per_env_runner)
+        vec_steps = max(1, cfg.sample_steps_per_iter // (n_runners * n_envs))
+        for b in self.foreach_runner("sample_transitions", vec_steps):
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+        metrics: dict = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self._update_step += 1
+                batch["step"] = np.full(batch.count, self._update_step, np.int32)
+                metrics = self.learner_group.update(batch)
+                self.learner_group.apply(_polyak(cfg.tau))
+            self.sync_weights(self.learner_group.get_weights())
+        return {f"learner/{k}": v for k, v in metrics.items()} | {
+            "buffer_size": len(self.buffer)
+        }
+
+
+SACConfig.algo_class = SAC
+register_algorithm("SAC", SAC)

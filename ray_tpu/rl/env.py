@@ -146,6 +146,18 @@ def register_env(name: str, creator: Callable[[], Env]) -> None:
     _REGISTRY[name] = creator
 
 
+def make_vector_env(spec, n_envs: int, seed: Optional[int] = None):
+    """SyncVectorEnv for single-agent envs; MultiAgentVectorEnv (same
+    interface, slots = env x agent) when the creator builds a MultiAgentEnv
+    — shared-policy multi-agent training with unchanged algorithms."""
+    from ray_tpu.rl.multi_agent import MultiAgentEnv, MultiAgentVectorEnv
+
+    probe = make_env(spec)
+    if isinstance(probe, MultiAgentEnv):
+        return MultiAgentVectorEnv(spec, n_envs, seed=seed)
+    return SyncVectorEnv(spec, n_envs, seed=seed, _first=probe)
+
+
 def make_env(spec) -> Env:
     if isinstance(spec, Env):
         return spec
@@ -164,8 +176,10 @@ class SyncVectorEnv:
     ready for one batched policy forward — the policy runs ONE jitted call
     per vector step regardless of N."""
 
-    def __init__(self, creator: Callable[[], Env], n: int, seed: Optional[int] = None):
-        self.envs = [make_env(creator) for _ in range(n)]
+    def __init__(self, creator: Callable[[], Env], n: int, seed: Optional[int] = None, _first=None):
+        self.envs = ([_first] if _first is not None else []) + [
+            make_env(creator) for _ in range(n - (1 if _first is not None else 0))
+        ]
         self.n = n
         self.observation_space = self.envs[0].observation_space
         self.action_space = self.envs[0].action_space

@@ -37,7 +37,9 @@ class EnvRunner:
     ):
         import jax
 
-        self.vec = SyncVectorEnv(env_spec, num_envs, seed=seed)
+        from ray_tpu.rl.env import make_vector_env
+
+        self.vec = make_vector_env(env_spec, num_envs, seed=seed)
         self.fragment = rollout_fragment_length
         self.spec = RLModuleSpec(self.vec.observation_space, self.vec.action_space, hidden=hidden)
         self.module = module_cls(self.spec)
@@ -50,8 +52,9 @@ class EnvRunner:
         self._eps: Optional[float] = None
         self._obs = self.vec.reset()
         # episode stats
-        self._ep_ret = np.zeros(num_envs, np.float32)
-        self._ep_len = np.zeros(num_envs, np.int64)
+        # sized by SLOTS (= envs, or envs x agents for multi-agent vectors)
+        self._ep_ret = np.zeros(self.vec.n, np.float32)
+        self._ep_len = np.zeros(self.vec.n, np.int64)
         self._completed: list[tuple[float, int]] = []
 
     # -- weights -----------------------------------------------------------

@@ -338,3 +338,95 @@ def test_env_runner_fault_tolerance(ray_start_regular):
         assert all(algo.foreach_runner("ping"))
     finally:
         algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# SAC (continuous control) + multi-agent env API
+# ---------------------------------------------------------------------------
+
+
+def test_sac_training_step_smoke():
+    from ray_tpu.rl.algorithms.sac import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=2, rollout_fragment_length=32)
+        .training(
+            learning_starts=64, sample_steps_per_iter=128, updates_per_iter=4,
+            train_batch_size=64,
+        )
+        .build()
+    )
+    try:
+        algo.train()
+        result = algo.train()
+        assert "learner/q_loss" in result
+        assert result["learner/alpha"] > 0
+    finally:
+        algo.stop()
+
+
+def test_sac_learns_pendulum():
+    """SAC must clearly improve on Pendulum-v1 (random play averages about
+    -1200; threshold mirrors rllib/tuned_examples/sac scaled to CI budget)."""
+    from ray_tpu.rl.algorithms.sac import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+        .training(
+            learning_starts=800, sample_steps_per_iter=400, updates_per_iter=400,
+            train_batch_size=256, lr=3e-4,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        best, _ = _run_until(algo, "episode_return_mean", -350.0, max_iters=40)
+        assert best >= -350.0, f"SAC failed to learn Pendulum: best return {best}"
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_vector_env_slots():
+    from ray_tpu.rl.env import make_vector_env
+    from ray_tpu.rl.multi_agent import EchoCoopEnv
+
+    vec = make_vector_env(lambda: EchoCoopEnv(episode_len=4), 3, seed=0)
+    assert vec.n == 6  # 3 envs x 2 agents
+    obs = vec.reset()
+    assert obs.shape == (6, 2)
+    # both agents of one env see the same observation
+    np.testing.assert_array_equal(obs[0], obs[1])
+    # perfect play: action = argmax(obs) (the bit is obs[0])
+    acts = obs[:, 0].astype(np.int64) ^ 0  # action == bit
+    obs2, rew, term, trunc, final = vec.step(1 - np.argmax(obs, -1))
+    np.testing.assert_allclose(rew, 1.5)  # both correct -> 1 + 0.5 each
+    # episodes truncate after 4 steps and auto-reset
+    for _ in range(3):
+        obs2, rew, term, trunc, final = vec.step(np.zeros(6, np.int64))
+    assert trunc.all()
+
+
+def test_shared_policy_ppo_learns_multi_agent():
+    """PPO trains ONE shared policy over all agents of a MultiAgentEnv via
+    the slot-flattened vector view; coordination reward improves toward the
+    1.5/step optimum."""
+    from ray_tpu.rl.multi_agent import EchoCoopEnv
+
+    algo = (
+        PPOConfig()
+        .environment(lambda: EchoCoopEnv(episode_len=16))
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4, rollout_fragment_length=64)
+        .training(train_batch_size=1024, minibatch_size=256, num_epochs=4, lr=1e-3)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        # per-slot episode return: optimum 16*1.5=24; random ~12
+        best, _ = _run_until(algo, "episode_return_mean", 20.0, max_iters=25)
+        assert best >= 20.0, f"shared-policy PPO failed: best {best}"
+    finally:
+        algo.stop()
