@@ -36,6 +36,14 @@ class GPTConfig:
     dtype: str = "bfloat16"           # activation/compute dtype
     remat: bool = True
     attn_impl: str = "auto"           # auto|xla|flash|ring (see ops/attention)
+    # Mixture-of-Experts (0 = dense MLP). Experts shard over the mesh's
+    # ``ep`` axis; routing uses GShard/Switch-style dense dispatch einsums
+    # (one-hot matmuls — static shapes, MXU-friendly, XLA inserts the
+    # all-to-alls from the sharding constraints).
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01      # load-balancing loss weight
 
     # GPT-J-6B shape (reference north star):
     # vocab 50400→50432, seq 2048, d_model 4096, 28 layers, 16 heads
@@ -58,20 +66,27 @@ def gpt_init(rng: jax.Array, cfg: GPTConfig) -> dict:
     def kernel(key, shape, fan_in):
         return jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)
 
-    ks = jax.random.split(k_blocks, 4)
+    ks = jax.random.split(k_blocks, 5)
+    blocks = {
+        "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
+        "attn_qkv": {"kernel": kernel(ks[0], (L, d, 3 * d), d), "bias": jnp.zeros((L, 3 * d))},
+        "attn_out": {"kernel": kernel(ks[1], (L, d, d), d), "bias": jnp.zeros((L, d))},
+        "ln2": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        blocks["router"] = {"kernel": kernel(ks[4], (L, d, E), d)}
+        blocks["moe_in"] = {"kernel": kernel(ks[2], (L, E, d, dff), d)}
+        blocks["moe_out"] = {"kernel": kernel(ks[3], (L, E, dff, d), dff)}
+    else:
+        blocks["mlp_in"] = {"kernel": kernel(ks[2], (L, d, dff), d), "bias": jnp.zeros((L, dff))}
+        blocks["mlp_out"] = {"kernel": kernel(ks[3], (L, dff, d), dff), "bias": jnp.zeros((L, d))}
     return {
         "embed": {
             "tokens": init(k_tok, (cfg.vocab_size, d), jnp.float32),
             "pos": init(k_pos, (cfg.seq_len, d), jnp.float32),
         },
-        "blocks": {
-            "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
-            "attn_qkv": {"kernel": kernel(ks[0], (L, d, 3 * d), d), "bias": jnp.zeros((L, 3 * d))},
-            "attn_out": {"kernel": kernel(ks[1], (L, d, d), d), "bias": jnp.zeros((L, d))},
-            "ln2": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
-            "mlp_in": {"kernel": kernel(ks[2], (L, d, dff), d), "bias": jnp.zeros((L, dff))},
-            "mlp_out": {"kernel": kernel(ks[3], (L, dff, d), dff), "bias": jnp.zeros((L, d))},
-        },
+        "blocks": blocks,
         "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
         "lm_head": {"kernel": kernel(k_head, (d, cfg.vocab_size), d)},
     }
@@ -83,6 +98,60 @@ def _layernorm(x, scale, bias):
     var = x32.var(-1, keepdims=True)
     out = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
     return out.astype(x.dtype)
+
+
+def _moe_mlp(cfg: GPTConfig, x, layer, c):
+    """Mixture-of-experts MLP with GShard/Switch dense dispatch.
+
+    Routing is all one-hot einsums over static shapes: top-k gate → capacity
+    assignment via cumsum → (tokens, E, cap) dispatch tensor → expert matmuls
+    on (E, cap, d) — sharded over the ``ep`` mesh axis, so XLA compiles the
+    dispatch/combine einsums into all-to-alls over ICI. Over-capacity
+    assignments drop (standard). Returns (out, aux_loss).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    b, s, d = x.shape
+    E, k = cfg.n_experts, cfg.experts_per_token
+    n = b * s
+    cap = max(1, int(cfg.capacity_factor * k * n / E))
+    flat = x.reshape(n, d)
+
+    logits = (flat @ layer["router"]["kernel"].astype(x.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                     # (n, E)
+    gate_w, gate_idx = jax.lax.top_k(probs, k)                  # (n, k)
+    gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+
+    # (n*k assignments) -> expert one-hot, position within expert via cumsum
+    a_idx = gate_idx.reshape(n * k)
+    onehot = jax.nn.one_hot(a_idx, E, dtype=jnp.float32)        # (nk, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # (nk, E)
+    pos_in_expert = pos.sum(-1)                                 # (nk,)
+    keep = (pos_in_expert < cap).astype(jnp.float32)
+    disp = onehot * keep[:, None]                               # (nk, E)
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), cap, dtype=jnp.float32)
+    # fold the k slots back into tokens: (n, E, cap) dispatch tensor — a
+    # token's top-k experts are distinct, so summing slots never collides.
+    # O(n·E·cap), never an (n, n) tensor (GShard's dispatch/combine form).
+    disp_t = (disp[:, :, None] * pos_oh[:, None, :]).reshape(n, k, E, cap)
+    dispatch = disp_t.sum(axis=1)                               # (n, E, cap)
+    combine = (disp_t * gate_w[:, :, None, None]).sum(axis=1)   # (n, E, cap)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), flat)
+    expert_in = c(expert_in, P("ep", None, None))
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["moe_in"]["kernel"].astype(x.dtype))
+    )
+    h = c(h, P("ep", None, "tp"))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, layer["moe_out"]["kernel"].astype(x.dtype))
+    expert_out = c(expert_out, P("ep", None, None))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out).reshape(b, s, d)
+
+    # Switch load-balancing aux: E * sum(frac_tokens_e * mean_prob_e)
+    frac = (onehot * keep[:, None]).mean(0)
+    mean_prob = probs.mean(0)
+    aux = E * jnp.sum(frac * mean_prob) * k
+    return out, aux.astype(jnp.float32)
 
 
 def _block(cfg: GPTConfig, x, layer, mesh=None):
@@ -155,33 +224,50 @@ def _block(cfg: GPTConfig, x, layer, mesh=None):
     x = x + c(att, P(("dp", "fsdp"), "sp", None))
 
     ln2 = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
-    hmid = jax.nn.gelu(ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt))
-    hmid = c(hmid, P(("dp", "fsdp"), "sp", "tp"))
-    out = hmid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
-    return x + c(out, P(("dp", "fsdp"), "sp", None))
+    if cfg.n_experts > 0:
+        out, aux = _moe_mlp(cfg, ln2, layer, c)
+    else:
+        hmid = jax.nn.gelu(ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt))
+        hmid = c(hmid, P(("dp", "fsdp"), "sp", "tp"))
+        out = hmid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
+        aux = jnp.float32(0.0)
+    return x + c(out, P(("dp", "fsdp"), "sp", None)), aux
 
 
-def gpt_forward(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:
-    """tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32."""
+def gpt_forward(
+    cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None, return_aux: bool = False
+):
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    ``return_aux=True`` also returns the mean MoE load-balancing loss."""
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     x = params["embed"]["tokens"].astype(dt)[tokens]
     x = x + params["embed"]["pos"].astype(dt)[:s]
 
-    block = lambda carry, layer: (_block(cfg, carry, layer, mesh), None)
+    def block(carry, layer):
+        y, aux = _block(cfg, carry, layer, mesh)
+        return y, aux
+
     if cfg.remat:
         block = jax.checkpoint(block, prevent_cse=False)
-    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x, auxes = jax.lax.scan(block, x, params["blocks"])
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"]
+    if return_aux:
+        return logits, auxes.mean()
     return logits
 
 
 def gpt_loss(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:
-    """Next-token cross-entropy, mean over (batch, seq-1)."""
-    logits = gpt_forward(cfg, params, tokens[:, :-1], mesh)
+    """Next-token cross-entropy, mean over (batch, seq-1); MoE configs add
+    the weighted load-balancing aux loss."""
+    logits, aux = gpt_forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    loss = -ll.mean()
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss

@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 # outermost (the axis that crosses slice/DCN boundaries). PartitionSpecs refer
 # to axes by NAME, so this ordering only affects which physical devices form
 # each axis group.
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass
@@ -30,9 +30,13 @@ class MeshConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1  # expert parallelism (MoE experts sharded over this axis)
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        sizes = {
+            "dp": self.dp, "fsdp": self.fsdp, "ep": self.ep,
+            "tp": self.tp, "sp": self.sp,
+        }
         fixed = [a for a, s in sizes.items() if s != -1]
         free = [a for a, s in sizes.items() if s == -1]
         if len(free) > 1:

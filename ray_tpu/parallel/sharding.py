@@ -36,6 +36,10 @@ _RULES = [
     (r"blocks/mlp_in/bias$", P(None, "tp")),
     (r"blocks/mlp_out/kernel$", P(None, "tp", "fsdp")),    # (L, 4d, d)
     (r"blocks/mlp_out/bias$", P(None, None)),
+    # MoE: experts over ep, then the usual fsdp/tp split inside each expert
+    (r"blocks/router/kernel$", P(None, None, None)),       # (L, d, E) small
+    (r"blocks/moe_in/kernel$", P(None, "ep", "fsdp", "tp")),   # (L, E, d, 4d)
+    (r"blocks/moe_out/kernel$", P(None, "ep", "tp", "fsdp")),  # (L, E, 4d, d)
     (r"blocks/ln\d/(scale|bias)$", P(None, None)),
     (r"ln_f/(scale|bias)$", P()),  # rank-1 (d,) — replicate
     (r"lm_head/kernel$", P("tp", "fsdp")),        # (d_model, vocab)

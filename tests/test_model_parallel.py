@@ -17,7 +17,7 @@ TINY = GPTConfig(vocab_size=256, seq_len=64, d_model=64, n_layers=2, n_heads=4, 
 
 def test_mesh_factoring():
     m = make_mesh(MeshConfig(dp=-1, fsdp=2, tp=2), devices=jax.devices("cpu")[:8])
-    assert dict(zip(m.axis_names, m.devices.shape)) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+    assert dict(zip(m.axis_names, m.devices.shape)) == {"dp": 2, "fsdp": 2, "ep": 1, "tp": 2, "sp": 1}
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=1, tp=1).resolve(8)
     with pytest.raises(ValueError):
