@@ -49,9 +49,13 @@ class _QueueActor:
             return (False, None)
 
     def put_batch(self, items: list, timeout: Optional[float] = None) -> bool:
+        # atomic: reject the WHOLE batch if it can't fit (a partial insert
+        # would duplicate items when the caller retries after Full)
+        maxsize = self._q.maxsize
+        if maxsize > 0 and self._q.qsize() + len(items) > maxsize:
+            return False
         for item in items:
-            if not self.put(item, timeout):
-                return False
+            self._q.put(item)
         return True
 
     def get_batch(self, max_items: int):
@@ -95,11 +99,14 @@ class Queue:
 
     def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
         deadline = None if timeout is None else time.monotonic() + timeout
+        # ship the payload ONCE: retry slices re-send only a tiny ObjectRef,
+        # not the item (a blocked 100MB put must not re-serialize per slice)
+        ref = ray_tpu.put(item)
         while True:
             slice_t = 0 if not block else self._SLICE
             if deadline is not None:
                 slice_t = max(0, min(slice_t, deadline - time.monotonic()))
-            ok = ray_tpu.get(self.actor.put.remote(item, slice_t))
+            ok = ray_tpu.get(self.actor.put.remote(ref, slice_t))
             if ok:
                 return
             if not block or (deadline is not None and time.monotonic() >= deadline):
@@ -120,15 +127,24 @@ class Queue:
                 slice_t = max(0, min(slice_t, deadline - time.monotonic()))
             ok, item = ray_tpu.get(self.actor.get.remote(slice_t))
             if ok:
-                return item
+                return self._resolve(item)
             if not block or (deadline is not None and time.monotonic() >= deadline):
                 raise Empty("ray_tpu.util.queue.Queue is empty")
+
+    @staticmethod
+    def _resolve(item):
+        from ray_tpu._private.runtime import ObjectRef
+
+        return ray_tpu.get(item) if isinstance(item, ObjectRef) else item
 
     def get_nowait(self) -> Any:
         return self.get(block=False)
 
     def get_nowait_batch(self, max_items: int) -> list:
-        return ray_tpu.get(self.actor.get_batch.remote(max_items))
+        return [
+            self._resolve(i)
+            for i in ray_tpu.get(self.actor.get_batch.remote(max_items))
+        ]
 
     def shutdown(self):
         try:

@@ -36,12 +36,20 @@ STATUS_FAILED = "FAILED"
 class _Store:
     def __init__(self, storage: str, workflow_id: str):
         self.dir = os.path.join(storage, workflow_id)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.dir)
+
+    def _ensure(self):
+        # lazy: read-only queries (get_status of a typo id, list_all over a
+        # storage root with stray files) must not mutate storage
         os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     def _meta_path(self):
         return os.path.join(self.dir, "meta.json")
 
     def write_meta(self, **kwargs):
+        self._ensure()
         meta = self.read_meta()
         meta.update(kwargs)
         meta["updated_at"] = time.time()
@@ -62,6 +70,7 @@ class _Store:
         return os.path.exists(self.step_path(step_id))
 
     def save_step(self, step_id: str, value: Any):
+        self._ensure()
         tmp = self.step_path(step_id) + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(value, f)
@@ -74,6 +83,7 @@ class _Store:
     def save_graph(self, dag: DAGNode, input_args: tuple):
         import cloudpickle  # graphs close over user functions
 
+        self._ensure()
         with open(os.path.join(self.dir, "graph.pkl"), "wb") as f:
             cloudpickle.dump((dag, input_args), f)
 
@@ -101,9 +111,11 @@ def _step_ids(dag: DAGNode) -> dict[int, str]:
     for idx, node in enumerate(order):
         name = type(node).__name__
         if isinstance(node, FunctionNode):
-            name = getattr(getattr(node, "_fn", None), "_name", None) or getattr(
-                getattr(node._fn, "_function", None), "__name__", "fn"
-            )
+            # RemoteFunction wraps the user function as ._fn (and
+            # update_wrapper copies __name__ onto the wrapper itself)
+            name = getattr(
+                getattr(node._fn, "_fn", node._fn), "__name__", None
+            ) or getattr(node._fn, "__name__", "fn")
         ids[id(node)] = f"{idx:03d}_{name}_{hashlib.sha1(name.encode()).hexdigest()[:6]}"
     return ids
 
@@ -128,15 +140,22 @@ def _execute_durable(dag: DAGNode, input_args: tuple, store: _Store) -> Any:
             k: (run_node(v) if isinstance(v, DAGNode) else v)
             for k, v in node._bound_kwargs.items()
         }
+        checkpoint = True
         if isinstance(node, MultiOutputNode):
             value = list(args)
         elif isinstance(node, FunctionNode):
             # each step runs as a task; its materialized result is the
             # durability unit (reference: one checkpoint per workflow task)
             value = ray_tpu.get(node._fn.remote(*args, **kwargs))
+        elif hasattr(node, "_cls"):  # ClassNode — uses the DURABLY computed
+            # args, but actor handles themselves aren't durable: not
+            # checkpointed (reference: virtual actors are a separate system)
+            value = node._cls.remote(*args, **kwargs)
+            checkpoint = False
         else:
-            value = node._execute_impl({})
-        store.save_step(step_id, value)
+            raise TypeError(f"workflows cannot execute {type(node).__name__}")
+        if checkpoint:
+            store.save_step(step_id, value)
         memo[key] = value
         return value
 
@@ -184,7 +203,10 @@ def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
 
 
 def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]:
-    return _Store(storage or _DEFAULT_STORAGE, workflow_id).read_meta().get("status")
+    store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
+    if not store.exists():
+        return None
+    return store.read_meta().get("status")
 
 
 def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
@@ -199,5 +221,6 @@ def list_all(storage: Optional[str] = None) -> list[tuple[str, Optional[str]]]:
     out = []
     if os.path.isdir(root):
         for wid in sorted(os.listdir(root)):
-            out.append((wid, get_status(wid, root)))
+            if os.path.isdir(os.path.join(root, wid)):  # skip stray files
+                out.append((wid, get_status(wid, root)))
     return out
