@@ -21,5 +21,9 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve._private.common import AutoscalingConfig  # noqa: F401

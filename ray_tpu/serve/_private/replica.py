@@ -33,16 +33,20 @@ class Replica:
 
     # -- request path ------------------------------------------------------
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(self, method: str, args: tuple, kwargs: dict, model_id=None) -> Any:
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        _set_request_model_id(model_id)
         try:
             target = self._callable if method == "__call__" else getattr(self._callable, method)
             if method == "__call__" and not callable(target):
                 raise TypeError(f"Deployment {type(self._callable).__name__} is not callable")
             return target(*args, **kwargs)
         finally:
+            _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1
 

@@ -94,26 +94,45 @@ def batch(
     """
 
     def wrap(fn):
-        # The queue hangs off the INSTANCE (created lazily at first call) so
-        # the decorated class stays cloudpickle-able — a closure-held lock or
+        # Queues hang off the INSTANCE (created lazily at first call) so the
+        # decorated class stays cloudpickle-able — a closure-held lock or
         # queue dict would break shipping the deployment to replica actors.
-        attr = f"__serve_batch_queue_{fn.__name__}"
+        # One queue PER multiplexed model id: batches never mix models, and
+        # the flusher thread re-enters the submitting request's model
+        # context (threading.local does not cross into the flusher).
+        attr = f"__serve_batch_queues_{fn.__name__}"
 
         @functools.wraps(fn)
         def wrapper(self, item):
             import ray_tpu.serve.batching as _b
+            from ray_tpu.serve.multiplex import (
+                _set_request_model_id,
+                get_multiplexed_model_id,
+            )
 
-            q = getattr(self, attr, None)
+            model_id = get_multiplexed_model_id()
+            queues = getattr(self, attr, None)
+            if queues is None:
+                with _b._CREATE_LOCK:
+                    queues = getattr(self, attr, None)
+                    if queues is None:
+                        queues = {}
+                        setattr(self, attr, queues)
+            q = queues.get(model_id)
             if q is None:
                 with _b._CREATE_LOCK:
-                    q = getattr(self, attr, None)
+                    q = queues.get(model_id)
                     if q is None:
-                        q = _BatchQueue(
-                            lambda items: fn(self, items),
-                            max_batch_size,
-                            batch_wait_timeout_s,
-                        )
-                        setattr(self, attr, q)
+
+                        def run(items, _mid=model_id):
+                            _set_request_model_id(_mid)
+                            try:
+                                return fn(self, items)
+                            finally:
+                                _set_request_model_id(None)
+
+                        q = _BatchQueue(run, max_batch_size, batch_wait_timeout_s)
+                        queues[model_id] = q
             return q.submit(item).result()
 
         wrapper._is_serve_batch = True  # noqa: SLF001

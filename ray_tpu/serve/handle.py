@@ -102,29 +102,43 @@ class _Router:
                 self._replicas = replicas
                 self._inflight = [0] * len(replicas)
 
-    def pick(self) -> tuple[Any, int]:
+    def pick(self, model_id: Optional[str] = None) -> tuple[Any, int]:
         """Power-of-two-choices over local in-flight counts, honoring the
         per-replica max_ongoing_requests admission cap (backpressure —
-        reference: pow_2_scheduler queue-length caps)."""
+        reference: pow_2_scheduler queue-length caps). Multiplexed requests
+        route by rendezvous hash so a model id sticks to one replica
+        (reference: model-aware multiplex routing)."""
         deadline = time.time() + 30.0
         while True:
             self._refresh()
             with self._lock:
                 n = len(self._replicas)
                 if n:
-                    if n == 1:
+                    if model_id:
+                        from ray_tpu.serve.multiplex import rendezvous_pick
+
+                        # sticky: wait for THE model's replica rather than
+                        # spilling onto others (a spill would duplicate the
+                        # model's weights in another replica's HBM)
+                        idx = rendezvous_pick(model_id, n)
+                        if self._inflight[idx] < self._max_ongoing:
+                            self._inflight[idx] += 1
+                            return self._replicas[idx], idx
+                        idx = None
+                    elif n == 1:
                         idx = 0
                     else:
                         i, j = random.sample(range(n), 2)
                         idx = i if self._inflight[i] <= self._inflight[j] else j
-                    if self._inflight[idx] < self._max_ongoing:
+                    if idx is not None and self._inflight[idx] < self._max_ongoing:
                         self._inflight[idx] += 1
                         return self._replicas[idx], idx
-                    # chosen replica at capacity: try the global minimum
-                    idx = min(range(n), key=self._inflight.__getitem__)
-                    if self._inflight[idx] < self._max_ongoing:
-                        self._inflight[idx] += 1
-                        return self._replicas[idx], idx
+                    if idx is not None:
+                        # chosen replica at capacity: try the global minimum
+                        idx = min(range(n), key=self._inflight.__getitem__)
+                        if self._inflight[idx] < self._max_ongoing:
+                            self._inflight[idx] += 1
+                            return self._replicas[idx], idx
             if time.time() > deadline:
                 raise RuntimeError(
                     f"No replica capacity for deployment {self.deployment_name!r}"
@@ -153,16 +167,26 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str):
+    def __init__(self, deployment_name: str, _model_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self._router: Optional[_Router] = None
+        self._model_id = _model_id
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        """A view of this handle with request options (reference:
+        ``handle.options(multiplexed_model_id=...)``). The view SHARES the
+        router (in-flight accounting stays coherent)."""
+        view = DeploymentHandle(self.deployment_name, _model_id=multiplexed_model_id)
+        view._router = self._get_router()
+        return view
 
     # picklability: the router (with live actor handles) stays local
     def __getstate__(self):
-        return {"deployment_name": self.deployment_name}
+        return {"deployment_name": self.deployment_name, "_model_id": self._model_id}
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
+        self._model_id = state.get("_model_id")
         self._router = None
 
     def _get_router(self) -> _Router:
@@ -174,7 +198,7 @@ class DeploymentHandle:
         return self._remote("__call__", args, kwargs)
 
     def __getattr__(self, name: str) -> _MethodCaller:
-        if name.startswith("_") or name in ("deployment_name",):
+        if name.startswith("_") or name in ("deployment_name", "options"):
             raise AttributeError(name)
         return _MethodCaller(self, name)
 
@@ -200,9 +224,14 @@ class DeploymentHandle:
             else None
         )
         for attempt in range(3):
-            replica, idx = router.pick()
+            replica, idx = router.pick(model_id=self._model_id)
             try:
-                ref = replica.handle_request.remote(method, args, kwargs)
+                if self._model_id:
+                    ref = replica.handle_request.remote(
+                        method, args, kwargs, self._model_id
+                    )
+                else:
+                    ref = replica.handle_request.remote(method, args, kwargs)
                 return DeploymentResponse(ref, router, idx, retry=retry)
             except RayActorError:
                 router._complete(idx)
