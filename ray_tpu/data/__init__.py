@@ -27,6 +27,7 @@ from ray_tpu.data.datasource import (  # noqa: F401
     RangeDatasource,
     ReadTask,
     TextDatasource,
+    SQLDatasource,
     TFRecordsDatasource,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
@@ -109,6 +110,22 @@ def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
 
 def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     return _from_source(TFRecordsDatasource(paths, kwargs), parallelism)
+
+
+def read_sql(
+    sql: str, connection_factory, *, parallelism: int = 1, order_by: str = None
+) -> Dataset:
+    """Rows of a SQL query as a Dataset (reference: ``ray.data.read_sql``).
+    ``connection_factory`` is a zero-arg callable returning a DB-API
+    connection (sqlite3.connect, psycopg2.connect, ...). ``parallelism > 1``
+    windows the query with LIMIT/OFFSET and requires ``order_by`` (a
+    deterministic ordering key) so windows are disjoint."""
+    return _from_source(
+        SQLDatasource(
+            sql, connection_factory, parallelism_hint=parallelism, order_by=order_by
+        ),
+        parallelism,
+    )
 
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:

@@ -352,3 +352,29 @@ def test_streaming_split_equal_splits_remainder_rows(ray_start_regular):
     [t.join(timeout=60) for t in ts]
     assert len(results[0]) == len(results[1]) == 25
     assert sorted(results[0] + results[1]) == list(range(50))
+
+
+def test_read_sql_sqlite(ray_start_regular, tmp_path):
+    """SQL datasource over DB-API (reference: ray.data.read_sql)."""
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE users (id INTEGER, score REAL)")
+    conn.executemany(
+        "INSERT INTO users VALUES (?, ?)", [(i, i * 0.5) for i in range(50)]
+    )
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT id, score FROM users", lambda: sqlite3.connect(db))
+    assert ds.count() == 50
+    rows = ds.take(5)
+    assert rows[0]["id"] == 0 and rows[4]["score"] == 2.0
+
+    # windowed parallel read covers all rows exactly once
+    ds4 = rd.read_sql(
+        "SELECT id, score FROM users", lambda: sqlite3.connect(db),
+        parallelism=4, order_by="id",
+    )
+    assert sorted(r["id"] for r in ds4.take_all()) == list(range(50))
