@@ -32,14 +32,15 @@ class DeploymentResponse:
     router's retry-on-replica-failure semantics).
     """
 
-    def __init__(self, ref, router: "_Router", replica_idx: int, retry=None):
+    def __init__(self, ref, router: "_Router", replica_idx: int, retry=None, replica=None):
         self._ref = ref
         self._router = router
         self._replica_idx = replica_idx
+        self._replica = replica
         self._retry = retry  # zero-arg callable re-submitting the request
         self._done = False
 
-    MAX_RETRIES = 2
+    MAX_RETRIES = 4
 
     def result(self, timeout: Optional[float] = None) -> Any:
         import ray_tpu
@@ -49,9 +50,17 @@ class DeploymentResponse:
             return ray_tpu.get(self._ref, timeout=timeout)
         except RayActorError:
             self._settle()
-            self._router.drop()
+            if self._replica is not None:
+                # fail over immediately: this router stops routing to the
+                # dead replica without waiting for the controller's health
+                # check to notice
+                self._router.mark_failed(self._replica)
+            else:
+                self._router.drop()
             if self._retry is None:
                 raise  # retry budget exhausted — surface the failure
+            # no sleep: pick() itself waits (with deadline) when no live
+            # replica is available; with others alive the retry is instant
             return self._retry().result(timeout)
         finally:
             self._settle()
@@ -78,6 +87,14 @@ class _Router:
         self._max_ongoing = 1
         self._version = -1
         self._last_refresh = 0.0
+        # replicas observed dead by THIS router, excluded until the
+        # controller publishes a new replica set — immediate failover
+        # instead of waiting out the controller's health-check window
+        self._excluded: set = set()
+        self._excluded_version = -1
+        self._real_version = -1  # last version actually seen from the
+        # controller — unlike _version it is never reset by drop(), so
+        # exclusion bookkeeping survives cache invalidation
 
     def _controller(self):
         import ray_tpu
@@ -101,6 +118,25 @@ class _Router:
                 self._version = version
                 self._replicas = replicas
                 self._inflight = [0] * len(replicas)
+            self._real_version = version
+            if self._excluded and version != self._excluded_version:
+                # the controller published a NEW replica set since the
+                # exclusions were recorded — they no longer apply
+                self._excluded.clear()
+
+    def _sticky_pick(self, model_id: str, live: list) -> int:
+        """Highest-random-weight over STABLE replica identities: a model's
+        home replica doesn't move when unrelated replicas join/die/exclude
+        (positional hashing would remap models on every live-set change)."""
+        import hashlib
+
+        def weight(i):
+            key = str(self._replica_key(self._replicas[i]))
+            return int.from_bytes(
+                hashlib.sha1(f"{model_id}:{key}".encode()).digest()[:8], "little"
+            )
+
+        return max(live, key=weight)
 
     def pick(self, model_id: Optional[str] = None) -> tuple[Any, int]:
         """Power-of-two-choices over local in-flight counts, honoring the
@@ -112,30 +148,33 @@ class _Router:
         while True:
             self._refresh()
             with self._lock:
-                n = len(self._replicas)
+                live = [
+                    i
+                    for i in range(len(self._replicas))
+                    if self._replica_key(self._replicas[i]) not in self._excluded
+                ]
+                n = len(live)
                 if n:
                     if model_id:
-                        from ray_tpu.serve.multiplex import rendezvous_pick
-
                         # sticky: wait for THE model's replica rather than
                         # spilling onto others (a spill would duplicate the
                         # model's weights in another replica's HBM)
-                        idx = rendezvous_pick(model_id, n)
+                        idx = self._sticky_pick(model_id, live)
                         if self._inflight[idx] < self._max_ongoing:
                             self._inflight[idx] += 1
                             return self._replicas[idx], idx
                         idx = None
                     elif n == 1:
-                        idx = 0
+                        idx = live[0]
                     else:
-                        i, j = random.sample(range(n), 2)
+                        i, j = random.sample(live, 2)
                         idx = i if self._inflight[i] <= self._inflight[j] else j
                     if idx is not None and self._inflight[idx] < self._max_ongoing:
                         self._inflight[idx] += 1
                         return self._replicas[idx], idx
                     if idx is not None:
-                        # chosen replica at capacity: try the global minimum
-                        idx = min(range(n), key=self._inflight.__getitem__)
+                        # chosen replica at capacity: try the live minimum
+                        idx = min(live, key=self._inflight.__getitem__)
                         if self._inflight[idx] < self._max_ongoing:
                             self._inflight[idx] += 1
                             return self._replicas[idx], idx
@@ -144,6 +183,18 @@ class _Router:
                     f"No replica capacity for deployment {self.deployment_name!r}"
                 )
             time.sleep(0.02)
+
+    @staticmethod
+    def _replica_key(handle):
+        return getattr(handle, "_actor_id", None) or id(handle)
+
+    def mark_failed(self, replica):
+        """Exclude a replica this router saw die — routing fails over NOW,
+        before the controller's health check notices."""
+        with self._lock:
+            self._excluded.add(self._replica_key(replica))
+            self._excluded_version = self._real_version
+        self.drop()
 
     def _complete(self, idx: int):
         with self._lock:
@@ -232,8 +283,8 @@ class DeploymentHandle:
                     )
                 else:
                     ref = replica.handle_request.remote(method, args, kwargs)
-                return DeploymentResponse(ref, router, idx, retry=retry)
+                return DeploymentResponse(ref, router, idx, retry=retry, replica=replica)
             except RayActorError:
                 router._complete(idx)
-                router.drop()
+                router.mark_failed(replica)
         raise RuntimeError(f"Could not submit to deployment {self.deployment_name!r}")
