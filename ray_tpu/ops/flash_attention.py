@@ -1,15 +1,21 @@
 """Pallas TPU flash attention (causal) with a full custom-VJP backward.
 
 The blockwise online-softmax formulation (Flash Attention 2) — no (seq, seq)
-score matrix ever reaches HBM, so memory is O(seq) and the MXU stays fed from
-VMEM. Forward saves only out + logsumexp per row; backward recomputes scores
-blockwise with two kernels (dQ, then dK/dV). All accumulation fp32, inputs
-bf16/fp32.
+score matrix ever reaches HBM and no kernel instance ever holds more than one
+(block_q, d) + (block_k, d) working set in VMEM, so memory is O(seq) in HBM
+and O(block) in VMEM at ANY sequence length. Forward saves only out +
+logsumexp per row; backward recomputes scores blockwise with two kernels
+(dQ, then dK/dV). All accumulation fp32, inputs bf16/fp32.
 
-TPU tiling notes: the logsumexp rows live as ``(bh, 8, seq)`` — value
-broadcast over 8 sublanes so the (sublane, lane) block shape ``(8, block_q)``
-satisfies Mosaic's (8, 128) fp32 tile constraint; backward consumes the
-single meaningful sublane as ``(bh, 1, seq)`` full-dim blocks. Sequence
+Grid layout: ``(bh, q_block, kv_block)`` with the KV dimension minor — TPU
+grids execute the minor dimension sequentially, so VMEM scratch accumulators
+(acc/m/l for forward, dq / dk+dv for backward) carry across KV (resp. Q)
+steps of one output block and are flushed on the block's last step.
+Causally-dead (q, kv) cells are skipped with ``pl.when``.
+
+TPU tiling notes: per-row stats (logsumexp, delta) live as ``(bh, 8, seq)``
+— value broadcast over 8 sublanes so the (sublane, lane) block shape
+``(8, block_q)`` satisfies Mosaic's (8, 128) fp32 tile constraint. Sequence
 lengths must tile by 128 on the TPU path (the public entry falls back to the
 XLA implementation otherwise).
 
@@ -28,6 +34,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -36,71 +43,86 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _causal_mask(q_start, k_start, block_q, block_k):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return cols <= rows
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k):
-    """One (bh, q-block) cell: online softmax over causal kv blocks."""
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scale):
+    """Grid (bh, qi, kj), kj minor/sequential. Scratch carries the online
+    softmax state across kj steps of one q block."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
     q_start = qi * block_q
+    k_start = kj * block_k
+    j_last = (q_start + block_q - 1) // block_k  # last causally-live kv block
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(k_start <= q_start + block_q - 1)  # skip causally-dead cells
+    def _():
+        @pl.when(kj == 0)
+        def _():
+            m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+            l_sc[:] = jnp.zeros_like(l_sc)
+            acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    # only kv blocks at-or-before the diagonal contribute
-    num_kv = (q_start + block_q + block_k - 1) // block_k
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(cols <= rows, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=1))
+        s = jnp.where(_causal_mask(q_start, k_start, block_q, block_k), s, NEG_INF)
+        m_prev = m_sc[0]
+        l_prev = l_sc[0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=1)
+        acc_sc[:] = acc_sc[:] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l, acc
+        m_sc[:] = jnp.broadcast_to(m_new[None, :], m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new[None, :], l_sc.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse = m + jnp.log(l)  # (BQ,)
-    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, block_q))
+        @pl.when(kj == j_last)
+        def _():
+            l = jnp.maximum(l_sc[0], 1e-30)
+            o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+            lse = m_sc[0] + jnp.log(l)
+            lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, *, block_q, block_k):
     bh, seq, d = q.shape
     scale = 1.0 / (d**0.5)
-    grid = (bh, seq // block_q)
+    grid = (bh, seq // block_q, seq // block_k)
     out, lse8 = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_k=block_k),
+        functools.partial(_fwd_kernel, scale=scale),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 8, seq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, block_q), jnp.float32),   # running max (broadcast)
+            pltpu.VMEM((8, block_q), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -112,120 +134,141 @@ def _flash_fwd(q, k, v, *, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k):
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0]      # (BQ,)
-    delta = delta_ref[0, 0]  # (BQ,)
-    q_start = qi * block_q
-    num_kv = (q_start + block_q + block_k - 1) // block_k
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    q_start, k_start = qi * block_q, kj * block_k
+    j_last = (q_start + block_q - 1) // block_k
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _():
+        @pl.when(kj == 0)
+        def _():
+            dq_sc[:] = jnp.zeros_like(dq_sc)
+
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        p = jnp.where(cols <= rows, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(
+            _causal_mask(q_start, k_start, block_q, block_k),
+            jnp.exp(s - lse[:, None]),
+            0.0,
+        )
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        @pl.when(kj == j_last)
+        def _():
+            dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, seq_len
+    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale
 ):
-    ki = pl.program_id(1)
-    block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    k_start = ki * block_k
-    num_q = seq_len // block_q
-    first_q = k_start // block_q  # earliest q block the diagonal touches
+    """Grid (bh, kb, qi), qi minor/sequential; accumulates dk/dv for one kv
+    block across its causally-live q blocks."""
+    kb, qi = pl.program_id(1), pl.program_id(2)
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    block_q = q_ref.shape[1]
+    k_start, q_start = kb * block_k, qi * block_q
+    i_first = k_start // block_q     # first q block the diagonal touches
+    n_q = pl.num_programs(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _():
+        @pl.when(qi == i_first)
+        def _():
+            dk_sc[:] = jnp.zeros_like(dk_sc)
+            dv_sc[:] = jnp.zeros_like(dv_sc)
+
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = scale * jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
-        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        p = jnp.where(cols <= rows, jnp.exp(s - lse_blk[:, None]), 0.0)
-        dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        p = jnp.where(
+            _causal_mask(q_start, k_start, block_q, block_k),
+            jnp.exp(s - lse[:, None]),
+            0.0,
+        )
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_blk[:, None]) * scale
-        dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds = p * (dp - delta[:, None]) * scale
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk, dv
 
-    z = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        @pl.when(qi == n_q - 1)
+        def _():
+            dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, out, lse, do, *, block_q, block_k):
     bh, seq, d = q.shape
     scale = 1.0 / (d**0.5)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # (bh, seq)
-    delta = delta[:, None, :]  # (bh, 1, seq) — full-dim minor blocks tile fine
+    delta = delta[:, None, :]  # (bh, 1, seq)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block_k=block_k),
-        grid=(bh, seq // block_q),
+        functools.partial(_dq_kernel, scale=scale),
+        grid=(bh, seq // block_q, seq // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, seq_len=seq),
-        grid=(bh, seq // block_k),
+        functools.partial(_dkv_kernel, scale=scale),
+        grid=(bh, seq // block_k, seq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, kk, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, kk, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, kk, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, kk, i: (b, 0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(k, v, q, do, lse, delta)
@@ -271,10 +314,10 @@ def flash_attention(
 ) -> jax.Array:
     """Causal flash attention. q,k,v: (batch, heads, seq, head_dim).
 
-    O(seq) memory; differentiable (custom VJP with blockwise-recompute
-    backward). On TPU, seq must tile by 128 (Mosaic lane constraint) — falls
-    back to the XLA path otherwise; interpret mode (CPU CI) accepts any
-    power-of-two-friendly blocking.
+    O(seq) HBM / O(block) VMEM; differentiable (custom VJP with
+    blockwise-recompute backward). On TPU, seq must tile by 128 (Mosaic lane
+    constraint) — falls back to the XLA path otherwise; interpret mode (CPU
+    CI) accepts any power-of-two-friendly blocking.
     """
     b, h, s, d = q.shape
     bq, bk = _pick_blocks(s, block_q, block_k)
@@ -287,6 +330,14 @@ def flash_attention(
     return out.reshape(b, h, s, d)
 
 
+def flash_shardable(batch: int, heads: int, mesh) -> bool:
+    """True when (batch, heads) divide the mesh's (dp*fsdp, tp) axes — the
+    precondition for ``flash_attention_sharded``."""
+    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    tp = mesh.shape.get("tp", 1)
+    return batch % dp == 0 and heads % tp == 0
+
+
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh) -> jax.Array:
     """Flash attention inside a dp/fsdp/tp-sharded pjit program.
 
@@ -295,16 +346,18 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh) -> j
     all-gather q/k/v and replicate the kernel on every chip. This wrapper
     shard_maps it — batch over (dp, fsdp), heads over tp, seq/head_dim local
     — so each chip runs the kernel on exactly its shard (attention has no
-    cross-batch/cross-head communication). Falls back to the caller's XLA
-    path via ValueError when shapes don't divide the mesh.
+    cross-batch/cross-head communication). Callers must check
+    ``flash_shardable`` first.
     """
     from jax.sharding import PartitionSpec as P
 
     b, h, s, d = q.shape
-    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    tp = mesh.shape.get("tp", 1)
-    if b % dp or h % tp:
-        raise ValueError(f"batch {b} / heads {h} don't divide mesh axes dp*fsdp={dp}, tp={tp}")
+    if not flash_shardable(b, h, mesh):
+        raise ValueError(
+            f"batch {b} / heads {h} don't divide mesh axes "
+            f"dp*fsdp={mesh.shape.get('dp', 1) * mesh.shape.get('fsdp', 1)}, "
+            f"tp={mesh.shape.get('tp', 1)}"
+        )
     spec = P(("dp", "fsdp"), "tp", None, None)
     fn = jax.shard_map(
         flash_attention, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
