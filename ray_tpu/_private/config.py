@@ -29,6 +29,19 @@ class Config:
     #: spill to disk (reference: local_object_manager.h spill throttles).
     #: 0 = auto (object_store_memory, else 2 GiB).
     object_spilling_threshold_bytes: int = 0
+    #: Size of the native shared-memory arena (the plasma-equivalent C++
+    #: allocator in ``ray_tpu/_native/arena.cc``) each head creates for its
+    #: host. The segment is sparse — pages commit on first touch — so the
+    #: default costs nothing until used. 0 disables the arena (every object
+    #: gets a dedicated POSIX segment, the pure-Python fallback).
+    object_store_arena_bytes: int = 256 * 1024 * 1024
+    #: Objects at or below this many serialized bytes are placed in the
+    #: arena (one lock-protected pointer bump instead of a per-object
+    #: shm_open+mmap+unlink syscall round-trip); larger objects use a
+    #: dedicated segment whose mapping supports zero-copy reads for the
+    #: lifetime of the value (arena reads copy out under a pin, so blocks
+    #: can be recycled safely — see arena.cc pin/generation protocol).
+    arena_max_object_bytes: int = 256 * 1024
 
     # -- scheduler ---------------------------------------------------------
     #: Hybrid scheduling policy: pack onto busiest feasible node until its

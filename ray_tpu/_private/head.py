@@ -252,6 +252,15 @@ class Head:
         self.socket_path = socket_path
         self.authkey = authkey
         self.shm_owner = ShmOwner()
+        # Native object arena (plasma equivalent, ray_tpu/_native/arena.cc):
+        # one shared segment for this host's small/medium objects. None when
+        # disabled or the native build is unavailable (pure-Python fallback:
+        # a dedicated segment per object).
+        self.arena_name: Optional[str] = None
+        if GLOBAL_CONFIG.object_store_arena_bytes > 0:
+            from ray_tpu._private import shm_store as _shm
+
+            self.arena_name = _shm.create_arena(GLOBAL_CONFIG.object_store_arena_bytes)
 
         self.objects: dict[bytes, ObjectEntry] = {}
         self.functions: dict[bytes, bytes] = {}  # func table (reference: GCS fn table)
@@ -465,6 +474,8 @@ class Head:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if self.arena_name:
+            env["RAY_TPU_ARENA"] = self.arena_name
         popen = subprocess.Popen(
             [
                 sys.executable,
@@ -1313,7 +1324,7 @@ class Head:
         if ent.refcount <= 0 and ent.pins <= 0 and ent.ready:
             self.objects.pop(obj_id, None)
             if ent.shm is not None:
-                self.shm_owner.unlink(ent.shm.name)
+                self.shm_owner.unlink(ent.shm)
             if ent.spill_path is not None:
                 try:
                     os.unlink(ent.spill_path)
@@ -1373,7 +1384,7 @@ class Head:
                 f.write(data)
         except Exception:
             return  # spill is best-effort; the object stays in shm
-        self.shm_owner.unlink(ent.shm.name)
+        self.shm_owner.unlink(ent.shm)
         ent.shm = None
         ent.spill_path = path
 
@@ -1412,7 +1423,7 @@ class Head:
             for oid in obj_ids:
                 ent = self.objects.pop(oid, None)
                 if ent is not None and ent.shm is not None:
-                    self.shm_owner.unlink(ent.shm.name)
+                    self.shm_owner.unlink(ent.shm)
 
     # -------------------------------------------------------- task cancel
 
@@ -1881,6 +1892,10 @@ class Head:
             except Exception:
                 pass
         self.shm_owner.shutdown()
+        if self.arena_name:
+            from ray_tpu._private import shm_store as _shm
+
+            _shm.unlink_arena(self.arena_name)
         try:
             os.unlink(self.socket_path)
         except OSError:

@@ -23,9 +23,12 @@ control-plane socket inline, like the reference's in-process memory store
 from __future__ import annotations
 
 import dataclasses
+import os
 import pickle
 import threading
+import uuid
 from multiprocessing import resource_tracker, shared_memory
+from typing import Optional
 
 from ray_tpu._private.serialization import SerializedValue
 
@@ -42,6 +45,81 @@ class ShmLocation:
     header_len: int
     buffer_lens: list[int]
     total_size: int
+    #: Set for objects living in the native arena (``_native/arena.cc``):
+    #: ``name`` is then the arena segment and ``offset``/``gen`` identify the
+    #: allocation for pin/free. None = dedicated POSIX segment (legacy path).
+    offset: Optional[int] = None
+    gen: int = 0
+
+
+# ---------------------------------------------------------------------------
+# native arena (plasma-equivalent allocator; see ray_tpu/_native/arena.cc)
+# ---------------------------------------------------------------------------
+
+_ARENA_ENV = "RAY_TPU_ARENA"
+_arena_lock = threading.Lock()
+_arenas: dict[str, "object"] = {}  # name -> Arena (attached mappings, cached)
+_write_arena_name: Optional[str] = None
+
+
+def create_arena(size: int) -> Optional[str]:
+    """Head-side: create this host's arena. Returns its name (for worker env
+    + later unlink) or None when the native library is unavailable."""
+    global _write_arena_name
+    from ray_tpu import _native
+
+    name = f"/rta-{os.getpid():x}-{uuid.uuid4().hex[:8]}"
+    arena = _native.Arena.create(name, size)
+    if arena is None:
+        return None
+    with _arena_lock:
+        _arenas[name] = arena
+        _write_arena_name = name
+    return name
+
+
+def attach_arena(name: str) -> Optional["object"]:
+    """Attach (once per process, cached) to an arena by segment name."""
+    with _arena_lock:
+        a = _arenas.get(name)
+    if a is not None:
+        return a
+    from ray_tpu import _native
+
+    a = _native.Arena.attach(name)
+    if a is not None:
+        with _arena_lock:
+            _arenas.setdefault(name, a)
+            a = _arenas[name]
+    return a
+
+
+def set_write_arena(name: Optional[str]) -> None:
+    """Select the arena new objects are written into (worker startup reads
+    the head-provided ``RAY_TPU_ARENA`` env; the head/driver sets directly)."""
+    global _write_arena_name
+    _write_arena_name = name
+
+
+def _current_write_arena():
+    global _write_arena_name
+    name = _write_arena_name
+    if name is None:
+        name = os.environ.get(_ARENA_ENV) or None
+        if name is None:
+            return None
+        _write_arena_name = name
+    return attach_arena(name)
+
+
+def unlink_arena(name: str) -> None:
+    with _arena_lock:
+        arena = _arenas.pop(name, None)
+    if arena is not None:
+        arena.unlink()
+    global _write_arena_name
+    if _write_arena_name == name:
+        _write_arena_name = None
 
 
 def _untrack(shm: shared_memory.SharedMemory) -> None:
@@ -55,24 +133,62 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
 
 
 def write_shm(sv: SerializedValue) -> ShmLocation:
-    """Lay a serialized value out in a fresh shm segment."""
+    """Lay a serialized value out in shared memory.
+
+    Small/medium values go into the native arena when one is attached (a
+    single allocation under the arena lock — no per-object syscalls); large
+    values, or everything when the native path is unavailable, get a
+    dedicated POSIX segment (zero-copy reads, mapping outlives unlink)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if sv.total_size <= GLOBAL_CONFIG.arena_max_object_bytes:
+        arena = _current_write_arena()
+        if arena is not None:
+            loc = _write_arena(arena, sv)
+            if loc is not None:
+                return loc  # else: arena full — fall through to a segment
+    return _write_segment(sv)
+
+
+def _layout(sv: SerializedValue) -> tuple[list[int], int]:
+    """Aligned buffer offsets + total size for [header][buf0][buf1...]."""
     hlen = len(sv.header)
     offs = [_align(hlen)]
     for b in sv.buffers[:-1] if sv.buffers else []:
-        offs.append(_align(offs[-1] + len(b.raw())))
-    total = (offs[-1] + len(sv.buffers[-1].raw())) if sv.buffers else hlen
-    total = max(total, 1)
+        offs.append(_align(offs[-1] + b.raw().nbytes))
+    total = (offs[-1] + sv.buffers[-1].raw().nbytes) if sv.buffers else hlen
+    return offs, max(total, 1)
+
+
+def _copy_into(mv, sv: SerializedValue, offs: list[int]) -> list[int]:
+    """Lay the serialized value out in ``mv``; returns buffer lengths."""
+    mv[: len(sv.header)] = sv.header
+    lens = []
+    for off, b in zip(offs, sv.buffers):
+        raw = b.raw()
+        n = raw.nbytes
+        mv[off : off + n] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+        lens.append(n)
+    return lens
+
+
+def _write_arena(arena, sv: SerializedValue) -> Optional[ShmLocation]:
+    offs, total = _layout(sv)
+    r = arena.alloc(total)
+    if r is None:
+        return None
+    base, gen = r
+    lens = _copy_into(arena.view(base, total), sv, offs)
+    return ShmLocation(arena.name, len(sv.header), lens, total, offset=base, gen=gen)
+
+
+def _write_segment(sv: SerializedValue) -> ShmLocation:
+    offs, total = _layout(sv)
     shm = shared_memory.SharedMemory(create=True, size=total)
     _untrack(shm)
     try:
-        shm.buf[:hlen] = sv.header
-        lens = []
-        for off, b in zip(offs, sv.buffers):
-            raw = b.raw()
-            n = raw.nbytes
-            shm.buf[off : off + n] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
-            lens.append(n)
-        loc = ShmLocation(shm.name, hlen, lens, total)
+        lens = _copy_into(shm.buf, sv, offs)
+        loc = ShmLocation(shm.name, len(sv.header), lens, total)
     finally:
         shm.close()
     return loc
@@ -87,17 +203,38 @@ def _quiet_close(shm: shared_memory.SharedMemory) -> None:
 
 
 class ShmReader:
-    """Attach to a segment and expose zero-copy out-of-band buffers.
+    """Read a stored object back.
 
-    The mapping must outlive any views handed to the deserialized value, so we
-    keep the SharedMemory open and let a weak registry close it when the value
-    is garbage collected (readers pin via ``hold``).
+    Dedicated segments expose zero-copy out-of-band buffers: the mapping must
+    outlive any views handed to the deserialized value, so we keep the
+    SharedMemory open and let a weak registry close it when the value is
+    garbage collected. Arena objects instead copy out under a pin (see
+    ``arena.cc``): the pin makes free-vs-read safe, and copying means the
+    block can be recycled the moment the pin drops — plasma's eviction
+    semantics without plasma's client bookkeeping. A vanished object (freed,
+    spilled, or arena gone) raises FileNotFoundError, which callers treat as
+    "re-fetch from the head" (see runtime._materialize).
     """
 
     def __init__(self, loc: ShmLocation):
+        self.loc = loc
+        self.shm = None
+        self._arena = None
+        if loc.offset is not None:
+            arena = attach_arena(loc.name)
+            if arena is None or not arena.pin(loc.offset, loc.gen):
+                raise FileNotFoundError(f"arena object gone: {loc.name}+{loc.offset}")
+            self._arena = arena
+            # Copy out immediately and drop the pin: the window where a
+            # concurrent free could recycle the block is exactly this copy,
+            # and the pin covers it.
+            try:
+                self._data = bytes(arena.view(loc.offset, loc.total_size))
+            finally:
+                arena.unpin(loc.offset)
+            return
         self.shm = shared_memory.SharedMemory(name=loc.name)
         _untrack(self.shm)
-        self.loc = loc
         # If this reader is GC'd while deserialized values still hold views
         # into the mapping, SharedMemory.__del__ would raise BufferError as
         # an unraisable error (noisy at exit; pytest's unraisable capture
@@ -108,9 +245,12 @@ class ShmReader:
 
         weakref.finalize(self, _quiet_close, self.shm)
 
+    def _mv(self):
+        return memoryview(self._data) if self.shm is None else self.shm.buf
+
     def read(self):
         loc = self.loc
-        mv = self.shm.buf
+        mv = self._mv()
         header = mv[: loc.header_len]
         bufs = []
         off = _align(loc.header_len)
@@ -121,12 +261,12 @@ class ShmReader:
         return value
 
     def read_serialized_bytes(self) -> bytes:
-        """Copy the segment back into wire format (for shipping an object to
-        a REMOTE node over the control socket — no shm across hosts)."""
+        """Copy back into wire format (for shipping an object to a REMOTE
+        node over the control socket — no shm across hosts)."""
         from ray_tpu._private.serialization import SerializedValue
 
         loc = self.loc
-        mv = self.shm.buf
+        mv = self._mv()
         header = bytes(mv[: loc.header_len])
         bufs = []
         off = _align(loc.header_len)
@@ -136,6 +276,8 @@ class ShmReader:
         return SerializedValue(header, bufs).to_bytes()
 
     def close(self):
+        if self.shm is None:
+            return  # arena reads hold no resources past __init__
         try:
             self.shm.close()
         except BufferError:
@@ -147,28 +289,40 @@ class ShmReader:
 
 
 class ShmOwner:
-    """Head-side registry of live segments; unlinks on free/shutdown."""
+    """Head-side registry of live objects; frees on release/shutdown.
+
+    Dedicated segments are unlinked; arena blocks are freed back to the
+    native allocator (a free racing a pinned reader defers to the last
+    unpin — arena.cc zombie protocol)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._segments: dict[str, int] = {}  # name -> size
+        # (segment name, arena offset or None) -> (size, gen)
+        self._objects: dict[tuple[str, Optional[int]], tuple[int, int]] = {}
         self.bytes_used = 0
 
     def register(self, loc: ShmLocation) -> None:
+        key = (loc.name, loc.offset)
         with self._lock:
-            if loc.name not in self._segments:
-                self._segments[loc.name] = loc.total_size
+            if key not in self._objects:
+                self._objects[key] = (loc.total_size, loc.gen)
                 self.bytes_used += loc.total_size
 
-    def unlink(self, name: str) -> None:
+    def unlink(self, loc: ShmLocation) -> None:
+        key = (loc.name, loc.offset)
         with self._lock:
-            size = self._segments.pop(name, None)
-            if size is not None:
-                self.bytes_used -= size
+            ent = self._objects.pop(key, None)
+            if ent is not None:
+                self.bytes_used -= ent[0]
+        if loc.offset is not None:
+            arena = attach_arena(loc.name)
+            if arena is not None:
+                arena.free(loc.offset, loc.gen)
+            return
         try:
             # attach registers with the resource tracker; unlink() unregisters
             # again, so no explicit _untrack here (it would double-unregister).
-            shm = shared_memory.SharedMemory(name=name)
+            shm = shared_memory.SharedMemory(name=loc.name)
             shm.close()
             shm.unlink()
         except FileNotFoundError:
@@ -176,10 +330,12 @@ class ShmOwner:
 
     def shutdown(self) -> None:
         with self._lock:
-            names = list(self._segments)
-            self._segments.clear()
+            keys = list(self._objects)
+            self._objects.clear()
             self.bytes_used = 0
-        for name in names:
+        for name, offset in keys:
+            if offset is not None:
+                continue  # the arena segment itself is unlinked by its owner
             try:
                 shm = shared_memory.SharedMemory(name=name)
                 shm.close()
