@@ -22,7 +22,7 @@ _COMMON = {
     "placement_group", "placement_group_bundle_index",
     "placement_group_capture_child_tasks", "_metadata", "label_selector",
 }
-_ACTOR_ONLY = {"max_restarts", "max_task_retries", "max_concurrency", "lifetime", "namespace", "get_if_exists"}
+_ACTOR_ONLY = {"max_restarts", "max_task_retries", "max_concurrency", "concurrency_groups", "lifetime", "namespace", "get_if_exists"}
 
 
 def validate(options: dict[str, Any], is_actor: bool) -> None:

@@ -35,6 +35,16 @@ class WorkerState:
         self.actor_instance = None
         self.actor_id: Optional[bytes] = None
         self.actor_pool = None  # ThreadPoolExecutor for max_concurrency > 1
+        # asyncio actors (any ``async def`` method): a dedicated event loop
+        # thread runs every method (single-thread state semantics, like the
+        # reference's per-concurrency-group asyncio loops, _raylet.pyx:2082);
+        # concurrency bounded per group by asyncio.Semaphore.
+        self.async_loop = None
+        self.group_sems: dict[str, object] = {}
+        self.group_pools: dict[str, object] = {}  # threaded actors w/ groups
+        self.async_tasks: dict[bytes, object] = {}  # task_id -> asyncio.Task
+        self.async_io_pool = None    # ThreadPoolExecutor: blocking arg fetches
+        self.async_done_pool = None  # ThreadPoolExecutor: result store/send
         self.running = True
         self.exec_thread_id: Optional[int] = None
         self.cancel_requested: set[bytes] = set()
@@ -69,6 +79,49 @@ def connect_head(address: str, authkey: bytes, retries: int = 3):
     raise last
 
 
+def _install_jax_platform_pin() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative in this worker.
+
+    Platform plugins can stomp the env var during ``import jax`` (observed:
+    the axon TPU plugin sets ``jax_platforms=axon,cpu`` at registration, so a
+    CI worker spawned with ``JAX_PLATFORMS=cpu`` would still compile onto the
+    TPU tunnel). Workers import jax lazily inside user functions, so pin the
+    config the moment jax first gets imported — then restore __import__ so
+    the steady state pays nothing."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import builtins
+    import sys
+
+    orig = builtins.__import__
+
+    def imp(name, *a, **k):
+        mod = orig(name, *a, **k)
+        # Nested imports during jax's own package init re-enter here with
+        # half-initialized modules, and the plugin can stomp the config at
+        # any point of that init — so re-assert after EVERY jax import and
+        # only disarm once jax is fully loaded with the value verified.
+        if name == "jax" or name.startswith("jax."):
+            jaxmod = sys.modules.get("jax")
+            cfg = getattr(jaxmod, "config", None)
+            try:
+                if cfg is not None and cfg.jax_platforms != want:
+                    cfg.update("jax_platforms", want)
+                spec = getattr(jaxmod, "__spec__", None)
+                if (
+                    cfg is not None
+                    and not getattr(spec, "_initializing", False)
+                    and cfg.jax_platforms == want
+                ):
+                    builtins.__import__ = orig  # verified: steady state pays 0
+            except Exception:
+                pass
+        return mod
+
+    builtins.__import__ = imp
+
+
 def main(
     socket_path: str,
     authkey: bytes,
@@ -76,6 +129,7 @@ def main(
     token: str = "",
     remote: bool = False,
 ):
+    _install_jax_platform_pin()
     try:
         conn = connect_head(socket_path, authkey)
     except FileNotFoundError:
@@ -117,6 +171,10 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
 
 def _handle_cancel(state: WorkerState, task_id: bytes):
     state.cancel_requested.add(task_id)
+    atask = state.async_tasks.get(task_id)
+    if atask is not None and state.async_loop is not None:
+        state.async_loop.call_soon_threadsafe(atask.cancel)
+        return
     tid = state.task_threads.get(task_id)
     if tid is not None:
         # best-effort async interrupt (reference: SIGINT into the worker),
@@ -132,7 +190,23 @@ def _exec_loop(state: WorkerState):
         spec = state.task_queue.get()
         if spec is None:
             break
-        if spec["kind"] == "actor_method" and state.actor_pool is not None:
+        if spec["kind"] == "actor_method" and state.async_loop is not None:
+            _dispatch_async(state, spec)
+        elif spec["kind"] == "actor_method" and state.group_pools:
+            group = spec.get("concurrency_group") or "_default"
+            pool = state.group_pools.get(group)
+            if pool is None:
+                err = rex.RayTaskError.from_exception(
+                    spec.get("name", "task"),
+                    ValueError(
+                        f"Unknown concurrency group {group!r}; declared: "
+                        f"{sorted(g for g in state.group_pools if g != '_default')}"
+                    ),
+                )
+                _finish_task(state, spec, err, is_error=True)
+            else:
+                pool.submit(_run_spec, state, spec)
+        elif spec["kind"] == "actor_method" and state.actor_pool is not None:
             state.actor_pool.submit(_run_spec, state, spec)
         else:
             _run_spec(state, spec)
@@ -261,6 +335,138 @@ def _run_task(state: WorkerState, spec: dict):
     )
 
 
+def _setup_actor_concurrency(state: WorkerState, spec: dict) -> None:
+    """Pick the actor's execution engine (reference: async actors on asyncio
+    event loops, _raylet.pyx:2082-2084; threaded actors + concurrency groups,
+    core_worker/transport/concurrency_group_manager.cc).
+
+    * any ``async def`` method -> one event-loop thread runs ALL methods
+      (so actor state is only ever touched from one thread); per-group
+      semaphores bound concurrency. Async actors default to a high limit
+      (1000, like the reference) unless max_concurrency says otherwise.
+    * plain class + concurrency_groups -> one thread pool per group.
+    * plain class + max_concurrency>1 -> single thread pool (legacy path).
+    """
+    import asyncio
+    import inspect
+
+    cls = type(state.actor_instance)
+    is_async = any(
+        inspect.iscoroutinefunction(getattr(cls, n, None))
+        for n in dir(cls)
+        if not n.startswith("__")
+    )
+    groups = dict(spec.get("concurrency_groups") or {})
+    mc = spec.get("max_concurrency")  # None = not set by the user
+    if is_async:
+        from concurrent.futures import ThreadPoolExecutor
+
+        state.async_loop = asyncio.new_event_loop()
+        threading.Thread(
+            target=state.async_loop.run_forever, name="actor-asyncio", daemon=True
+        ).start()
+        # async actors default to high concurrency (reference: 1000); an
+        # EXPLICIT max_concurrency=1 genuinely serializes the actor.
+        default_limit = 1000 if mc is None else max(int(mc), 1)
+        state.group_sems = {"_default": asyncio.Semaphore(default_limit)}
+        for g, n in groups.items():
+            state.group_sems[g] = asyncio.Semaphore(max(int(n), 1))
+        # Blocking head I/O runs on these, never on the loop thread. Arg
+        # fetches (which can wait indefinitely on unready ObjectRefs) and
+        # result completions get SEPARATE pools: if they shared one, enough
+        # blocked loads would starve the _finish_task that produces the very
+        # object those loads wait for (deadlock).
+        state.async_io_pool = ThreadPoolExecutor(
+            max_workers=min(32, max(4, len(groups) * 2 + 4)),
+            thread_name_prefix="actor-io",
+        )
+        state.async_done_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="actor-done"
+        )
+    elif groups:
+        from concurrent.futures import ThreadPoolExecutor
+
+        state.group_pools = {
+            "_default": ThreadPoolExecutor(max_workers=max(int(mc or 1), 1))
+        }
+        for g, n in groups.items():
+            state.group_pools[g] = ThreadPoolExecutor(max_workers=max(int(n), 1))
+    elif mc is not None and int(mc) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        state.actor_pool = ThreadPoolExecutor(max_workers=int(mc))
+
+
+def _dispatch_async(state: WorkerState, spec: dict) -> None:
+    """Schedule an actor method onto the actor's event loop immediately.
+
+    All blocking head I/O — arg fetch at the start, result store/send at the
+    end — runs on ``state.async_io_pool`` threads, never on the dispatch
+    thread (one unready ObjectRef arg must not block dispatch of the later
+    method that produces it) and never on the loop thread."""
+    import asyncio
+
+    asyncio.run_coroutine_threadsafe(_arun(state, spec), state.async_loop)
+
+
+async def _arun(state: WorkerState, spec: dict):
+    import asyncio
+    import functools
+    import inspect
+
+    loop = asyncio.get_running_loop()
+    task_id = spec["task_id"]
+    state.async_tasks[task_id] = asyncio.current_task()
+    is_error = False
+    try:
+        group = spec.get("concurrency_group")
+        if group and group not in state.group_sems:
+            raise ValueError(
+                f"Unknown concurrency group {group!r}; declared groups: "
+                f"{sorted(g for g in state.group_sems if g != '_default')}"
+            )
+        sem = state.group_sems[group or "_default"]
+        if task_id in state.cancel_requested:
+            raise rex.TaskCancelledError()
+        args, kwargs = await loop.run_in_executor(
+            state.async_io_pool, functools.partial(_load_args, state, spec)
+        )
+        async with sem:
+            if task_id in state.cancel_requested:
+                raise rex.TaskCancelledError()
+            method = getattr(state.actor_instance, spec["method_name"])
+            if inspect.iscoroutinefunction(method):
+                value = await method(*args, **kwargs)
+            else:
+                value = method(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001
+        if isinstance(e, asyncio.CancelledError):
+            value = rex.TaskCancelledError()
+        elif isinstance(e, (rex.TaskCancelledError, rex.RayTaskError)):
+            value = e
+        else:
+            value = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
+        is_error = True
+    finally:
+        state.async_tasks.pop(task_id, None)
+        state.cancel_requested.discard(task_id)
+    # fire-and-forget onto the dedicated completion pool: must not be
+    # cancellable, must not serialize on the loop thread, and must not queue
+    # behind blocked arg fetches (see _setup_actor_concurrency)
+    state.async_done_pool.submit(_finish_task, state, spec, value, is_error)
+
+
+def _finish_task(state: WorkerState, spec: dict, value, is_error: bool) -> None:
+    try:
+        results = _store_results(state, spec, value, is_error)
+    except BaseException:  # noqa: BLE001
+        traceback.print_exc()
+        results = []
+    state.ctx.send_raw(
+        ("task_done", {"task_id": spec["task_id"], "results": results, "results_error": is_error})
+    )
+
+
 def _cli_main():
     """Entry point for ``python -m ray_tpu._private.worker_main`` — workers
     are exec'd fresh (reference: worker_pool spawning default_worker.py), so
@@ -291,10 +497,7 @@ def _run_actor_create(state: WorkerState, spec: dict):
             state.actor_instance = cls(*args, **kwargs)
         state.actor_id = spec["actor_id"]
         state.ctx.current_actor = spec["actor_id"].hex()  # for get_runtime_context()
-        if spec.get("max_concurrency", 1) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            state.actor_pool = ThreadPoolExecutor(max_workers=spec["max_concurrency"])
+        _setup_actor_concurrency(state, spec)
         state.ctx.send_raw(("actor_ready", {"actor_id": spec["actor_id"], "error": None}))
     except BaseException as e:  # noqa: BLE001
         err = rex.RayTaskError.from_exception(spec.get("name", "actor"), e)

@@ -21,12 +21,19 @@ from ray_tpu._private.runtime import get_ctx
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        name: str,
+        num_returns: int = 1,
+        concurrency_group: Optional[str] = None,
+    ):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    _SUPPORTED_OPTIONS = frozenset({"num_returns"})
+    _SUPPORTED_OPTIONS = frozenset({"num_returns", "concurrency_group"})
 
     def options(self, **options) -> "ActorMethod":
         unknown = set(options) - self._SUPPORTED_OPTIONS
@@ -35,10 +42,17 @@ class ActorMethod:
                 f"Unsupported actor-method options: {sorted(unknown)} "
                 f"(supported: {sorted(self._SUPPORTED_OPTIONS)})"
             )
-        return ActorMethod(self._handle, self._name, options.get("num_returns", self._num_returns))
+        return ActorMethod(
+            self._handle,
+            self._name,
+            options.get("num_returns", self._num_returns),
+            options.get("concurrency_group", self._concurrency_group),
+        )
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit_method(self._name, args, kwargs, self._num_returns)
+        return self._handle._submit_method(
+            self._name, args, kwargs, self._num_returns, self._concurrency_group
+        )
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -63,9 +77,11 @@ class ActorHandle:
         meta = self._methods.get(name)
         if meta is None:
             raise AttributeError(f"Actor {self._class_name} has no method {name!r}")
-        return ActorMethod(self, name, meta.get("num_returns", 1))
+        return ActorMethod(
+            self, name, meta.get("num_returns", 1), meta.get("concurrency_group")
+        )
 
-    def _submit_method(self, name, args, kwargs, num_returns):
+    def _submit_method(self, name, args, kwargs, num_returns, concurrency_group=None):
         ctx = get_ctx()
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
         task_id, return_ids = ctx.new_task_returns(max(num_returns, 1))
@@ -80,6 +96,8 @@ class ActorHandle:
             "return_ids": return_ids,
             "name": f"{self._class_name}.{name}",
         }
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
         refs = ctx.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 
@@ -137,6 +155,9 @@ class ActorClass:
             m = getattr(self._cls, name, None)
             if callable(m):
                 methods[name] = {"num_returns": getattr(m, "_num_returns", 1)}
+                group = getattr(m, "_concurrency_group", None)
+                if group:
+                    methods[name]["concurrency_group"] = group
         return methods
 
     def remote(self, *args, **kwargs):
@@ -170,7 +191,10 @@ class ActorClass:
             "strategy": opt.to_strategy(options),
             "max_restarts": options.get("max_restarts", 0),
             "max_task_retries": options.get("max_task_retries", 0),
-            "max_concurrency": options.get("max_concurrency", 1),
+            # None = "not set": async actors then default to high concurrency
+            # (1000, reference semantics) while an explicit 1 serializes them
+            "max_concurrency": options.get("max_concurrency"),
+            "concurrency_groups": options.get("concurrency_groups"),
             "name": options.get("name") or self._cls.__name__,
             "lifetime": options.get("lifetime"),
             "methods": methods,
@@ -208,11 +232,15 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
 
 def method(**kwargs):
     """Decorator to override per-method defaults, e.g.
-    ``@ray_tpu.method(num_returns=2)`` (reference: ``ray.method``)."""
+    ``@ray_tpu.method(num_returns=2)`` or
+    ``@ray_tpu.method(concurrency_group="io")`` (reference: ``ray.method``,
+    concurrency groups in ``core_worker/transport/concurrency_group_manager.cc``)."""
 
     def wrap(fn):
         if "num_returns" in kwargs:
             fn._num_returns = kwargs["num_returns"]
+        if "concurrency_group" in kwargs:
+            fn._concurrency_group = kwargs["concurrency_group"]
         return fn
 
     return wrap
