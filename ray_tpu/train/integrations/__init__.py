@@ -1,0 +1,18 @@
+"""Framework integrations for ray_tpu.train.
+
+TPU-native counterpart of the reference's trainer integrations
+(``python/ray/train/huggingface/``, ``train/lightning/``, torch utils in
+``train/torch/train_loop_utils.py``): instead of wrapping torch models in
+DDP/FSDP, these adapters move weights and checkpoints between external
+ecosystems (HuggingFace transformers, orbax, flax) and the pjit-sharded
+JAX training stack.
+"""
+
+from ray_tpu.train.integrations.huggingface import (  # noqa: F401
+    gpt_config_from_hf,
+    load_hf_gpt2,
+)
+from ray_tpu.train.integrations.orbax import (  # noqa: F401
+    load_pytree_checkpoint,
+    save_pytree_checkpoint,
+)

@@ -1,0 +1,114 @@
+"""HuggingFace transformers interop.
+
+The reference integrates HF via torch Trainer callbacks
+(``python/ray/train/huggingface/transformers/``). The TPU-native equivalent
+is weight-level: convert a transformers GPT-2-family checkpoint into the
+stacked-layer pytree that ``ray_tpu.models.gpt`` trains with pjit, so HF
+models fine-tune on the JAX/XLA stack directly (no torch in the hot path).
+
+The stacked layout (layer dim in front, consumed by ``lax.scan``) is the only
+structural difference from the per-layer HF state dict; orientation of every
+kernel matches (HF Conv1D already stores (in, out)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.models.gpt import GPTConfig
+
+
+def gpt_config_from_hf(hf_config: Any, **overrides) -> GPTConfig:
+    """Build a ``GPTConfig`` from a ``transformers.GPT2Config``."""
+    fields = dict(
+        vocab_size=int(hf_config.vocab_size),
+        seq_len=int(hf_config.n_positions),
+        d_model=int(hf_config.n_embd),
+        n_layers=int(hf_config.n_layer),
+        n_heads=int(hf_config.n_head),
+    )
+    fields.update(overrides)
+    return GPTConfig(**fields)
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def load_hf_gpt2(
+    model_or_state_dict: Any,
+    cfg: Optional[GPTConfig] = None,
+    pad_vocab_to_multiple: int = 1,
+) -> tuple[GPTConfig, dict]:
+    """Convert a ``transformers`` GPT-2 model (or its state dict) into
+    ``(GPTConfig, params)`` for ``ray_tpu.models.gpt``.
+
+    ``pad_vocab_to_multiple=128`` pads the embedding/vocab dimension with
+    zero rows for MXU-friendly shapes (padded ids are never produced by a
+    tokenizer, so logits for them are inert).
+
+    Works fully offline: pass ``GPT2LMHeadModel(GPT2Config(...))`` built
+    locally, or any mapping of GPT-2 state-dict names to arrays.
+    """
+    if hasattr(model_or_state_dict, "state_dict"):
+        sd = model_or_state_dict.state_dict()
+        if cfg is None and hasattr(model_or_state_dict, "config"):
+            cfg = gpt_config_from_hf(model_or_state_dict.config)
+    else:
+        sd = dict(model_or_state_dict)
+    # accept both bare GPT2Model ("h.0...") and LMHead ("transformer.h.0...")
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    def get(name):
+        return _np(sd[prefix + name])
+
+    wte = get("wte.weight")
+    wpe = get("wpe.weight")
+    vocab, d = wte.shape
+    if cfg is None:
+        n_layers = 1 + max(
+            int(k.split(".")[1 if not prefix else 2])
+            for k in sd
+            if ".h." in ("." + k) or k.startswith("h.")
+        )
+        raise ValueError(
+            "pass cfg= or a model with .config (cannot infer n_heads from a "
+            f"state dict; saw {n_layers} layers)"
+        )
+    if pad_vocab_to_multiple > 1:
+        target = -(-vocab // pad_vocab_to_multiple) * pad_vocab_to_multiple
+        if target != vocab:
+            wte = np.concatenate([wte, np.zeros((target - vocab, d), np.float32)])
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, vocab_size=target)
+    L = cfg.n_layers
+
+    def stack(name):
+        return np.stack([get(f"h.{i}.{name}") for i in range(L)])
+
+    blocks = {
+        "ln1": {"scale": stack("ln_1.weight"), "bias": stack("ln_1.bias")},
+        "attn_qkv": {"kernel": stack("attn.c_attn.weight"), "bias": stack("attn.c_attn.bias")},
+        "attn_out": {"kernel": stack("attn.c_proj.weight"), "bias": stack("attn.c_proj.bias")},
+        "ln2": {"scale": stack("ln_2.weight"), "bias": stack("ln_2.bias")},
+        "mlp_in": {"kernel": stack("mlp.c_fc.weight"), "bias": stack("mlp.c_fc.bias")},
+        "mlp_out": {"kernel": stack("mlp.c_proj.weight"), "bias": stack("mlp.c_proj.bias")},
+    }
+    params = {
+        "embed": {"tokens": wte, "pos": wpe},
+        "blocks": blocks,
+        "ln_f": {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")},
+        # HF ties lm_head to wte (vocab, d); our head is (d, vocab)
+        "lm_head": {"kernel": np.ascontiguousarray(wte.T)},
+    }
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree.map(jnp.asarray, params)
+    return cfg, params

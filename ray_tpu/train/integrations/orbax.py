@@ -1,0 +1,50 @@
+"""Orbax-backed pytree checkpointing.
+
+The reference persists checkpoints as directories on a ``pyarrow.fs``
+(``python/ray/train/_checkpoint.py:56``, storage in
+``train/_internal/storage.py``). For JAX pytrees the TPU-native serializer is
+orbax: sharded-array aware, async-capable, restores under a different mesh
+(the multihost checkpoint story). These helpers bridge orbax directories and
+``ray_tpu.train.Checkpoint`` so ``session.report(checkpoint=...)`` can carry
+sharded model state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+_SUBDIR = "pytree"
+
+
+def save_pytree_checkpoint(state: Any, path: str) -> Checkpoint:
+    """Write ``state`` (a pytree of arrays/scalars) to ``path`` with orbax
+    and return a train ``Checkpoint`` handle for ``session.report``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, _SUBDIR), state, force=True)
+    return Checkpoint.from_directory(path)
+
+
+def load_pytree_checkpoint(
+    checkpoint: "Checkpoint | str", target: Optional[Any] = None
+) -> Any:
+    """Restore a pytree saved by :func:`save_pytree_checkpoint`.
+
+    ``target`` (a pytree of like-shaped arrays, e.g. from ``jax.eval_shape``
+    or a freshly-initialized model) restores with matching structure and
+    sharding; without it orbax returns the raw saved tree.
+    """
+    import orbax.checkpoint as ocp
+
+    path = checkpoint if isinstance(checkpoint, str) else checkpoint.path
+    item = os.path.join(os.path.abspath(path), _SUBDIR)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(item, item=target)
+        return ckptr.restore(item)
