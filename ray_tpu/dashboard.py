@@ -1,0 +1,157 @@
+"""Cluster dashboard: REST API + a small live HTML overview.
+
+TPU-native counterpart of the reference's dashboard head
+(``dashboard/head.py`` — an aiohttp REST server with per-module routes —
+plus the React frontend in ``dashboard/client/``). Re-designed for this
+runtime: cluster state already lives in the driver-attached head, so the
+dashboard is a stdlib ``ThreadingHTTPServer`` thread inside any attached
+process — no separate daemon, no node agents, no build step. Endpoints
+mirror the reference's REST surface (nodes/actors/tasks/jobs/metrics) and
+``/metrics`` serves Prometheus text like the metrics agent
+(``dashboard/modules/reporter/reporter_agent.py``).
+
+Usage::
+
+    ray_tpu.init()
+    url = ray_tpu.dashboard.start()     # -> http://127.0.0.1:8265
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+
+
+def _payload(path: str):
+    import ray_tpu
+    from ray_tpu.util import metrics as um
+    from ray_tpu.util import state as st
+
+    if path == "/api/version":
+        return {"ray_tpu": getattr(ray_tpu, "__version__", "dev"), "dashboard": 1}
+    if path == "/api/nodes":
+        return st.list_nodes()
+    if path == "/api/actors":
+        return st.list_actors()
+    if path == "/api/tasks":
+        return st.list_tasks()
+    if path == "/api/objects":
+        return st.list_objects()
+    if path == "/api/placement_groups":
+        return st.list_placement_groups()
+    if path == "/api/summary":
+        return st.summary()
+    if path == "/api/cluster_resources":
+        return {
+            "total": ray_tpu.cluster_resources(),
+            "available": ray_tpu.available_resources(),
+        }
+    if path == "/api/timeline":
+        return st.timeline()
+    if path == "/api/jobs":
+        try:
+            from ray_tpu.job import list_jobs
+
+            return [j if isinstance(j, dict) else j.__dict__ for j in list_jobs()]
+        except Exception:
+            return []
+    if path == "/api/metrics":
+        return um.collect()
+    return None
+
+
+_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;min-width:30rem}
+ td,th{border:1px solid #ccc;padding:.25rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f3f3f3} code{background:#f6f6f6;padding:0 .2rem}
+</style></head><body>
+<h1>ray_tpu cluster</h1>
+<div id="content">loading…</div>
+<script>
+async function j(u){return (await fetch(u)).json()}
+function table(rows, cols){
+ if(!rows.length) return "<i>none</i>";
+ let h="<table><tr>"+cols.map(c=>`<th>${c}</th>`).join("")+"</tr>";
+ for(const r of rows.slice(0,50))
+   h+="<tr>"+cols.map(c=>`<td>${r[c]===undefined?"":JSON.stringify(r[c])}</td>`).join("")+"</tr>";
+ return h+"</table>";
+}
+(async()=>{
+ const res=await j("/api/cluster_resources"), nodes=await j("/api/nodes"),
+   actors=await j("/api/actors"), summary=await j("/api/summary");
+ document.getElementById("content").innerHTML =
+  "<h2>Resources</h2><pre>"+JSON.stringify(res,null,1)+"</pre>"
+  +"<h2>Nodes ("+nodes.length+")</h2>"+table(nodes,["node_id","alive","resources"])
+  +"<h2>Actors ("+actors.length+")</h2>"+table(actors,["actor_id","class_name","state","name"])
+  +"<h2>Task summary</h2><pre>"+JSON.stringify(summary.tasks||summary,null,1)+"</pre>";
+})();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet by default
+        pass
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        try:
+            if self.path in ("/", "/index.html"):
+                body = _INDEX.encode()
+                ctype = "text/html; charset=utf-8"
+            elif self.path == "/metrics":
+                from ray_tpu.util import metrics as um
+
+                body = um.prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                data = _payload(self.path.rstrip("/"))
+                if data is None:
+                    self.send_error(404)
+                    return
+                body = json.dumps(data, default=str).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # surface handler bugs as 500s, not hangs
+            try:
+                self.send_error(500, str(e))
+            except Exception:
+                pass
+
+
+def start(host: str = "127.0.0.1", port: int = 8265) -> str:
+    """Start the dashboard server (idempotent). Returns its URL.
+
+    ``port=0`` picks a free port (the URL reports the real one)."""
+    global _server, _thread
+    if _server is not None:
+        h, p = _server.server_address[:2]
+        return f"http://{h}:{p}"
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    _server.daemon_threads = True
+    _thread = threading.Thread(target=_server.serve_forever, name="dashboard", daemon=True)
+    _thread.start()
+    h, p = _server.server_address[:2]
+    return f"http://{h}:{p}"
+
+
+def stop() -> None:
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _thread = None

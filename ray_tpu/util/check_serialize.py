@@ -1,0 +1,82 @@
+"""Serializability inspection.
+
+Counterpart of the reference's ``ray.util.check_serialize
+.inspect_serializability`` — walks a failing object's closure/attributes to
+point at the exact leaf that cloudpickle chokes on, instead of surfacing one
+opaque ``TypeError`` from deep inside a task submission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, List, Optional
+
+from ray_tpu._private import serialization as ser
+
+
+@dataclasses.dataclass
+class FailureTuple:
+    """One unserializable leaf. ``obj`` is the failing object, ``name`` its
+    best-known label, ``parent`` the container it was reached from."""
+
+    obj: Any
+    name: str
+    parent: Any
+
+    def __repr__(self):
+        return f"FailTuple({self.name} [obj={self.obj!r}, parent={self.parent!r}])"
+
+
+def _try_pickle(obj: Any) -> Optional[Exception]:
+    try:
+        ser.dumps(obj)
+        return None
+    except Exception as e:  # noqa: BLE001 - any serializer failure counts
+        return e
+
+
+def inspect_serializability(
+    obj: Any, name: Optional[str] = None, depth: int = 3, _failures=None, _seen=None
+) -> tuple[bool, List[FailureTuple]]:
+    """Check whether ``obj`` cloudpickles; on failure, descend into closures,
+    attributes and containers to locate root causes.
+
+    Returns ``(serializable, failures)`` where ``failures`` holds the deepest
+    offending leaves found (the reference prints a tree; we return the data
+    and let the caller format it).
+    """
+    name = name or getattr(obj, "__qualname__", None) or repr(obj)[:60]
+    failures: List[FailureTuple] = [] if _failures is None else _failures
+    seen = set() if _seen is None else _seen
+
+    err = _try_pickle(obj)
+    if err is None:
+        return True, failures
+    if id(obj) in seen or depth < 0:
+        return False, failures
+    seen.add(id(obj))
+
+    found_deeper = False
+    children: list[tuple[str, Any]] = []
+    if inspect.isfunction(obj) or inspect.ismethod(obj):
+        closure = inspect.getclosurevars(obj)
+        children += [(f"nonlocal {k}", v) for k, v in closure.nonlocals.items()]
+        children += [(f"global {k}", v) for k, v in closure.globals.items()]
+    elif isinstance(obj, dict):
+        children += [(str(k), v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"[{i}]", v) for i, v in enumerate(obj)]
+    elif hasattr(obj, "__dict__") and not inspect.isclass(obj):
+        children += list(vars(obj).items())
+
+    for child_name, child in children:
+        if _try_pickle(child) is not None:
+            found_deeper = True
+            ok, _ = inspect_serializability(
+                child, name=child_name, depth=depth - 1, _failures=failures, _seen=seen
+            )
+
+    if not found_deeper and not any(f.obj is obj for f in failures):
+        failures.append(FailureTuple(obj=obj, name=name, parent=None))
+    return False, failures

@@ -1,0 +1,95 @@
+"""util parity shims: multiprocessing.Pool, check_serialize, dashboard CLI.
+
+Reference counterparts: ``ray.util.multiprocessing`` (Pool over tasks),
+``ray.util.check_serialize.inspect_serializability``.
+"""
+
+import threading
+
+import pytest
+
+import ray_tpu
+
+
+class TestPool:
+    def test_apply_and_map(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.apply(pow, (2, 5)) == 32
+            assert p.map(lambda x: x * x, range(8)) == [x * x for x in range(8)]
+
+    def test_starmap_and_async(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+            ar = p.apply_async(pow, (2, 10))
+            assert ar.get(timeout=30) == 1024
+            assert ar.successful()
+
+    def test_imap_unordered_completes(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            out = sorted(p.imap_unordered(lambda x: x + 1, range(6)))
+        assert out == list(range(1, 7))
+
+    def test_async_error_propagates(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def boom(x):
+            raise RuntimeError("pool-kaboom")
+
+        with Pool(processes=2) as p:
+            ar = p.apply_async(boom, (1,))
+            with pytest.raises(RuntimeError, match="pool-kaboom"):
+                ar.get(timeout=30)
+            assert not ar.successful()
+
+    def test_initializer_runs_in_workers(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def setup(v):
+            import os
+
+            os.environ["POOL_INIT_FLAG"] = str(v)
+
+        def read(_):
+            import os
+
+            return os.environ.get("POOL_INIT_FLAG")
+
+        with Pool(processes=2, initializer=setup, initargs=(7,)) as p:
+            assert set(p.map(read, range(4))) == {"7"}
+
+
+class TestCheckSerialize:
+    def test_serializable_object_passes(self):
+        from ray_tpu.util.check_serialize import inspect_serializability
+
+        ok, failures = inspect_serializability({"a": [1, 2, 3]})
+        assert ok and not failures
+
+    def test_finds_offending_closure_var(self):
+        from ray_tpu.util.check_serialize import inspect_serializability
+
+        lock = threading.Lock()  # classic unserializable
+
+        def f():
+            return lock
+
+        ok, failures = inspect_serializability(f)
+        assert not ok
+        assert any(fail.obj is lock for fail in failures)
+
+    def test_finds_offending_attribute(self):
+        from ray_tpu.util.check_serialize import inspect_serializability
+
+        class Holder:
+            def __init__(self):
+                self.fine = 1
+                self.bad = threading.Lock()
+
+        ok, failures = inspect_serializability(Holder())
+        assert not ok and failures
