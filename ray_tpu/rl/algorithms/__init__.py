@@ -1,2 +1,6 @@
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rl.algorithms.bc import BC, BCConfig  # noqa: F401
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401

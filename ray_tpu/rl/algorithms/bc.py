@@ -1,0 +1,98 @@
+"""BC — behavior cloning from offline experience.
+
+Reference: ``rllib/algorithms/bc/`` (MARWIL with beta=0: pure supervised
+action imitation from an offline dataset). TPU shape: the whole update is
+one jitted max-likelihood step over columnar minibatches — no env stepping
+in the training path; env runners exist only to evaluate the cloned policy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.offline import OfflineDataset
+from ray_tpu.rl.rl_module import ActorCriticModule, RLModuleSpec
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iter = 100
+        #: OfflineDataset | path (.npz / .jsonl) — the training experience
+        self.offline_data = None
+        #: env steps rolled per iteration to measure the cloned policy
+        self.evaluation_steps = 0
+
+    algo_class = None  # set below
+
+
+def bc_loss(module: ActorCriticModule, params, batch):
+    """Negative log-likelihood of dataset actions (+ tiny value-head decay
+    so the unused critic cannot drift to inf under weight sharing)."""
+    actions = batch[sb.ACTIONS]
+    if module.discrete:
+        actions = actions.astype(jnp.int32)
+    logp, entropy, value = module.logp_entropy_value(params, batch[sb.OBS], actions)
+    nll = -jnp.mean(logp)
+    return nll + 1e-6 * jnp.mean(value**2), {
+        "nll": nll,
+        "entropy": jnp.mean(entropy),
+    }
+
+
+class BC(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "BCConfig":
+        return BCConfig()
+
+    def _setup(self):
+        cfg: BCConfig = self.config
+        self.dataset: OfflineDataset = OfflineDataset.resolve(
+            cfg.offline_data, seed=cfg.seed
+        )
+        obs_space, act_space = self.foreach_runner("get_spaces")[0]
+        spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
+        self.learner_group = LearnerGroup(
+            dict(
+                module_factory=lambda: ActorCriticModule(spec),
+                loss_fn=bc_loss,
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed or 0,
+            ),
+            remote=cfg.remote_learner,
+        )
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params):
+        self.learner_group.set_weights(params)
+        self.sync_weights(params)
+
+    def training_step(self) -> dict:
+        cfg: BCConfig = self.config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iter):
+            metrics = self.learner_group.update(self.dataset.sample(cfg.train_batch_size))
+        out = {f"learner/{k}": v for k, v in metrics.items()}
+        if cfg.evaluation_steps > 0:
+            self.sync_weights(self.learner_group.get_weights())
+            n_runners = max(1, len(self._runner_actors) or 1)
+            n_envs = max(1, cfg.num_envs_per_env_runner)
+            per = max(1, cfg.evaluation_steps // (n_runners * n_envs))
+            for b in self.foreach_runner("sample_transitions", per):
+                self._timesteps_total += b.count
+        else:
+            self._timesteps_total += cfg.updates_per_iter * cfg.train_batch_size
+        return out
+
+
+BCConfig.algo_class = BC
+register_algorithm("BC", BC)

@@ -1,0 +1,147 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Reference: ``rllib/algorithms/cql/`` (SAC objectives + a conservative
+penalty that pushes down Q-values of out-of-distribution actions so the
+offline policy cannot exploit extrapolation error). Reuses this repo's SAC
+module/loss composition (``sac.py``): one pytree, one jitted step; the CQL
+regularizer adds a logsumexp over sampled random + policy actions minus the
+dataset Q, weighted by ``cql_alpha``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+from ray_tpu.rl.algorithms.sac import SACModule, _polyak, sac_loss
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.offline import OfflineDataset
+from ray_tpu.rl.rl_module import RLModuleSpec
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.updates_per_iter = 200
+        self.tau = 0.005
+        self.target_entropy = "auto"
+        self.cql_alpha = 1.0          # conservative penalty weight
+        self.cql_n_actions = 4        # sampled actions per state for logsumexp
+        self.offline_data = None      # OfflineDataset | .npz/.jsonl path
+        self.evaluation_steps = 0
+
+    algo_class = None  # set below
+
+
+def cql_loss(gamma: float, target_entropy: float, cql_alpha: float, n_actions: int):
+    base = sac_loss(gamma, target_entropy)
+
+    def loss_fn(module: SACModule, params, batch):
+        total, metrics = base(module, params, batch)
+        obs = batch[sb.OBS]
+        act = batch[sb.ACTIONS]
+        B = obs.shape[0]
+        rng = jax.random.fold_in(jax.random.PRNGKey(1), batch["step"][0])
+
+        # OOD action set: uniform-random + current-policy samples per state
+        low = jnp.asarray(module.act_low)
+        high = jnp.asarray(module.act_high)
+        rand_a = jax.random.uniform(
+            jax.random.fold_in(rng, 0),
+            (n_actions, B, module.act_dim),
+            minval=low,
+            maxval=high,
+        )
+        pol_a, _ = module.sample_action_logp(
+            jax.lax.stop_gradient(params),
+            jnp.broadcast_to(obs, (n_actions,) + obs.shape),
+            jax.random.fold_in(rng, 1),
+        )
+        cand = jnp.concatenate([rand_a, pol_a], axis=0)        # (2n, B, act)
+        obs_rep = jnp.broadcast_to(obs, (2 * n_actions,) + obs.shape)
+        q1_ood, q2_ood = module.q_values(
+            params, obs_rep.reshape(-1, obs.shape[-1]), cand.reshape(-1, module.act_dim)
+        )
+        q1_ood = q1_ood.reshape(2 * n_actions, B)
+        q2_ood = q2_ood.reshape(2 * n_actions, B)
+        q1_data, q2_data = module.q_values(params, obs, act)
+
+        # logsumexp over candidate actions ≈ max Q on OOD actions
+        gap1 = jnp.mean(jax.scipy.special.logsumexp(q1_ood, axis=0) - q1_data)
+        gap2 = jnp.mean(jax.scipy.special.logsumexp(q2_ood, axis=0) - q2_data)
+        penalty = cql_alpha * (gap1 + gap2)
+        metrics = dict(metrics)
+        metrics["cql_penalty"] = penalty
+        return total + penalty, metrics
+
+    return loss_fn
+
+
+class CQL(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "CQLConfig":
+        return CQLConfig()
+
+    def _module_cls(self):
+        return SACModule
+
+    def _setup(self):
+        cfg: CQLConfig = self.config
+        self.dataset: OfflineDataset = OfflineDataset.resolve(
+            cfg.offline_data, seed=cfg.seed
+        )
+        obs_space, act_space = self.foreach_runner("get_spaces")[0]
+        spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
+        tgt_ent = (
+            -float(np.prod(act_space.shape))
+            if cfg.target_entropy == "auto"
+            else float(cfg.target_entropy)
+        )
+        self.learner_group = LearnerGroup(
+            dict(
+                module_factory=lambda: SACModule(spec),
+                loss_fn=cql_loss(cfg.gamma, tgt_ent, cfg.cql_alpha, cfg.cql_n_actions),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed or 0,
+            ),
+            remote=cfg.remote_learner,
+        )
+        self._update_step = 0
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params):
+        self.learner_group.set_weights(params)
+        self.sync_weights(params)
+
+    def training_step(self) -> dict:
+        cfg: CQLConfig = self.config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iter):
+            batch = self.dataset.sample(cfg.train_batch_size)
+            self._update_step += 1
+            batch["step"] = np.full(batch.count, self._update_step, np.int32)
+            metrics = self.learner_group.update(batch)
+            self.learner_group.apply(_polyak(cfg.tau))
+        out = {f"learner/{k}": v for k, v in metrics.items()}
+        if cfg.evaluation_steps > 0:
+            self.sync_weights(self.learner_group.get_weights())
+            n_runners = max(1, len(self._runner_actors) or 1)
+            per = max(1, cfg.evaluation_steps // n_runners)
+            for b in self.foreach_runner("sample_transitions", per):
+                self._timesteps_total += b.count
+        else:
+            self._timesteps_total += cfg.updates_per_iter * cfg.train_batch_size
+        return out
+
+
+CQLConfig.algo_class = CQL
+register_algorithm("CQL", CQL)
