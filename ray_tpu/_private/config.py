@@ -43,6 +43,20 @@ class Config:
     #: can be recycled safely — see arena.cc pin/generation protocol).
     arena_max_object_bytes: int = 256 * 1024
 
+    #: Rebuild lost task-produced objects by resubmitting their creating
+    #: task (reference: object_recovery_manager.h lineage reconstruction).
+    enable_lineage_reconstruction: bool = True
+    #: Total bytes of creating-task specs retained for reconstruction;
+    #: beyond this the oldest objects silently lose reconstructability
+    #: (reference: lineage total-size eviction in reference_count.h).
+    max_lineage_bytes: int = 64 * 1024 * 1024
+    #: Path for head-state snapshots (KV store, function table). Empty =
+    #: no persistence. With a path set, a restarting head reloads the
+    #: snapshot (reference: GCS Redis-backed table storage for HA).
+    gcs_snapshot_path: str = ""
+    #: Seconds between periodic snapshots (also written at shutdown).
+    gcs_snapshot_interval_s: float = 10.0
+
     # -- scheduler ---------------------------------------------------------
     #: Hybrid scheduling policy: pack onto busiest feasible node until its
     #: critical-resource utilization exceeds this threshold, then prefer the

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import queue
 import threading
 import time
 from collections import deque
@@ -52,7 +53,7 @@ from ray_tpu._private.shm_store import ShmLocation, ShmOwner
 class ObjectEntry:
     __slots__ = (
         "small", "shm", "is_error", "refcount", "pins", "size",
-        "spill_path", "last_access", "last_read", "borrow_nonces",
+        "spill_path", "last_access", "last_read", "borrow_nonces", "lineage",
     )
 
     def __init__(self):
@@ -69,6 +70,10 @@ class ObjectEntry:
         # the (first) deserializer claims it (reference: borrower registration
         # in core_worker/reference_count.h:61)
         self.borrow_nonces: Optional[set] = None
+        # creating-task spec for lineage reconstruction (reference:
+        # object_recovery_manager.h:41 rebuilds lost objects by resubmitting
+        # the task; task_manager.cc lineage). None for ray.put objects.
+        self.lineage: Optional[dict] = None
 
     @property
     def ready(self) -> bool:
@@ -252,6 +257,7 @@ class Head:
         self.socket_path = socket_path
         self.authkey = authkey
         self.shm_owner = ShmOwner()
+        self._snapshot_path = GLOBAL_CONFIG.gcs_snapshot_path or None
         # Native object arena (plasma equivalent, ray_tpu/_native/arena.cc):
         # one shared segment for this host's small/medium objects. None when
         # disabled or the native build is unavailable (pure-Python fallback:
@@ -265,6 +271,17 @@ class Head:
         self.objects: dict[bytes, ObjectEntry] = {}
         self.functions: dict[bytes, bytes] = {}  # func table (reference: GCS fn table)
         self.kv: dict[str, bytes] = {}
+        # pubsub: channel -> sinks; a sink is ("conn", conn) for socket
+        # clients or ("fn", callable) for in-process subscribers (reference:
+        # src/ray/pubsub/ long-poll channels, GCS actor/node update feeds)
+        self._subs: dict[str, list] = {}
+        self._pub_locks: dict[int, threading.Lock] = {}
+        self._pub_queue: "queue.Queue" = queue.Queue()
+        self._snapshot_due = 0.0
+        self._lineage_fifo: deque = deque()
+        self._lineage_total = 0
+        if self._snapshot_path:
+            self._load_snapshot()  # after the tables above exist
 
         self.nodes: dict[bytes, NodeState] = {}
         self.node_order: list[bytes] = []
@@ -304,6 +321,9 @@ class Head:
         h = threading.Thread(target=self._health_loop, name="head-health", daemon=True)
         h.start()
         self._threads.append(h)
+        pub = threading.Thread(target=self._publisher_loop, name="head-pub", daemon=True)
+        pub.start()
+        self._threads.append(pub)
         if GLOBAL_CONFIG.memory_monitor_refresh_ms > 0:
             m = threading.Thread(
                 target=self._memory_monitor_loop, name="head-memmon", daemon=True
@@ -398,7 +418,12 @@ class Head:
         return node_id
 
     def _dispatch_request(self, conn, worker, seq, method, payload, remote: bool = False):
-        handler = getattr(self, "rpc_" + method)
+        if method in ("subscribe", "unsubscribe"):
+            import functools
+
+            handler = functools.partial(getattr(self, "_rpc_" + method), conn)
+        else:
+            handler = getattr(self, "rpc_" + method)
         if remote and method == "get":
             handler = self._rpc_get_remote
         blocking = method in ("get", "wait", "pg_ready", "get_actor_named")
@@ -588,6 +613,7 @@ class Head:
             self.node_order.append(node_id.binary())
             self._retry_pending_pgs()
             self._schedule()
+        self.publish("nodes", {"event": "added", "node_id": node_id.hex(), "resources": dict(resources)})
         return node_id
 
     def remove_node(self, node_id: NodeID, graceful: bool = False) -> None:
@@ -600,6 +626,8 @@ class Head:
                 return
             node.alive = False
             workers = list(node.all_workers)
+        self.publish("nodes", {"event": "removed", "node_id": node_id.hex()})
+        with self.lock:
             assigned = list(node.assigned)
             node.assigned.clear()
             node.idle_workers.clear()
@@ -840,6 +868,17 @@ class Head:
             self._unpin_deps(rec["spec"])
             for obj_id, locator in payload.get("results", []):
                 self._store_locator(obj_id, locator)
+                # remember how to recompute a lost copy (normal tasks only:
+                # actor-method replay needs the actor's state at call time)
+                if (
+                    not payload.get("results_error")
+                    and rec["spec"]["kind"] == "task"
+                    and GLOBAL_CONFIG.enable_lineage_reconstruction
+                ):
+                    ent = self.objects.get(obj_id)
+                    if ent is not None:
+                        ent.lineage = rec["spec"]
+                        self._lineage_track(obj_id, rec["spec"])
             self._event(rec, "FINISHED" if not payload.get("results_error") else "FAILED")
             spec = rec["spec"]
             if spec["kind"] == "actor_method":
@@ -893,6 +932,9 @@ class Head:
     def _health_loop(self):
         while not self._shutdown:
             time.sleep(GLOBAL_CONFIG.health_check_interval_s)
+            if self._snapshot_path and time.monotonic() >= self._snapshot_due:
+                self._snapshot_due = time.monotonic() + GLOBAL_CONFIG.gcs_snapshot_interval_s
+                self._snapshot()
             dead, reap = [], []
             keep = GLOBAL_CONFIG.idle_worker_keep_alive_s
             now = time.monotonic()
@@ -1099,6 +1141,7 @@ class Head:
                 self._kill_actor_locked(actor, payload["error"], restart=False)
                 return
             actor.state = ACTOR_ALIVE
+            self.publish("actors", {"event": "ALIVE", "actor_id": actor.actor_id.hex(), "name": actor.name})
             actor.worker = wh
             wh.actor_id = actor_id
             rec = self.tasks.pop(actor.create_spec["task_id"], None)
@@ -1164,6 +1207,7 @@ class Head:
             if actor.restarts_left > 0:
                 actor.restarts_left -= 1
             actor.state = ACTOR_RESTARTING
+            self.publish("actors", {"event": "RESTARTING", "actor_id": actor.actor_id.hex(), "name": actor.name})
             # inflight calls with retry budget left are re-queued ahead of new
             # calls; the rest fail (reference: max_task_retries per call,
             # -1 = unlimited)
@@ -1203,6 +1247,7 @@ class Head:
 
     def _kill_actor_locked(self, actor: ActorState, cause, restart: bool, inflight=None):
         actor.state = ACTOR_DEAD
+        self.publish("actors", {"event": "DEAD", "actor_id": actor.actor_id.hex(), "name": actor.name})
         actor.death_cause = str(cause)
         err = cause if isinstance(cause, Exception) else rex.ActorDiedError(msg=str(cause))
         for s in (inflight or []) + list(actor.inflight.values()) + list(actor.pending_calls):
@@ -1282,9 +1327,12 @@ class Head:
                     if ent is not None and ent.ready:
                         if ent.small is None and ent.shm is None:
                             self._restore_spilled(oid, ent)  # transparent
-                        ent.last_access = ent.last_read = time.monotonic()
-                        out.append(ent.locator())
-                        break
+                        if ent.ready:  # restore may fail INTO lineage
+                            # reconstruction, which empties the entry — then
+                            # keep waiting for the recomputed value instead
+                            ent.last_access = ent.last_read = time.monotonic()
+                            out.append(ent.locator())
+                            break
                     remaining = None if deadline is None else deadline - time.monotonic()
                     if remaining is not None and remaining <= 0:
                         raise rex.GetTimeoutError(f"Get timed out on {ObjectID(oid)}")
@@ -1399,15 +1447,10 @@ class Head:
             with open(ent.spill_path, "rb") as f:
                 data = f.read()
             sv = ser.SerializedValue.from_bytes(data)
-        except Exception as e:
+        except Exception:
             ent.spill_path = None
-            err = ser.serialize(
-                rex.ObjectLostError(
-                    ObjectID(obj_id).hex(), f"spilled copy unreadable: {e!r}"
-                )
-            )
-            ent.small = err.to_bytes()
-            ent.is_error = True
+            # rebuild via lineage; failure stores ObjectLostError on the entry
+            self._reconstruct(obj_id, ent)
             return
         self._ensure_capacity(sv.total_size)
         ent.shm = write_shm(sv)
@@ -1417,6 +1460,104 @@ class Head:
         except OSError:
             pass
         ent.spill_path = None
+
+    def _lineage_spec_size(self, spec: dict) -> int:
+        n = 512
+        for a in list(spec.get("args", ())) + list(spec.get("kwargs", {}).values()):
+            if a[0] != "r":
+                n += len(a[1])
+        return n
+
+    def _lineage_track(self, obj_id: bytes, spec: dict) -> None:
+        """Lock held. Bound total retained lineage (reference: lineage
+        total-size eviction, reference_count.h lineage pinning budget):
+        over the cap, the oldest objects silently lose reconstructability."""
+        size = self._lineage_spec_size(spec)
+        self._lineage_fifo.append((obj_id, size))
+        self._lineage_total += size
+        cap = GLOBAL_CONFIG.max_lineage_bytes
+        while self._lineage_total > cap and self._lineage_fifo:
+            old_id, old_size = self._lineage_fifo.popleft()
+            self._lineage_total -= old_size
+            old = self.objects.get(old_id)
+            if old is not None:
+                old.lineage = None
+
+    def _reconstruct(self, obj_id: bytes, ent: ObjectEntry) -> bool:
+        """Lock held. Resubmit the creating task to rebuild a lost object
+        (reference: ObjectRecoveryManager::RecoverObject,
+        core_worker/object_recovery_manager.h:41). Returns True when a
+        resubmission is queued/running — getters then block until the task
+        stores fresh results. Fails (False) when an input of the creating
+        task is itself gone without lineage."""
+        spec = ent.lineage
+        ent.small = None
+        ent.shm = None
+        ent.spill_path = None
+        if spec is not None and spec["task_id"] in self.tasks:
+            return True  # already being recomputed (another lost return)
+        pinned: list = []
+        failed = spec is None  # e.g. ray.put objects: no creating task
+        for _kind, arg_id in (() if spec is None else _iter_arg_refs(spec)):
+            arg = self.objects.get(arg_id)
+            if arg is None:
+                failed = True  # input gone without a record: unrecoverable
+                break
+            in_flight = any(
+                arg_id in t["spec"]["return_ids"] for t in self.tasks.values()
+            )
+            if not arg.ready and not in_flight and not self._reconstruct(arg_id, arg):
+                # recursive rebuild impossible (marked LOST below): this
+                # task would wait on its arg forever — fail instead of hang
+                failed = True
+                break
+            arg.pins += 1
+            pinned.append(arg)
+        if failed:
+            for arg in pinned:  # no task queued: release this loop's pins
+                arg.pins -= 1
+            err = ser.serialize(
+                rex.ObjectLostError(
+                    ObjectID(obj_id).hex(), "object lost and not reconstructable"
+                )
+            )
+            ent.small = err.to_bytes()
+            ent.is_error = True
+            ent.lineage = None
+            return False
+        rec = {
+            "task_id": spec["task_id"],
+            "spec": spec,
+            "state": "PENDING",
+            "worker": None,
+            "retries_left": 0,
+            "reconstruction": True,
+        }
+        self.tasks[spec["task_id"]] = rec
+        self.pending_sched.append(rec)
+        self._event(rec, "PENDING_ARGS_AVAIL")
+        self._schedule()
+        return True
+
+    def rpc_report_lost(self, obj_ids):
+        """A reader found an object's shm backing gone (segment unlinked /
+        arena block recycled): verify, then reconstruct via lineage or mark
+        LOST. The caller re-issues its get, which blocks until ready."""
+        from ray_tpu._private.shm_store import ShmReader
+
+        with self.lock:
+            for oid in obj_ids:
+                ent = self.objects.get(oid)
+                if ent is None or ent.small is not None or ent.shm is None:
+                    continue  # inline data or already being handled
+                try:
+                    ShmReader(ent.shm).close()
+                    continue  # backing is actually fine (caller raced)
+                except FileNotFoundError:
+                    pass
+                self.shm_owner.unlink(ent.shm)
+                self._reconstruct(oid, ent)  # failure stores ObjectLostError
+            self.cv.notify_all()
 
     def free_objects(self, obj_ids: list[bytes]):
         with self.lock:
@@ -1597,6 +1738,114 @@ class Head:
             sv = ser.SerializedValue.from_bytes(payload)
             return ("shm", write_shm(sv), is_err)
         return locator
+
+    # ---------------------------------------------------------------- pubsub
+
+    def _conn_lock(self, conn) -> threading.Lock:
+        wh = self._conn_worker.get(conn)
+        if wh is not None:
+            return wh.send_lock
+        lock = self._pub_locks.get(id(conn))
+        if lock is None:
+            lock = self._pub_locks.setdefault(id(conn), threading.Lock())
+        return lock
+
+    def _rpc_subscribe(self, conn, channel):
+        with self.lock:
+            self._subs.setdefault(channel, []).append(("conn", conn))
+
+    def _rpc_unsubscribe(self, conn, channel):
+        with self.lock:
+            sinks = self._subs.get(channel, [])
+            self._subs[channel] = [s for s in sinks if s != ("conn", conn)]
+
+    def subscribe_local(self, channel: str, fn) -> None:
+        """In-process subscription (the driver shares this process)."""
+        with self.lock:
+            self._subs.setdefault(channel, []).append(("fn", fn))
+
+    def unsubscribe_local(self, channel: str, fn) -> None:
+        with self.lock:
+            sinks = self._subs.get(channel, [])
+            self._subs[channel] = [s for s in sinks if s != ("fn", fn)]
+
+    def publish(self, channel: str, payload) -> None:
+        """Queue a message for every subscriber of ``channel`` (reference:
+        src/ray/pubsub/publisher.h — GCS-push counterpart). Delivery happens
+        on a dedicated publisher thread: callers frequently hold the head
+        lock, and a blocking send to one slow subscriber must never stall
+        the control plane."""
+        self._pub_queue.put((channel, payload))
+
+    rpc_publish = publish
+
+    def _publisher_loop(self) -> None:
+        while True:
+            item = self._pub_queue.get()
+            if item is None:
+                return
+            channel, payload = item
+            with self.lock:
+                sinks = list(self._subs.get(channel, ()))
+            dead = []
+            for kind, sink in sinks:
+                if kind == "fn":
+                    try:
+                        sink(channel, payload)
+                    except Exception:
+                        pass
+                    continue
+                try:
+                    with self._conn_lock(sink):
+                        sink.send(("pub", channel, payload))
+                except Exception:
+                    dead.append((kind, sink))
+            if dead:
+                with self.lock:
+                    self._subs[channel] = [
+                        s for s in self._subs.get(channel, []) if s not in dead
+                    ]
+
+    # ------------------------------------------------------------- snapshot
+
+    def _snapshot(self) -> None:
+        """Persist restartable head state (reference: GCS table storage —
+        gcs_table_storage.cc with the Redis backend for HA). Scope: the KV
+        store and function table; live processes (workers/actors) are not
+        resurrectable across a head restart by design."""
+        path = self._snapshot_path
+        if not path:
+            return
+        import pickle as _pickle
+
+        with self.lock:
+            blob = _pickle.dumps(
+                {"version": 1, "kv": dict(self.kv), "functions": dict(self.functions)}
+            )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _load_snapshot(self) -> None:
+        path = self._snapshot_path
+        if not path or not os.path.exists(path):
+            return
+        import pickle as _pickle
+
+        try:
+            with open(path, "rb") as f:
+                data = _pickle.loads(f.read())
+            self.kv.update(data.get("kv", {}))
+            self.functions.update(data.get("functions", {}))
+        except Exception:
+            pass  # a torn snapshot must not block cluster start
 
     def rpc_put(self, obj_id, small, shm, is_error=False):
         locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
@@ -1891,6 +2140,8 @@ class Head:
                 self._tcp_listener.close()
             except Exception:
                 pass
+        self._pub_queue.put(None)
+        self._snapshot()
         self.shm_owner.shutdown()
         if self.arena_name:
             from ray_tpu._private import shm_store as _shm

@@ -142,10 +142,43 @@ class BaseContext:
         self.current_actor = None  # set in actor workers
         self.node_id_bin: Optional[bytes] = None
         self.task_depth = 0
+        # pubsub: channel -> local callbacks fed by head "pub" pushes
+        # (reference: src/ray/pubsub subscriber channels)
+        self._pub_sinks: dict[str, list] = {}
+        self._pub_lock = threading.Lock()
 
     # -- transport: subclasses implement call() --------------------------------
     def call(self, method: str, **payload) -> Any:
         raise NotImplementedError
+
+    # -- pubsub ------------------------------------------------------------
+    def on_pub(self, channel: str, payload) -> None:
+        with self._pub_lock:
+            sinks = list(self._pub_sinks.get(channel, ()))
+        for fn in sinks:
+            try:
+                fn(channel, payload)
+            except Exception:
+                pass
+
+    def pub_register(self, channel: str, fn) -> None:
+        with self._pub_lock:
+            first = not self._pub_sinks.get(channel)  # missing OR emptied
+            self._pub_sinks.setdefault(channel, []).append(fn)
+        if first:
+            self.call("subscribe", channel=channel)
+
+    def pub_unregister(self, channel: str, fn) -> None:
+        with self._pub_lock:
+            sinks = self._pub_sinks.get(channel, [])
+            if fn in sinks:
+                sinks.remove(fn)
+            empty = not sinks
+        if empty:
+            try:
+                self.call("unsubscribe", channel=channel)
+            except Exception:
+                pass
 
     # -- objects ----------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
@@ -191,8 +224,23 @@ class BaseContext:
                         raise
                     reader = None
         if reader is None:
+            # tell the head the backing is gone so it can restore from spill
+            # or rebuild via lineage (reference: object recovery manager),
+            # then block in get until a fresh copy lands
+            try:
+                self.call("report_lost", obj_ids=[obj_id])
+            except Exception:
+                pass
             fresh = self.call("get", obj_ids=[obj_id], timeout=None)[0]
-            return self._materialize(obj_id, fresh, _retry=False)
+            value = self._materialize(obj_id, fresh, _retry=False)
+            if fresh[2]:
+                # the object resolved to an error AFTER the caller already
+                # checked its (stale) locator — raise here, matching the
+                # caller-side error semantics
+                if isinstance(value, rex.RayTaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            return value
         value = reader.read()
         self._sweep_readers()
         return value
@@ -275,6 +323,10 @@ class DriverContext(BaseContext):
         self.node_id_bin = node_id_bin
 
     def call(self, method: str, **payload):
+        if method == "subscribe":
+            return self.head.subscribe_local(payload["channel"], self.on_pub)
+        if method == "unsubscribe":
+            return self.head.unsubscribe_local(payload["channel"], self.on_pub)
         if method == "free_ref_async":
             return self.head.remove_ref(payload["obj_id"])
         if method == "add_ref":
@@ -380,6 +432,8 @@ class RemoteDriverContext(WorkerContext):
             if msg[0] == "resp":
                 _, seq, ok, payload = msg
                 self.on_response(seq, ok, payload)
+            elif msg[0] == "pub":
+                self.on_pub(msg[1], msg[2])
 
     def shutdown(self):
         super().shutdown()

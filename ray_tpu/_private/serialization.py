@@ -47,13 +47,21 @@ class SerializedValue:
     @classmethod
     def from_bytes(cls, data: bytes | memoryview) -> "SerializedValue":
         mv = memoryview(data)
+        if len(mv) < 12:
+            raise ValueError("truncated serialized value")
         hlen = int.from_bytes(mv[:8], "little")
         nbuf = int.from_bytes(mv[8:12], "little")
         off = 12
+        if nbuf > (len(mv) - off) // 8:
+            raise ValueError("corrupt serialized value (buffer count)")
         sizes = []
         for _ in range(nbuf):
             sizes.append(int.from_bytes(mv[off : off + 8], "little"))
             off += 8
+        if off + hlen + sum(sizes) != len(mv):
+            # length mismatch = truncated/corrupt payload (spill-file rot is
+            # the practical case; callers fall back to lineage/LOST)
+            raise ValueError("corrupt serialized value (length mismatch)")
         header = bytes(mv[off : off + hlen])
         off += hlen
         bufs = []

@@ -159,6 +159,8 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
         if kind == "resp":
             _, seq, ok, payload = msg
             ctx.on_response(seq, ok, payload)
+        elif kind == "pub":
+            ctx.on_pub(msg[1], msg[2])
         elif kind == "run_task":
             state.task_queue.put(msg[1])
         elif kind == "cancel":
@@ -305,7 +307,7 @@ def _run_task(state: WorkerState, spec: dict):
         if task_id in state.cancel_requested:
             raise rex.TaskCancelledError()
         if spec["kind"] == "actor_method":
-            method = getattr(state.actor_instance, spec["method_name"])
+            method = _resolve_actor_method(state, spec["method_name"])
             args, kwargs = _load_args(state, spec)
             value = method(*args, **kwargs)
         else:
@@ -333,6 +335,52 @@ def _run_task(state: WorkerState, spec: dict):
     state.ctx.send_raw(
         ("task_done", {"task_id": task_id, "results": results, "results_error": is_error})
     )
+
+
+def _resolve_actor_method(state: WorkerState, name: str):
+    if name == "__dag_exec__":
+        import functools
+
+        return functools.partial(_dag_exec_loop, state.actor_instance)
+    return getattr(state.actor_instance, name)
+
+
+def _dag_exec_loop(instance, method_name: str, in_specs, out_channels):
+    """Compiled-DAG executor (reference: compiled_dag_node.py executors).
+
+    Owns this actor's dispatch queue until teardown: block on the input
+    channels, invoke the bound method, push the result to every consumer
+    edge. Exceptions travel through the channels as wrapped errors so the
+    driver's CompiledDAGRef.get re-raises them; channel close ends the loop.
+    """
+    from ray_tpu.dag.compiled import _WrappedError
+    from ray_tpu.experimental.channel import ChannelClosed
+
+    method = getattr(instance, method_name)
+    while True:
+        try:
+            # drain EVERY input channel each round, even when one carries an
+            # upstream error — skipping reads would desynchronize multi-input
+            # nodes (later rounds pairing values from different executions)
+            args = []
+            upstream_err = None
+            for kind, v in in_specs:
+                if kind == "chan":
+                    v = v.read()
+                    if isinstance(v, _WrappedError) and upstream_err is None:
+                        upstream_err = v
+                args.append(v)
+            if upstream_err is not None:
+                value = upstream_err
+            else:
+                try:
+                    value = method(*args)
+                except BaseException as e:  # noqa: BLE001 - ships to driver
+                    value = _WrappedError(e)
+            for out in out_channels:
+                out.write(value)
+        except ChannelClosed:
+            return "closed"
 
 
 def _setup_actor_concurrency(state: WorkerState, spec: dict) -> None:
