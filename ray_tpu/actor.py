@@ -54,6 +54,13 @@ class ActorMethod:
             self._name, args, kwargs, self._num_returns, self._concurrency_group
         )
 
+    def bind(self, *args, **kwargs):
+        """DAG node for this actor method (reference: dag ClassMethodNode);
+        compiled DAGs bind methods on live actor handles."""
+        from ray_tpu.dag.compiled import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *a, **k):
         raise TypeError(
             f"Actor method {self._name}() cannot be called directly; use .remote()."
@@ -70,6 +77,11 @@ class ActorHandle:
     @property
     def _actor_id_hex(self) -> str:
         return self._actor_id.hex()
+
+    @property
+    def __dag_exec__(self) -> ActorMethod:
+        """Internal: the compiled-DAG executor loop entry (worker builtin)."""
+        return ActorMethod(self, "__dag_exec__")
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):

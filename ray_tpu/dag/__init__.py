@@ -66,6 +66,13 @@ class DAGNode:
         }
         return self._execute_memo(memo)
 
+    def experimental_compile(self, buffer_size_bytes: int = 1 << 20):
+        """Compile this DAG for channel-based repeated execution
+        (reference: ``dag_node.py:108`` experimental_compile)."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes)
+
     def _collect_inputs(self) -> list["InputNode"]:
         inputs: list = []
         visited: set[int] = set()  # diamonds: walk each node once

@@ -1,0 +1,3 @@
+"""Experimental runtime features (reference: ``python/ray/experimental/``)."""
+
+from ray_tpu.experimental.channel import Channel  # noqa: F401
