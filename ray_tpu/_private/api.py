@@ -56,6 +56,11 @@ def init(
     if object_store_memory:
         # sizes both the node "memory" resource and the spill watermark
         GLOBAL_CONFIG.object_store_memory = int(object_store_memory)
+    if address is not None and address.startswith("ray://"):
+        # client mode (reference: ray:// gRPC proxy, util/client/) — here the
+        # remote-driver TCP attach IS the client protocol, so the scheme is
+        # an alias for it
+        address = address[len("ray://"):]
     if (
         address is not None
         and _head is None
@@ -89,7 +94,15 @@ def init(
     else:
         _session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
         sock = os.path.join(_session_dir, "head.sock")
-        head = Head(sock, authkey=os.urandom(16))
+        # RAY_TPU_AUTHKEY makes this cluster attachable from other
+        # processes/hosts (scripts.py head path uses the same secret);
+        # without it, a fresh random key isolates the session
+        from ray_tpu._private.config import resolve_authkey as _rk
+
+        head = Head(
+            sock,
+            authkey=_rk() if os.environ.get("RAY_TPU_AUTHKEY") else os.urandom(16),
+        )
         head.start()
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus if num_cpus is not None else os.cpu_count() or 1))

@@ -128,5 +128,35 @@ def read_sql(
     )
 
 
+def read_webdataset(paths, *, parallelism: int = -1, decode: bool = True, **kwargs) -> Dataset:
+    """WebDataset tar shards -> one row per sample (reference:
+    ``ray.data.read_webdataset``); columns are member extensions."""
+    from ray_tpu.data.datasource import WebDatasetDatasource
+
+    return read_datasource(
+        WebDatasetDatasource(paths, {"decode": decode, **kwargs}), parallelism=parallelism
+    )
+
+
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
+    """MongoDB collection -> Dataset (reference: ``ray.data.read_mongo``).
+    Needs pymongo installed."""
+    from ray_tpu.data.datasource import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(uri, database, collection, pipeline), parallelism=parallelism
+    )
+
+
+def read_bigquery(project_id: str, *, query: str = None, dataset: str = None, parallelism: int = -1) -> Dataset:
+    """BigQuery query/table -> Dataset (reference: ``ray.data.read_bigquery``).
+    Needs google-cloud-bigquery installed."""
+    from ray_tpu.data.datasource import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(project_id, query, dataset), parallelism=parallelism
+    )
+
+
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
     return _from_source(datasource, parallelism)

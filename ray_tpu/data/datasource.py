@@ -427,6 +427,132 @@ def _json_safe(v):
     return v
 
 
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset tar shards (reference: ``datasource/webdataset_datasource.py``).
+
+    Each sample = consecutive tar members sharing a basename; member
+    extensions become columns (``jpg``/``png`` decode to image tensors when
+    PIL is available and ``decode=True``, ``json`` parses, ``cls``/``txt``
+    decode to scalars, everything else stays bytes). Pure tarfile — no
+    webdataset dependency.
+    """
+
+    def _read_file(self, path):
+        import json as _json
+        import tarfile
+
+        decode = self.read_kwargs.get("decode", True)
+        rows: list[dict] = []
+        with tarfile.open(path) as tf:
+            current_key = None
+            sample: dict = {}
+            for member in tf:
+                if not member.isfile():
+                    continue
+                name = member.name
+                base, dot, ext = name.partition(".")
+                if current_key is not None and base != current_key and sample:
+                    rows.append(sample)
+                    sample = {}
+                current_key = base
+                data = tf.extractfile(member).read()
+                if decode:
+                    if ext in ("txt", "text"):
+                        data = data.decode()
+                    elif ext in ("cls", "id", "index"):
+                        data = int(data)
+                    elif ext == "json":
+                        data = _json.loads(data)
+                    elif ext in ("jpg", "jpeg", "png") :
+                        try:
+                            import io as _io
+
+                            from PIL import Image
+
+                            data = np.asarray(Image.open(_io.BytesIO(data)))
+                        except ImportError:
+                            pass  # leave raw bytes
+                sample["__key__"] = base
+                sample[ext] = data
+            if sample:
+                rows.append(sample)
+        if rows:
+            yield BlockAccessor.rows_to_block(rows)
+
+
+class MongoDatasource(Datasource):
+    """MongoDB collections (reference: ``datasource/mongo_datasource.py``).
+    Requires ``pymongo`` (not bundled — gated import)."""
+
+    def __init__(self, uri: str, database: str, collection: str, pipeline=None):
+        try:
+            import pymongo  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_mongo requires pymongo, which is not installed in this "
+                "environment"
+            ) from e
+        self.uri, self.database, self.collection = uri, database, collection
+        self.pipeline = pipeline or []
+
+    def estimate_inmemory_data_size(self):
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> list:
+        uri, db, coll, pipe = self.uri, self.database, self.collection, self.pipeline
+
+        def fn():
+            import pymongo
+
+            client = pymongo.MongoClient(uri)
+            docs = list(client[db][coll].aggregate(pipe) if pipe else client[db][coll].find())
+            for d in docs:
+                d.pop("_id", None)
+            if docs:
+                yield BlockAccessor.rows_to_block(docs)
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=None, input_files=[]))]
+
+
+class BigQueryDatasource(Datasource):
+    """BigQuery tables/queries (reference: ``datasource/bigquery_datasource.py``).
+    Requires ``google-cloud-bigquery`` (gated import)."""
+
+    def __init__(self, project_id: str, query: Optional[str] = None, dataset: Optional[str] = None):
+        try:
+            from google.cloud import bigquery  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "read_bigquery requires google-cloud-bigquery, which is not "
+                "installed in this environment"
+            ) from e
+        if not (query or dataset):
+            raise ValueError(
+                "read_bigquery needs query=... or dataset=... "
+                "(dataset must be a fully-qualified table id: "
+                "'project.dataset.table')"
+            )
+        self.project_id, self.query, self.dataset = project_id, query, dataset
+
+    def estimate_inmemory_data_size(self):
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> list:
+        project, query, dataset = self.project_id, self.query, self.dataset
+
+        def fn():
+            from google.cloud import bigquery
+
+            client = bigquery.Client(project=project)
+            if query:
+                table = client.query(query).to_arrow()
+            else:
+                table = client.list_rows(dataset).to_arrow()
+            yield table
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=None, input_files=[]))]
+
+
 class SQLDatasource(Datasource):
     """Rows from a SQL query via a DB-API connection factory.
 
