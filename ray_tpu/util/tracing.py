@@ -1,0 +1,115 @@
+"""Task/actor tracing (reference: ``python/ray/util/tracing/tracing_helper.py``
+— OpenTelemetry spans around submit/execute when RAY_TRACING_ENABLED).
+
+OpenTelemetry isn't bundled, so spans are recorded into the head's task-event
+stream instead: every task already carries PENDING/RUNNING/FINISHED
+transitions with timestamps (``head.task_events``), which ``timeline()``
+exports as a Chrome trace. This module adds the *user-defined* span surface
+on top: application code brackets its own regions and they land in the same
+timeline, nested per process/actor.
+
+    from ray_tpu.util import tracing
+
+    with tracing.span("preprocess", batch=i):
+        ...
+
+``tracing.export_chrome_trace(path)`` merges runtime task events and user
+spans into one chrome://tracing-loadable JSON file.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+_local = threading.local()
+_lock = threading.Lock()
+_spans: list[dict] = []  # finished spans of THIS process
+
+
+def _now_us() -> float:
+    return time.time() * 1e6
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes: Any) -> Iterator[None]:
+    """Record a named region. Nesting tracks a per-thread stack so child
+    spans indent under their parent in the trace viewer."""
+    depth = getattr(_local, "depth", 0)
+    _local.depth = depth + 1
+    t0 = _now_us()
+    try:
+        yield
+    finally:
+        _local.depth = depth
+        rec = {
+            "name": name,
+            "cat": "user",
+            "ph": "X",
+            "ts": t0,
+            "dur": _now_us() - t0,
+            "pid": f"proc-{os.getpid()}",
+            "tid": f"thread-{threading.get_ident() & 0xFFFF}-d{depth}",
+        }
+        if attributes:
+            rec["args"] = {k: _jsonable(v) for k, v in attributes.items()}
+        with _lock:
+            _spans.append(rec)
+
+
+def _jsonable(v: Any):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def get_spans() -> list[dict]:
+    """Finished user spans recorded in this process."""
+    with _lock:
+        return list(_spans)
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def collect_cluster_spans() -> list[dict]:
+    """Gather user spans from every live worker (a task per node would be
+    overkill; workers ship spans through a collector task)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _drain():
+        from ray_tpu.util import tracing as t
+
+        out = t.get_spans()
+        t.clear()
+        return out
+
+    # best effort: one collector task (workers sharing that process drain);
+    # driver-local spans are always included
+    out = list(get_spans())
+    try:
+        out += ray_tpu.get(_drain.remote(), timeout=10)
+    except Exception:
+        pass
+    return out
+
+
+def export_chrome_trace(path: Optional[str] = None) -> list[dict]:
+    """Runtime task events + user spans as one Chrome trace
+    (reference: ``ray timeline``, ``_private/state.py:924``)."""
+    from ray_tpu.util import state as st
+
+    events = st.timeline() + collect_cluster_spans()
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events

@@ -15,6 +15,12 @@ import os
 # also force the platform through jax.config (no-op if jax is absent).
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CI never touches the TPU: drop the axon plugin bootstrap env so WORKER
+# subprocesses skip the relay handshake in their sitecustomize — python
+# process startup otherwise BLOCKS whenever the single-tenant TPU tunnel
+# is busy (and CPU tests have no business dialing it at all). Invoke
+# pytest itself with PALLAS_AXON_POOL_IPS= for the same reason.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 try:
     import jax

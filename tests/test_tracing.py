@@ -1,0 +1,49 @@
+"""User-span tracing merged with runtime task events.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` + ``ray timeline``.
+"""
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_span_records_duration_and_attrs():
+    tracing.clear()
+    with tracing.span("outer", phase=1):
+        with tracing.span("inner"):
+            pass
+    spans = tracing.get_spans()
+    names = [s["name"] for s in spans]
+    assert names == ["inner", "outer"]  # children finish first
+    outer = spans[1]
+    assert outer["dur"] >= spans[0]["dur"]
+    assert outer["args"]["phase"] == 1
+    tracing.clear()
+
+
+def test_export_merges_task_events_and_user_spans(ray_start_regular, tmp_path):
+    tracing.clear()
+
+    @ray_tpu.remote
+    def traced_task():
+        from ray_tpu.util import tracing as t
+
+        with t.span("in-task-work"):
+            return 1
+
+    with tracing.span("driver-section"):
+        assert ray_tpu.get(traced_task.remote(), timeout=60) == 1
+
+    out = str(tmp_path / "trace.json")
+    events = tracing.export_chrome_trace(out)
+    import json
+
+    with open(out) as f:
+        loaded = json.load(f)
+    assert loaded == events
+    names = {e["name"] for e in events}
+    assert "driver-section" in names
+    assert any("traced_task" in n for n in names)  # runtime task event
+    # chrome trace shape
+    assert all({"ph", "ts", "pid"} <= set(e) for e in events)
+    tracing.clear()
