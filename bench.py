@@ -60,12 +60,15 @@ def main():
     dev = jax.devices()[0]
     on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
     if on_tpu:
-        # 406M-param GPT, bf16, flash attention (Pallas), remat per block.
-        # batch 16 keeps the MXU fed (batch 8 left ~2x on the table, r1
-        # verdict); larger batches exceed this chip's compile envelope.
+        # 406M-param GPT, bf16, Pallas flash attention, full remat per
+        # block. batch 16 x seq 1024 measured best on v5e under an honest
+        # host-transfer barrier (0.33 MFU; flash beats the XLA einsum path
+        # 0.33 vs 0.29 at this shape; batch 32 / no-remat / "dots" remat
+        # all exceed the 16G HBM envelope; longer sequences only LOOKED
+        # faster under a broken async barrier).
         cfg = GPTConfig(vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16)
         batch = 16
-        steps = 10
+        steps = 8
     else:  # smoke config for CPU-only environments
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128, n_layers=2, n_heads=4)
         batch = 4
@@ -87,15 +90,31 @@ def main():
     )
     tokens = jax.device_put(tokens, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
 
+    def barrier(state, loss):
+        """Host transfers are the only reliable completion barrier through
+        the remote-execution tunnel (block_until_ready can return before
+        the work drains). Pull one UPDATED param element, not just the
+        loss — the loss is computed before the optimizer writes, so a
+        loss-only barrier would exclude the final update's tail."""
+        float(loss)
+        leaf = jax.tree_util.tree_leaves(state)[0]
+        float(jnp.ravel(leaf)[0])
+
     # warmup / compile
     state, loss = step_fn(state, tokens)
-    jax.block_until_ready(loss)
+    barrier(state, loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step_fn(state, tokens)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # The tunnel's throughput fluctuates run to run; take the MEDIAN of
+    # three windows — robust to one bad window without switching the
+    # metric to best-case (the reference baseline is a sustained average).
+    dts = []
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = step_fn(state, tokens)
+        barrier(state, loss)
+        dts.append(time.perf_counter() - t0)
+    dt = sorted(dts)[len(dts) // 2]
 
     tok_per_step = batch * cfg.seq_len
     tok_per_sec = steps * tok_per_step / dt

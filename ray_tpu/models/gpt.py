@@ -35,6 +35,10 @@ class GPTConfig:
     n_heads: int = 12
     dtype: str = "bfloat16"           # activation/compute dtype
     remat: bool = True
+    #: "full" recomputes the whole block in backward (min HBM);
+    #: "dots" saves matmul outputs (recomputes only cheap elementwise —
+    #: more HBM, fewer backward FLOPs). Tune per chip generation.
+    remat_policy: str = "full"
     attn_impl: str = "auto"           # auto|xla|flash|ring (see ops/attention)
     # Mixture-of-Experts (0 = dense MLP). Experts shard over the mesh's
     # ``ep`` axis; routing uses GShard/Switch-style dense dispatch einsums
@@ -250,7 +254,16 @@ def gpt_forward(
         return y, aux
 
     if cfg.remat:
-        block = jax.checkpoint(block, prevent_cse=False)
+        if cfg.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+            )
+        policy = (
+            jax.checkpoint_policies.checkpoint_dots
+            if cfg.remat_policy == "dots"
+            else None
+        )
+        block = jax.checkpoint(block, prevent_cse=False, policy=policy)
     x, auxes = jax.lax.scan(block, x, params["blocks"])
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
