@@ -52,6 +52,10 @@ class AlgorithmConfig:
         # Reference: AlgorithmConfig.fault_tolerance(restart_failed_env_runners=)
         # — a dead runner actor is replaced in-place and training continues.
         self.restart_failed_env_runners = True
+        #: factories building connector pipelines per runner (reference:
+        #: AlgorithmConfig.env_runners(env_to_module_connector=...))
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         self.train_batch_size = 4000
         self.minibatch_size = 128
         self.num_epochs = 8
@@ -76,6 +80,8 @@ class AlgorithmConfig:
         num_env_runners: Optional[int] = None,
         num_envs_per_env_runner: Optional[int] = None,
         rollout_fragment_length: Optional[int] = None,
+        env_to_module_connector=None,
+        module_to_env_connector=None,
         **kwargs,
     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
@@ -84,6 +90,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         self.extra.update(kwargs)
         return self
 
@@ -150,6 +160,8 @@ class Algorithm:
             seed=self.config.seed,
             hidden=tuple(self.config.hidden),
             module_cls=self._module_cls(),
+            env_to_module_connector=self.config.env_to_module_connector,
+            module_to_env_connector=self.config.module_to_env_connector,
         )
 
     def _module_cls(self):
@@ -211,6 +223,18 @@ class Algorithm:
             weights = None  # during _setup, before the learner exists
         if weights is not None:
             actor.set_weights.remote(weights)
+        # stateful connectors (running normalizers) must not restart cold:
+        # clone state from any surviving runner
+        if self.config.env_to_module_connector or self.config.module_to_env_connector:
+            for j, other in enumerate(self._runner_actors):
+                if j == index:
+                    continue
+                try:
+                    state = ray_tpu.get(other.get_connector_state.remote(), timeout=10)
+                    actor.set_connector_state.remote(state)
+                    break
+                except Exception:
+                    continue
         self._runner_actors[index] = actor
 
     def sync_weights(self, params) -> None:

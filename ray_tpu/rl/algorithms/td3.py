@@ -1,0 +1,220 @@
+"""TD3 — twin-delayed deep deterministic policy gradient.
+
+Reference: ``rllib/algorithms/td3/`` (DDPG + twin Q + target policy
+smoothing + delayed policy updates). Same single-pytree/single-jitted-step
+shape as this repo's SAC: the critic and (gated) actor objectives compose
+into one loss with stop-gradients, the policy delay is a ``step % d`` gate
+inside the jitted step (no Python-side alternation), and target networks
+Polyak-update after each step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import sample_batch as sb
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.rl_module import _mlp_apply, _mlp_init
+from ray_tpu.rl.sample_batch import SampleBatch
+from ray_tpu.rl.spaces import Box
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 100_000
+        self.learning_starts = 1500
+        self.sample_steps_per_iter = 400
+        self.updates_per_iter = 200
+        self.train_batch_size = 256
+        self.tau = 0.005
+        self.exploration_noise = 0.1      # env-side action noise
+        self.target_noise = 0.2           # target policy smoothing
+        self.target_noise_clip = 0.5
+        self.policy_delay = 2             # actor updates every d critic steps
+
+    algo_class = None  # set below
+
+
+class TD3Module:
+    """Deterministic tanh policy + twin Q, each with target copies."""
+
+    discrete = False
+
+    def __init__(self, spec, exploration_noise: float = 0.1):
+        assert isinstance(spec.action_space, Box), "TD3 needs a Box action space"
+        self.spec = spec
+        self.obs_dim = int(np.prod(spec.observation_space.shape))
+        self.act_dim = int(np.prod(spec.action_space.shape))
+        self.act_low = np.asarray(spec.action_space.low, np.float32).reshape(-1)
+        self.act_high = np.asarray(spec.action_space.high, np.float32).reshape(-1)
+        self.exploration_noise = exploration_noise
+
+    def init(self, rng):
+        kp, k1, k2 = jax.random.split(rng, 3)
+        h = list(self.spec.hidden)
+        q_sizes = [self.obs_dim + self.act_dim] + h + [1]
+        pi = _mlp_init(kp, [self.obs_dim] + h + [self.act_dim])
+        q1 = _mlp_init(k1, q_sizes, final_scale=1.0)
+        q2 = _mlp_init(k2, q_sizes, final_scale=1.0)
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
+        return {
+            "pi": pi,
+            "q1": q1,
+            "q2": q2,
+            "target_pi": copy(pi),
+            "target_q1": copy(q1),
+            "target_q2": copy(q2),
+        }
+
+    def _squash(self, u):
+        scale = (self.act_high - self.act_low) / 2.0
+        center = (self.act_high + self.act_low) / 2.0
+        return jnp.tanh(u) * scale + center
+
+    def policy(self, params, obs, target: bool = False):
+        key = "target_pi" if target else "pi"
+        return self._squash(_mlp_apply(params[key], obs, activation=jax.nn.relu))
+
+    def sample_action(self, params, obs, rng):
+        """EnvRunner interface: deterministic action + exploration noise."""
+        a = self.policy(params, obs)
+        noise = self.exploration_noise * jax.random.normal(rng, a.shape)
+        a = jnp.clip(a + noise, jnp.asarray(self.act_low), jnp.asarray(self.act_high))
+        zeros = jnp.zeros(a.shape[:-1], jnp.float32)
+        return a, zeros, zeros
+
+    def q_values(self, params, obs, act, target: bool = False):
+        x = jnp.concatenate([obs, act], axis=-1)
+        k1, k2 = ("target_q1", "target_q2") if target else ("q1", "q2")
+        q1 = _mlp_apply(params[k1], x, activation=jax.nn.relu)[..., 0]
+        q2 = _mlp_apply(params[k2], x, activation=jax.nn.relu)[..., 0]
+        return q1, q2
+
+
+def td3_loss(gamma: float, target_noise: float, noise_clip: float, policy_delay: int):
+    def loss_fn(module: TD3Module, params, batch):
+        obs, act = batch[sb.OBS], batch[sb.ACTIONS]
+        rew = batch[sb.REWARDS]
+        done = batch[sb.TERMINATEDS].astype(jnp.float32)
+        next_obs = batch[sb.NEXT_OBS]
+        step = batch["step"][0]
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), step)
+
+        # -- critic: clipped double-Q target with smoothed target action ---
+        next_a = module.policy(jax.lax.stop_gradient(params), next_obs, target=True)
+        smooth = jnp.clip(
+            target_noise * jax.random.normal(rng, next_a.shape),
+            -noise_clip,
+            noise_clip,
+        )
+        next_a = jnp.clip(
+            next_a + smooth, jnp.asarray(module.act_low), jnp.asarray(module.act_high)
+        )
+        tq1, tq2 = module.q_values(params, next_obs, next_a, target=True)
+        target = jax.lax.stop_gradient(
+            rew + gamma * (1.0 - done) * jnp.minimum(tq1, tq2)
+        )
+        q1, q2 = module.q_values(params, obs, act)
+        q_loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+        # -- actor, gated by the policy delay (Q frozen) -------------------
+        pi_a = module.policy(params, obs)
+        fq1, _ = module.q_values(jax.lax.stop_gradient(params), obs, pi_a)
+        do_pi = (step % policy_delay == 0).astype(jnp.float32)
+        pi_loss = -do_pi * jnp.mean(fq1)
+
+        return q_loss + pi_loss, {
+            "q_loss": q_loss,
+            "pi_loss": pi_loss,
+            "q_mean": jnp.mean(q1),
+        }
+
+    return loss_fn
+
+
+def _polyak_all(tau: float):
+    def update(learner):
+        p = dict(learner.params)
+        for src, dst in (("pi", "target_pi"), ("q1", "target_q1"), ("q2", "target_q2")):
+            p[dst] = jax.tree_util.tree_map(
+                lambda t, s: (1.0 - tau) * t + tau * s, p[dst], p[src]
+            )
+        learner.params = p
+        return True
+
+    return update
+
+
+class TD3(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "TD3Config":
+        return TD3Config()
+
+    def _module_cls(self):
+        cfg = self.config
+
+        def make(spec):
+            return TD3Module(spec, exploration_noise=cfg.exploration_noise)
+
+        return make
+
+    def _setup(self):
+        cfg: TD3Config = self.config
+        obs_space, act_space = self.foreach_runner("get_spaces")[0]
+        from ray_tpu.rl.rl_module import RLModuleSpec
+
+        spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
+        self.learner_group = LearnerGroup(
+            dict(
+                module_factory=lambda: TD3Module(spec, cfg.exploration_noise),
+                loss_fn=td3_loss(
+                    cfg.gamma, cfg.target_noise, cfg.target_noise_clip, cfg.policy_delay
+                ),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed or 0,
+            ),
+            remote=cfg.remote_learner,
+        )
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._update_step = 0
+        self.sync_weights(self.learner_group.get_weights())
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params):
+        self.learner_group.set_weights(params)
+        self.sync_weights(params)
+
+    def training_step(self) -> dict:
+        cfg: TD3Config = self.config
+        n_runners = max(1, len(self._runner_actors) or 1)
+        n_envs = max(1, cfg.num_envs_per_env_runner)
+        vec_steps = max(1, cfg.sample_steps_per_iter // (n_runners * n_envs))
+        for b in self.foreach_runner("sample_transitions", vec_steps):
+            self.buffer.add(b)
+            self._timesteps_total += b.count
+        metrics: dict = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self._update_step += 1
+                batch["step"] = np.full(batch.count, self._update_step, np.int32)
+                metrics = self.learner_group.update(batch)
+                self.learner_group.apply(_polyak_all(cfg.tau))
+            self.sync_weights(self.learner_group.get_weights())
+        return {f"learner/{k}": v for k, v in metrics.items()} | {
+            "buffer_size": len(self.buffer)
+        }
+
+
+TD3Config.algo_class = TD3
+register_algorithm("TD3", TD3)

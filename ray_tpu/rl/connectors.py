@@ -1,0 +1,159 @@
+"""Connectors: observation/action transform pipelines between env and module.
+
+Reference: ``rllib/connectors/`` — env-to-module pipelines transform raw
+observations before the policy sees them; module-to-env pipelines transform
+policy outputs before the env steps them. TPU-first shape: connectors are
+pure numpy on the (vectorized) host path — the jitted policy stays
+transform-free so swapping connectors never recompiles it.
+
+EnvRunner stores the TRANSFORMED observations in its sample batches, so the
+learner trains on exactly what the policy consumed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Connector:
+    """One transform stage. Subclasses override __call__; stateful stages
+    (running normalizers) expose get_state/set_state for cross-runner sync."""
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, data: np.ndarray) -> np.ndarray:
+        """Apply WITHOUT updating internal statistics (stateless stages:
+        same as __call__). Used for bootstrap/terminal observations that
+        duplicate already-counted data."""
+        return self(data)
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    """Compose stages left-to-right (reference: ConnectorPipelineV2)."""
+
+    def __init__(self, stages: list[Connector]):
+        self.stages = list(stages)
+
+    def __call__(self, data):
+        for s in self.stages:
+            data = s(data)
+        return data
+
+    def transform(self, data):
+        for s in self.stages:
+            data = s.transform(data)
+        return data
+
+    def get_state(self) -> dict:
+        return {i: s.get_state() for i, s in enumerate(self.stages)}
+
+    def set_state(self, state: dict) -> None:
+        for i, s in enumerate(self.stages):
+            if i in state:
+                s.set_state(state[i])
+
+
+# -- env -> module ----------------------------------------------------------
+
+
+class FlattenObservations(Connector):
+    """(N, *obs_shape) -> (N, prod(obs_shape)) (reference:
+    connectors/env_to_module/flatten_observations.py)."""
+
+    def __call__(self, obs):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class ClipObservations(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs):
+        return np.clip(obs, self.low, self.high)
+
+
+class NormalizeObservations(Connector):
+    """Running mean/var normalization (reference:
+    connectors/env_to_module/mean_std_filter.py). Stats update on every
+    call; get_state/set_state let an algorithm sync runners periodically."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: Optional[float] = 10.0):
+        self.eps = epsilon
+        self.clip = clip
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs):
+        obs = np.asarray(obs, np.float64)
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[1:], np.float64)
+            self._m2 = np.zeros(obs.shape[1:], np.float64)
+        # Chan et al. parallel update with the incoming minibatch
+        bn = float(obs.shape[0])
+        bmean = obs.mean(axis=0)
+        bvar = obs.var(axis=0)
+        delta = bmean - self._mean
+        total = self._count + bn
+        self._mean = self._mean + delta * (bn / total)
+        self._m2 = self._m2 + bvar * bn + (delta**2) * self._count * bn / total
+        self._count = total
+        return self.transform(obs)
+
+    def transform(self, obs):
+        obs = np.asarray(obs, np.float64)
+        if self._mean is None:
+            return obs.astype(np.float32)
+        var = self._m2 / max(self._count, 1.0)
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        return out.astype(np.float32)
+
+    def get_state(self) -> dict:
+        return {"count": self._count, "mean": self._mean, "m2": self._m2}
+
+    def set_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+# -- module -> env ----------------------------------------------------------
+
+
+class ClipActions(Connector):
+    """Clip continuous actions into the env's Box bounds (reference:
+    connectors/module_to_env ClipActions)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class GaussianActionNoise(Connector):
+    """Additive exploration noise for deterministic policies (TD3/DDPG)."""
+
+    def __init__(self, scale: float, low=None, high=None, seed: Optional[int] = None):
+        self.scale = scale
+        self.low, self.high = low, high
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, actions):
+        out = np.asarray(actions) + self._rng.normal(0.0, self.scale, np.shape(actions))
+        if self.low is not None:
+            out = np.clip(out, self.low, self.high)
+        return out.astype(np.float32)

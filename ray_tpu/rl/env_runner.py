@@ -34,6 +34,8 @@ class EnvRunner:
         hidden: tuple = (64, 64),
         worker_index: int = 0,
         module_cls: Callable = ActorCriticModule,
+        env_to_module_connector: Optional[Callable] = None,
+        module_to_env_connector: Optional[Callable] = None,
     ):
         import jax
 
@@ -41,7 +43,20 @@ class EnvRunner:
 
         self.vec = make_vector_env(env_spec, num_envs, seed=seed)
         self.fragment = rollout_fragment_length
-        self.spec = RLModuleSpec(self.vec.observation_space, self.vec.action_space, hidden=hidden)
+        self._c_obs = env_to_module_connector() if env_to_module_connector else None
+        self._c_act = module_to_env_connector() if module_to_env_connector else None
+        obs_space = self.vec.observation_space
+        if self._c_obs is not None:
+            # the module consumes TRANSFORMED observations: derive its input
+            # space (shape may change — flatten/stack connectors) so runner
+            # and learner modules agree
+            probe = self._c_obs.transform(
+                np.zeros((1,) + tuple(obs_space.shape), np.float32)
+            )
+            from ray_tpu.rl.spaces import Box as _Box
+
+            obs_space = _Box(-np.inf, np.inf, shape=tuple(np.asarray(probe).shape[1:]))
+        self.spec = RLModuleSpec(obs_space, self.vec.action_space, hidden=hidden)
         self.module = module_cls(self.spec)
         self._rng = jax.random.PRNGKey(0 if seed is None else seed + 1000 * worker_index)
         self.params = self.module.init(self._rng)
@@ -50,7 +65,7 @@ class EnvRunner:
         self._base_fn = jax.jit(self.module.sample_action)
         self._eps_fn = None  # built lazily on first set_epsilon
         self._eps: Optional[float] = None
-        self._obs = self.vec.reset()
+        self._obs = self._obs_transform(self.vec.reset())
         # episode stats
         # sized by SLOTS (= envs, or envs x agents for multi-agent vectors)
         self._ep_ret = np.zeros(self.vec.n, np.float32)
@@ -68,6 +83,31 @@ class EnvRunner:
 
     def get_spaces(self):
         return self.spec.observation_space, self.spec.action_space
+
+    # -- connectors --------------------------------------------------------
+
+    def _obs_transform(self, obs, update: bool = True):
+        if self._c_obs is None:
+            return obs
+        if update:
+            return self._c_obs(obs)
+        return self._c_obs.transform(obs)
+
+    def _act_transform(self, act):
+        return self._c_act(act) if self._c_act is not None else act
+
+    def get_connector_state(self) -> dict:
+        return {
+            "env_to_module": self._c_obs.get_state() if self._c_obs else {},
+            "module_to_env": self._c_act.get_state() if self._c_act else {},
+        }
+
+    def set_connector_state(self, state: dict) -> bool:
+        if self._c_obs and state.get("env_to_module"):
+            self._c_obs.set_state(state["env_to_module"])
+        if self._c_act and state.get("module_to_env"):
+            self._c_act.set_state(state["module_to_env"])
+        return True
 
     # -- policy invocation -------------------------------------------------
 
@@ -96,11 +136,17 @@ class EnvRunner:
         import jax
 
         N = self.vec.n
-        obs_shape = self.vec.observation_space.shape
+        # transformed shape: connectors may reshape observations
+        obs_shape = tuple(np.asarray(self._obs).shape[1:])
         act_shape = () if self.module.discrete else self.vec.action_space.shape
+        act_dtype = np.int64 if self.module.discrete else np.float32
         buf = {
             "obs": np.zeros((T, N) + obs_shape, np.float32),
-            "act": np.zeros((T, N) + act_shape, np.int64 if self.module.discrete else np.float32),
+            "act": np.zeros((T, N) + act_shape, act_dtype),
+            # the action the ENV executed (post module_to_env transform) —
+            # replay/off-policy batches must pair returns with THIS action;
+            # the pre-transform module action is only for on-policy logp
+            "env_act": np.zeros((T, N) + act_shape, act_dtype),
             "rew": np.zeros((T, N), np.float32),
             "term": np.zeros((T, N), bool),
             "trunc": np.zeros((T, N), bool),
@@ -116,9 +162,15 @@ class EnvRunner:
             buf["act"][t] = action
             buf["logp"][t] = np.asarray(logp)
             buf["val"][t] = np.asarray(value)
-            self._obs, rew, term, trunc, final = self.vec.step(action)
+            env_action = self._act_transform(action)
+            buf["env_act"][t] = env_action
+            self._obs, rew, term, trunc, final = self.vec.step(env_action)
+            # stats-updating transform runs ONCE per step (on the stepped
+            # obs); `final` — the same raw data for non-done slots — applies
+            # the transform without re-updating running statistics
+            self._obs = self._obs_transform(self._obs)
             buf["rew"][t], buf["term"][t], buf["trunc"][t] = rew, term, trunc
-            buf["final"][t] = final
+            buf["final"][t] = self._obs_transform(final, update=False)
             self._ep_ret += rew
             self._ep_len += 1
             for i in np.nonzero(term | trunc)[0]:
@@ -132,8 +184,8 @@ class EnvRunner:
         if not buf["trunc"].any():
             return None
         T, N = buf["rew"].shape
-        obs_shape = self.vec.observation_space.shape
-        tv = self._values_of(buf["final"].reshape((T * N,) + obs_shape))
+        obs_shape = buf["final"].shape[2:]
+        tv = self._values_of(buf["final"].reshape((T * N,) + tuple(obs_shape)))
         return tv.reshape(T, N)
 
     def sample(self, num_steps: Optional[int] = None) -> SampleBatch:
@@ -184,7 +236,7 @@ class EnvRunner:
         return SampleBatch(
             {
                 sb.OBS: flat(buf["obs"]),
-                sb.ACTIONS: flat(buf["act"]),
+                sb.ACTIONS: flat(buf["env_act"]),
                 sb.REWARDS: flat(buf["rew"]),
                 sb.NEXT_OBS: flat(buf["final"]),
                 sb.TERMINATEDS: flat(buf["term"]),
