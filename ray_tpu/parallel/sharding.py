@@ -72,8 +72,13 @@ def shard_params(params: Any, mesh) -> Any:
 
 
 def batch_spec() -> P:
-    """(batch, seq) token batches: batch over dp+fsdp, seq over sp."""
-    return P(("dp", "fsdp"), "sp")
+    """(batch, seq) token batches: batch sharded over dp+fsdp. The seq dim
+    stays UNsharded at the input boundary — token batches carry seq_len+1
+    columns (inputs|targets), which sp generally does not divide; the model
+    redistributes activations over sp via internal sharding constraints
+    (ops/attention ring path), so only the cheap int32 tokens replicate
+    within an sp group."""
+    return P(("dp", "fsdp"))
 
 
 def constrain(x, mesh, spec: P):
