@@ -172,11 +172,11 @@ def _core_microbench() -> dict:
         print(
             f"[bench] core microbench produced no metrics (rc={out.returncode}): "
             f"{out.stderr[-500:]}",
-            file=__import__("sys").stderr,
+            file=sys.stderr,
         )
         return {}
     except Exception as e:
-        print(f"[bench] core microbench failed: {e!r}", file=__import__("sys").stderr)
+        print(f"[bench] core microbench failed: {e!r}", file=sys.stderr)
         return {}
 
 

@@ -5,3 +5,4 @@ from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.algorithms.td3 import TD3, TD3Config  # noqa: F401
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401

@@ -98,6 +98,14 @@ class IMPALA(Algorithm):
     def get_default_config(cls) -> "IMPALAConfig":
         return IMPALAConfig()
 
+    def _make_loss(self, cfg):
+        """Loss builder — APPO subclasses swap in the clipped surrogate."""
+        return impala_loss(
+            cfg.gamma, cfg.vtrace_clip_rho_threshold,
+            cfg.vtrace_clip_c_threshold, cfg.vf_loss_coeff,
+            cfg.entropy_coeff,
+        )
+
     def _setup(self):
         cfg: IMPALAConfig = self.config
         obs_space, act_space = self.foreach_runner("get_spaces")[0]
@@ -105,11 +113,7 @@ class IMPALA(Algorithm):
         self.learner_group = LearnerGroup(
             dict(
                 module_factory=lambda: ActorCriticModule(spec),
-                loss_fn=impala_loss(
-                    cfg.gamma, cfg.vtrace_clip_rho_threshold,
-                    cfg.vtrace_clip_c_threshold, cfg.vf_loss_coeff,
-                    cfg.entropy_coeff,
-                ),
+                loss_fn=self._make_loss(cfg),
                 lr=cfg.lr,
                 grad_clip=cfg.grad_clip,
                 seed=cfg.seed or 0,
