@@ -250,6 +250,71 @@ class PlacementGroupState:
 # --------------------------------------------------------------------------
 
 
+class _DaemonPool:
+    """Cached pool of DAEMON threads for blocking RPCs.
+
+    ThreadPoolExecutor is unsuitable here: its non-daemon workers are joined
+    at interpreter exit, so one ``get``/``wait``/``pg_ready`` parked forever
+    (timeout=None on something never produced) would hang process exit —
+    the per-call threads this replaces were daemons for exactly that reason.
+    Threads spawn on demand up to ``max_workers``, reap after 30s idle, and
+    print handler crashes (a submitted-and-forgotten Future would swallow
+    them)."""
+
+    _IDLE_REAP_S = 30.0
+
+    def __init__(self, max_workers: int, name: str):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._threads = 0
+        self._idle = 0
+        self._max = max_workers
+        self._name = name
+
+    def submit(self, fn, *args) -> None:
+        self._q.put((fn, args))
+        with self._lock:
+            if self._idle == 0 and self._threads < self._max:
+                self._threads += 1
+                threading.Thread(target=self._run, name=self._name, daemon=True).start()
+
+    def _run(self) -> None:
+        import traceback as _tb
+
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                item = self._q.get(timeout=self._IDLE_REAP_S)
+            except queue.Empty:
+                with self._lock:
+                    self._idle -= 1
+                    # a put may have raced the timeout: keep serving if work
+                    # arrived (the lock orders this against submit's check)
+                    if not self._q.empty():
+                        self._idle += 1
+                        continue
+                    self._threads -= 1
+                return
+            with self._lock:
+                self._idle -= 1
+            if item is None:
+                with self._lock:
+                    self._threads -= 1
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 - must never kill the pool thread
+                _tb.print_exc()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            n = self._threads
+        for _ in range(n):
+            self._q.put(None)
+
+
 class Head:
     def __init__(self, socket_path: str, authkey: bytes):
         self.lock = threading.RLock()
@@ -277,6 +342,10 @@ class Head:
         self._subs: dict[str, list] = {}
         self._pub_locks: dict[int, threading.Lock] = {}
         self._pub_queue: "queue.Queue" = queue.Queue()
+        # cap >> any realistic concurrent-blocking-RPC count; parked gets
+        # hold a thread each, so the cap must stay generous (a too-small
+        # pool would queue NEW gets behind parked ones)
+        self._blocking_pool = _DaemonPool(4096, "head-rpc")
         self._snapshot_due = 0.0
         self._lineage_fifo: deque = deque()
         self._lineage_total = 0
@@ -428,9 +497,14 @@ class Head:
             handler = self._rpc_get_remote
         blocking = method in ("get", "wait", "pg_ready", "get_actor_named")
         if blocking:
-            threading.Thread(
-                target=self._run_request, args=(conn, worker, seq, handler, payload), daemon=True
-            ).start()
+            # blocking RPCs park until objects/actors materialize; run them
+            # on a cached high-cap pool so the hot path reuses threads
+            # instead of spawning one per call (reference: the event-loop
+            # pipelining in grpc_server.h — many-task workloads would
+            # otherwise hit thread-spawn overhead and exhaustion)
+            self._blocking_pool.submit(
+                self._run_request, conn, worker, seq, handler, payload
+            )
         else:
             self._run_request(conn, worker, seq, handler, payload)
 
@@ -2141,6 +2215,7 @@ class Head:
             except Exception:
                 pass
         self._pub_queue.put(None)
+        self._blocking_pool.shutdown()
         self._snapshot()
         self.shm_owner.shutdown()
         if self.arena_name:
