@@ -34,7 +34,9 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52544e4152454e41ull;  // "RTNARENA"
+// Bumped (v2) when the segment layout gained the live-header bitmap, so a
+// stale pre-bitmap segment left in /dev/shm can never be attached.
+constexpr uint64_t kMagic = 0x52544e4152454e42ull;  // "RTNARENB"
 constexpr uint64_t kAlign = 64;
 
 // Block.state word: [ generation:43 | zombie:1 | pins:20 ]
@@ -57,6 +59,7 @@ struct ArenaHeader {
   uint64_t magic;
   uint64_t size;        // whole segment size
   uint64_t first_block; // offset of the first Block
+  uint64_t bitmap_off;  // offset of the live-header bitmap (1 bit / 64B line)
   std::atomic<uint64_t> used;      // allocated payload bytes
   std::atomic<uint64_t> n_objects; // live allocations
   std::atomic<uint64_t> gen;       // generation counter
@@ -80,6 +83,34 @@ inline uint64_t next_off(Handle* h, uint64_t off) {
   Block* b = block_at(h, off);
   uint64_t n = off + sizeof(Block) + b->size;
   return n >= hdr(h)->size ? 0 : n;
+}
+
+// Live-header bitmap: bit (block_off / kAlign) is set iff that 64-byte line
+// is the header of a currently-ALLOCATED block. Mutated and read only under
+// the arena mutex, so plain (non-atomic) words suffice. This is what lets
+// rta_pin reject a stale payload offset that, after a free + coalesce/split,
+// now lands inside some other live object's payload — without it the
+// generation check would be reading (and on a 43-bit coincidence, CASing)
+// arbitrary payload bytes.
+inline uint64_t* bitmap_word(Handle* h, uint64_t block_off, uint64_t* mask) {
+  uint64_t idx = block_off / kAlign;
+  *mask = 1ull << (idx & 63);
+  return reinterpret_cast<uint64_t*>(h->base + hdr(h)->bitmap_off) + (idx >> 6);
+}
+inline void bitmap_set(Handle* h, uint64_t block_off) {
+  uint64_t mask;
+  uint64_t* w = bitmap_word(h, block_off, &mask);
+  *w |= mask;
+}
+inline void bitmap_clear(Handle* h, uint64_t block_off) {
+  uint64_t mask;
+  uint64_t* w = bitmap_word(h, block_off, &mask);
+  *w &= ~mask;
+}
+inline bool bitmap_test(Handle* h, uint64_t block_off) {
+  uint64_t mask;
+  uint64_t* w = bitmap_word(h, block_off, &mask);
+  return (*w & mask) != 0;
 }
 
 class MutexGuard {
@@ -112,6 +143,7 @@ void free_block_locked(Handle* h, uint64_t off) {
   Block* b = block_at(h, off);
   hdr(h)->used.fetch_sub(b->size, std::memory_order_relaxed);
   hdr(h)->n_objects.fetch_sub(1, std::memory_order_relaxed);
+  bitmap_clear(h, off);
   b->is_free = 1;
   try_merge_next(h, off);
   uint64_t p = b->prev_off;
@@ -143,7 +175,11 @@ void* rta_create(const char* name, uint64_t size) {
   auto* h = new Handle{static_cast<uint8_t*>(base), size};
   ArenaHeader* a = hdr(h);
   a->size = size;
-  a->first_block = align_up(sizeof(ArenaHeader));
+  a->bitmap_off = align_up(sizeof(ArenaHeader));
+  // One bit per 64-byte line over the whole segment (fresh shm is
+  // zero-filled, so the bitmap starts all-clear).
+  uint64_t bitmap_bytes = (size / kAlign + 7) / 8;
+  a->first_block = align_up(a->bitmap_off + bitmap_bytes);
   a->used.store(0);
   a->n_objects.store(0);
   a->gen.store(1);
@@ -209,6 +245,7 @@ uint64_t rta_alloc(void* hv, uint64_t size, uint64_t* gen_out) {
       b->is_free = 0;
       uint64_t gen = a->gen.fetch_add(1, std::memory_order_relaxed) + 1;
       b->state.store(gen << kGenShift, std::memory_order_release);
+      bitmap_set(h, off);
       a->used.fetch_add(b->size, std::memory_order_relaxed);
       a->n_objects.fetch_add(1, std::memory_order_relaxed);
       if (gen_out) *gen_out = gen;
@@ -221,15 +258,29 @@ uint64_t rta_alloc(void* hv, uint64_t size, uint64_t* gen_out) {
 
 // Pin a block if it is still the same allocation (generation matches and it
 // is not being freed). Returns 1 on success, 0 if the object is gone.
+//
+// Runs under the arena mutex: the caller-supplied offset may be stale, and
+// only the lock + live-header bitmap can prove it still names a block header
+// (after a free + coalesce/split it could point into the middle of another
+// live object's payload). Holding the lock also excludes rta_free, and the
+// zombie-free path in rta_unpin needs the zombie bit (set only under this
+// lock), so a plain fetch_add suffices once validation passes. Pins are
+// per-get, not per-byte — the uncontended pshared mutex is noise.
 int rta_pin(void* hv, uint64_t payload_off, uint64_t gen) {
   Handle* h = static_cast<Handle*>(hv);
-  Block* b = block_at(h, block_of(payload_off));
+  ArenaHeader* a = hdr(h);
+  if (payload_off < sizeof(Block)) return 0;
+  uint64_t boff = block_of(payload_off);
+  if (boff < a->first_block || (boff % kAlign) != 0 ||
+      boff + sizeof(Block) > h->size)
+    return 0;
+  MutexGuard g(&a->lock);
+  if (!bitmap_test(h, boff)) return 0;  // not a live allocated header
+  Block* b = block_at(h, boff);
   uint64_t cur = b->state.load(std::memory_order_acquire);
-  for (;;) {
-    if ((cur >> kGenShift) != gen || (cur & kZombieBit)) return 0;
-    if (b->state.compare_exchange_weak(cur, cur + 1, std::memory_order_acq_rel))
-      return 1;
-  }
+  if ((cur >> kGenShift) != gen || (cur & kZombieBit)) return 0;
+  b->state.fetch_add(1, std::memory_order_acq_rel);
+  return 1;
 }
 
 // Drop a pin. If the block was zombied (freed while pinned) and this was the
@@ -253,9 +304,9 @@ int rta_unpin(void* hv, uint64_t payload_off) {
 
 // Free an allocation. If readers hold pins, the block is zombied and the
 // last unpin completes the free. Returns 0 freed now, 1 deferred, -1 gone.
-// The state word is claimed by CAS: rta_pin runs without the mutex, so a
-// plain load+store here would let a pin land between them and free a block
-// under an active reader.
+// The state word is claimed by CAS: rta_unpin's fetch_sub runs without the
+// mutex, so a plain load+store here could lose a concurrent unpin and free
+// a block with corrupted pin bookkeeping.
 int rta_free(void* hv, uint64_t payload_off, uint64_t gen) {
   Handle* h = static_cast<Handle*>(hv);
   ArenaHeader* a = hdr(h);

@@ -268,13 +268,21 @@ class _DaemonPool:
         self._lock = threading.Lock()
         self._threads = 0
         self._idle = 0
+        # Items put but not yet claimed by a worker (claimed = the worker has
+        # taken the lock after q.get returned). Spawning on
+        # ``unclaimed > idle`` instead of ``idle == 0`` closes the window
+        # where a worker has returned from q.get but not yet decremented
+        # _idle: counting that item as still-unclaimed forces a spawn, so a
+        # handler that then parks forever cannot strand the queued item.
+        self._unclaimed = 0
         self._max = max_workers
         self._name = name
 
     def submit(self, fn, *args) -> None:
-        self._q.put((fn, args))
         with self._lock:
-            if self._idle == 0 and self._threads < self._max:
+            self._unclaimed += 1
+            self._q.put((fn, args))
+            if self._unclaimed > self._idle and self._threads < self._max:
                 self._threads += 1
                 threading.Thread(target=self._run, name=self._name, daemon=True).start()
 
@@ -290,17 +298,20 @@ class _DaemonPool:
                 with self._lock:
                     self._idle -= 1
                     # a put may have raced the timeout: keep serving if work
-                    # arrived (the lock orders this against submit's check)
-                    if not self._q.empty():
-                        self._idle += 1
+                    # arrived (the lock orders this against submit's check).
+                    # The loop top re-increments _idle — do NOT add it back
+                    # here or the thread is counted idle twice forever.
+                    if self._unclaimed > 0:
                         continue
                     self._threads -= 1
                 return
             with self._lock:
                 self._idle -= 1
-            if item is None:
-                with self._lock:
+                if item is not None:
+                    self._unclaimed -= 1
+                else:
                     self._threads -= 1
+            if item is None:
                 return
             fn, args = item
             try:
@@ -694,22 +705,25 @@ class Head:
         """Simulated node failure (reference: NodeKillerActor / node death in
         GCS). Kills all workers, fails or retries their tasks, restarts their
         actors elsewhere."""
+        # One critical section for mark-dead + requeue: releasing the lock
+        # mid-removal would let rpc_task_done/_schedule observe a dead node
+        # whose tasks are not yet requeued. publish() is a non-blocking
+        # Queue.put and terminate() just sends a signal, so neither can
+        # block the lock.
         with self.lock:
             node = self.nodes.get(node_id.binary())
             if node is None or not node.alive:
                 return
             node.alive = False
             workers = list(node.all_workers)
-        self.publish("nodes", {"event": "removed", "node_id": node_id.hex()})
-        with self.lock:
+            self.publish("nodes", {"event": "removed", "node_id": node_id.hex()})
             assigned = list(node.assigned)
             node.assigned.clear()
             node.idle_workers.clear()
-        for wh in workers:
-            wh.alive = False
-            if wh.proc is not None and wh.proc.is_alive():
-                wh.proc.terminate()
-        with self.lock:
+            for wh in workers:
+                wh.alive = False
+                if wh.proc is not None and wh.proc.is_alive():
+                    wh.proc.terminate()
             for rec in assigned:
                 self._requeue_or_fail(rec, rex.WorkerCrashedError("node removed"))
             for wh in workers:

@@ -345,18 +345,44 @@ def _resolve_actor_method(state: WorkerState, name: str):
     return getattr(state.actor_instance, name)
 
 
-def _dag_exec_loop(instance, method_name: str, in_specs, out_channels):
+def _dag_exec_loop(instance, method_name: str, in_specs, out_channels, call_on_loop=None):
     """Compiled-DAG executor (reference: compiled_dag_node.py executors).
 
     Owns this actor's dispatch queue until teardown: block on the input
     channels, invoke the bound method, push the result to every consumer
     edge. Exceptions travel through the channels as wrapped errors so the
     driver's CompiledDAGRef.get re-raises them; channel close ends the loop.
+
+    For async actors the channel loop runs on a daemon thread, and
+    ``call_on_loop`` (the actor's event loop) is set: each invocation is
+    marshalled onto the loop thread so actor state is still only ever
+    touched from that one thread.
     """
     from ray_tpu.dag.compiled import _WrappedError
     from ray_tpu.experimental.channel import ChannelClosed
 
     method = getattr(instance, method_name)
+    if call_on_loop is not None:
+        import asyncio
+        import concurrent.futures
+        import inspect
+
+        inner = method
+        if inspect.iscoroutinefunction(inner):
+            def method(*a, **k):  # noqa: F811
+                return asyncio.run_coroutine_threadsafe(inner(*a, **k), call_on_loop).result()
+        else:
+            def method(*a, **k):  # noqa: F811
+                cfut = concurrent.futures.Future()
+
+                def _run():
+                    try:
+                        cfut.set_result(inner(*a, **k))
+                    except BaseException as e:  # noqa: BLE001
+                        cfut.set_exception(e)
+
+                call_on_loop.call_soon_threadsafe(_run)
+                return cfut.result()
     while True:
         try:
             # drain EVERY input channel each round, even when one carries an
@@ -482,9 +508,43 @@ async def _arun(state: WorkerState, spec: dict):
         async with sem:
             if task_id in state.cancel_requested:
                 raise rex.TaskCancelledError()
-            method = getattr(state.actor_instance, spec["method_name"])
+            method = _resolve_actor_method(state, spec["method_name"])
             if inspect.iscoroutinefunction(method):
                 value = await method(*args, **kwargs)
+            elif spec["method_name"] == "__dag_exec__":
+                # The compiled-DAG executor loop blocks on channels until
+                # teardown; parking it on the event loop (or a shared
+                # executor) would wedge every other method of this actor.
+                # Run the channel loop on a dedicated daemon thread, but
+                # marshal each bound-method invocation back onto the event
+                # loop (via call_on_loop) so actor state keeps its
+                # single-thread invariant (_setup_actor_concurrency).
+                method = functools.partial(method, call_on_loop=loop)
+                fut = loop.create_future()
+
+                def _dag_runner():
+                    try:
+                        r = method(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001
+                        res, err = None, e
+                    else:
+                        res, err = r, None
+
+                    def _complete():
+                        if fut.cancelled():
+                            return
+                        if err is not None:
+                            fut.set_exception(err)
+                        else:
+                            fut.set_result(res)
+
+                    try:
+                        loop.call_soon_threadsafe(_complete)
+                    except RuntimeError:
+                        pass  # loop already closed (worker shutdown)
+
+                threading.Thread(target=_dag_runner, daemon=True, name="dag-exec").start()
+                value = await fut
             else:
                 value = method(*args, **kwargs)
     except BaseException as e:  # noqa: BLE001

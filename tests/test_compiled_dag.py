@@ -178,6 +178,33 @@ class TestCompiledDAG:
         finally:
             compiled.teardown()
 
+    def test_async_actor_in_compiled_dag(self, ray_start_regular):
+        """An actor with any async method runs its task loop on the asyncio
+        engine; the compiled-DAG exec loop must still resolve and must not
+        block the event loop (regression: _arun used getattr, so
+        __dag_exec__ raised AttributeError into the void and execute().get()
+        surfaced only as a channel timeout)."""
+
+        @ray_tpu.remote
+        class A:
+            async def poke(self):
+                return "alive"
+
+            def double(self, x):
+                return 2 * x
+
+        a = A.remote()
+        with InputNode() as inp:
+            dag = a.double.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(21).get(timeout=30) == 42
+            # other (async) methods stay serviceable while the DAG loop runs
+            assert ray_tpu.get(a.poke.remote(), timeout=30) == "alive"
+            assert compiled.execute(5).get(timeout=30) == 10
+        finally:
+            compiled.teardown()
+
     def test_actor_usable_after_teardown(self, ray_start_regular):
         @ray_tpu.remote
         class W:
