@@ -84,6 +84,18 @@ class Config:
     #: Default max_retries for normal tasks (reference:
     #: ``task_retry_delay_ms`` / default 3 retries).
     default_max_retries: int = 3
+    #: A spawned worker process that has not registered with the head within
+    #: this many seconds is killed and respawned (reference:
+    #: ``worker_register_timeout_seconds``, ray_config_def.h) — turns an
+    #: interpreter that wedges at startup into a logged hiccup instead of an
+    #: indefinite hang of whatever is waiting on its task. 0 disables the
+    #: kill/respawn (agent-side spawns that crash before connecting then
+    #: fall back to a fixed 60s reap).
+    worker_register_timeout_s: float = 30.0
+    #: How many times a registration-timed-out spawn is retried before the
+    #: slot's work is failed (actor creation) or left to the scheduler
+    #: (pool workers).
+    worker_spawn_retries: int = 3
 
     # -- actors ------------------------------------------------------------
     default_max_restarts: int = 0

@@ -136,6 +136,9 @@ class WorkerHandle:
         # correlation that works for workers spawned on REMOTE hosts, where
         # the head never sees a pid
         self.token: Optional[str] = None
+        # which attempt of a spawn chain this handle is (0 = first); bounds
+        # registration-timeout respawns (reference: worker_register_timeout_seconds)
+        self.spawn_attempts = 0
 
     def send(self, msg) -> bool:
         try:
@@ -383,6 +386,10 @@ class Head:
         self.tcp_address: Optional[tuple] = None
         self._threads: list[threading.Thread] = []
         self._conn_worker: dict[Any, WorkerHandle] = {}
+        # startup tokens invalidated by a registration timeout: a late
+        # registration bearing one is told to exit instead of joining the
+        # pool (bounded; pruned oldest-first in _respawn_timed_out)
+        self._revoked_tokens: dict[str, bool] = {}
         self.task_events: list[dict] = []  # observability feed (state API)
         self._infeasible_warned: dict[bytes, float] = {}
 
@@ -556,7 +563,9 @@ class Head:
 
     # -------------------------------------------------------------- workers
 
-    def _spawn_worker(self, node: NodeState, actor_id: Optional[bytes] = None) -> None:
+    def _spawn_worker(
+        self, node: NodeState, actor_id: Optional[bytes] = None, attempts: int = 0
+    ) -> None:
         # Workers are fresh interpreter processes running a dedicated entry
         # point (`python -m ray_tpu._private.worker_main`), like the
         # reference's worker pool (worker_pool.h:152) execing default_worker.py
@@ -570,6 +579,7 @@ class Head:
             wh = WorkerHandle(node, None)
             wh.actor_id = actor_id
             wh.token = token
+            wh.spawn_attempts = attempts
             with self.lock:
                 node.all_workers.add(wh)
             if not node.agent.send(("spawn_worker", {"token": token})):
@@ -603,6 +613,7 @@ class Head:
         wh = WorkerHandle(node, proc)
         wh.actor_id = actor_id
         wh.token = token
+        wh.spawn_attempts = attempts
         with self.lock:
             node.all_workers.add(wh)
         # registration arrives on its own connection; matched in _on_register
@@ -624,6 +635,15 @@ class Head:
                     if cand.conn is None and cand.proc is not None and cand.proc.pid == pid:
                         wh = cand
                         break
+            if wh is None and token and token in self._revoked_tokens:
+                # timed out and already replaced: exit, don't join the pool
+                self._revoked_tokens.pop(token, None)
+                wh = WorkerHandle(node, None)
+                wh.conn = conn
+                wh.alive = False
+                self._conn_worker[conn] = wh
+                wh.send(("exit", None))
+                return wh
             if wh is None:  # race-safe fallback
                 wh = WorkerHandle(node, None)
                 node.all_workers.add(wh)
@@ -1023,22 +1043,52 @@ class Head:
             if self._snapshot_path and time.monotonic() >= self._snapshot_due:
                 self._snapshot_due = time.monotonic() + GLOBAL_CONFIG.gcs_snapshot_interval_s
                 self._snapshot()
-            dead, reap = [], []
+            dead, reap, timed_out = [], [], []
             keep = GLOBAL_CONFIG.idle_worker_keep_alive_s
+            reg_timeout = GLOBAL_CONFIG.worker_register_timeout_s
             now = time.monotonic()
             with self.lock:
                 for node in self.nodes.values():
                     for wh in list(node.all_workers):
-                        if wh.alive and wh.proc is not None and not wh.proc.is_alive():
+                        if (
+                            wh.alive
+                            and wh.proc is not None
+                            and not wh.proc.is_alive()
+                            and wh.conn is not None
+                        ):
                             dead.append(wh)
+                        elif (
+                            wh.alive
+                            and wh.conn is None
+                            and reg_timeout > 0
+                            and now - wh.created_at > reg_timeout
+                        ):
+                            # spawned but never registered: a process that
+                            # wedged at interpreter start (or an agent-side
+                            # spawn that crashed where we hold no handle).
+                            # Kill + respawn instead of hanging its waiters
+                            # forever (reference: worker_register_timeout_seconds,
+                            # ray_config_def.h; worker_pool.h startup tokens).
+                            timed_out.append(wh)
+                        elif (
+                            wh.alive
+                            and wh.proc is not None
+                            and not wh.proc.is_alive()
+                            and wh.conn is None
+                        ):
+                            # local spawn died before registering: no point
+                            # waiting out the registration deadline
+                            timed_out.append(wh)
                         elif (
                             wh.alive
                             and wh.proc is None
                             and wh.conn is None
+                            and reg_timeout <= 0
                             and now - wh.created_at > 60.0
                         ):
-                            # agent-spawned worker never registered (crashed
-                            # on a remote host where we hold no proc handle)
+                            # registration timeout disabled: keep the legacy
+                            # reap of agent-side spawns that crashed before
+                            # connecting (no proc handle to poll)
                             dead.append(wh)
                     # Reap workers idle beyond the keep-alive (reference:
                     # worker_pool idle worker killing), but never while work
@@ -1054,6 +1104,75 @@ class Head:
                 wh.send(("exit", None))
             for wh in dead:
                 self._on_worker_dead(wh)
+            for wh in timed_out:
+                self._respawn_timed_out(wh)
+
+    def _respawn_timed_out(self, wh: WorkerHandle) -> None:
+        """A spawned worker missed its registration deadline: kill it and
+        retry the spawn (bounded), without charging the actor-restart budget
+        — a wedge at interpreter start is an environment hiccup, not an
+        application failure. On exhaustion an actor creation fails through
+        the actor FSM; a pool slot's queued work goes back to the scheduler.
+        Reference: worker_register_timeout_seconds (ray_config_def.h)
+        + worker_pool.h startup-token accounting."""
+        with self.lock:
+            if wh.conn is not None or not wh.alive:
+                return  # registered (or was reaped) before we acted
+            wh.alive = False
+            node = wh.node
+            node.all_workers.discard(wh)
+            if wh.token:
+                # a racing late registration must match nothing and be told
+                # to exit, not fall back to a fresh pool handle
+                self._revoked_tokens[wh.token] = True
+                while len(self._revoked_tokens) > 1024:
+                    self._revoked_tokens.pop(next(iter(self._revoked_tokens)))
+            actor_id = wh.actor_id
+            attempts = wh.spawn_attempts + 1
+            retry = node.alive and attempts <= GLOBAL_CONFIG.worker_spawn_retries
+            if actor_id is None:
+                # return the spawn slot; a retry re-claims it immediately so
+                # _maybe_spawn doesn't double-spawn for the same queued work
+                node.spawning = max(0, node.spawning - 1)
+                if retry:
+                    node.spawning += 1
+        # kill only after the handle is dead and its token revoked (above):
+        # registration can no longer win the race and then be shot
+        if wh.proc is not None and wh.proc.is_alive():
+            wh.proc.terminate()
+        elif wh.proc is None and node.agent is not None and wh.token:
+            node.agent.send(("kill_worker", {"token": wh.token}))
+        print(
+            f"[ray_tpu] worker (attempt {attempts}) on node "
+            f"{node.node_id.hex()[:8]} did not register within "
+            f"{GLOBAL_CONFIG.worker_register_timeout_s}s; "
+            + ("respawning" if retry else "giving up")
+        )
+        if retry:
+            threading.Thread(
+                target=self._spawn_worker,
+                args=(node, actor_id),
+                kwargs={"attempts": attempts},
+                daemon=True,
+            ).start()
+        elif actor_id is not None:
+            # exhausted: let the actor FSM decide (restart budget / fail refs)
+            with self.lock:
+                self._on_actor_worker_death(actor_id)
+                self._schedule()
+        else:
+            # exhausted: hand this node's queued work back to the scheduler
+            # so it can land on another node — or start a fresh spawn chain
+            # here if this is the only one (never strand it in node.assigned,
+            # which nothing re-examines)
+            with self.lock:
+                while node.assigned:
+                    rec = node.assigned.popleft()
+                    self._release_alloc(rec)
+                    rec["state"] = "PENDING"
+                    rec["node"] = None
+                    self.pending_sched.append(rec)
+                self._schedule()
 
     # ------------------------------------------------------- memory monitor
 

@@ -58,6 +58,7 @@ class NodeAgent:
         self.resources = dict(resources or {"CPU": float(os.cpu_count() or 1)})
         self.labels = dict(labels or {})
         self._procs: list[subprocess.Popen] = []
+        self._by_token: dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
         self.conn = connect_head(address, authkey)
         self.conn.send(
@@ -82,6 +83,13 @@ class NodeAgent:
                     break
                 if msg[0] == "spawn_worker":
                     self._spawn(msg[1])
+                elif msg[0] == "kill_worker":
+                    # registration-timeout path: the head gave up on this
+                    # spawn; kill it here so a wedged interpreter doesn't
+                    # leak on the host (head.py _respawn_timed_out)
+                    p = self._by_token.pop(msg[1].get("token", ""), None)
+                    if p is not None and p.poll() is None:
+                        p.terminate()
                 elif msg[0] == "exit":
                     break
         finally:
@@ -97,22 +105,25 @@ class NodeAgent:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        self._procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    "-m",
-                    "ray_tpu._private.worker_main",
-                    self.address,
-                    self.authkey.hex(),
-                    self.node_id_bin.hex(),
-                    info.get("token", ""),
-                    "--remote",
-                ],
-                env=env,
-            )
+        popen = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.worker_main",
+                self.address,
+                self.authkey.hex(),
+                self.node_id_bin.hex(),
+                info.get("token", ""),
+                "--remote",
+            ],
+            env=env,
         )
+        self._procs.append(popen)
+        token = info.get("token", "")
+        if token:
+            self._by_token[token] = popen
         self._procs = [p for p in self._procs if p.poll() is None]
+        self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
 
     def shutdown(self) -> None:
         self._stop.set()
