@@ -64,13 +64,17 @@ def main():
     dev = jax.devices()[0]
     on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
     if on_tpu:
-        # 406M-param GPT, bf16, Pallas flash attention, full remat per
-        # block. batch 16 x seq 1024 measured best on v5e under an honest
-        # host-transfer barrier (0.33 MFU; flash beats the XLA einsum path
-        # 0.33 vs 0.29 at this shape; batch 32 / no-remat / "dots" remat
-        # all exceed the 16G HBM envelope; longer sequences only LOOKED
-        # faster under a broken async barrier).
-        cfg = GPTConfig(vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16)
+        # 406M-param GPT, bf16, Pallas flash attention (1024x1024 blocks),
+        # fused blockwise cross-entropy (never materializes the ~6.6 GB of
+        # fp32 logits), remat policy "big" (keeps flash out+lse and the MLP
+        # hidden; recomputes the cheap rest). batch 16 x seq 1024 measured
+        # best on v5e under an honest host-transfer barrier: 0.407 MFU
+        # (round-2 full-remat/naive-CE config: 0.317). batch 24 "big" is
+        # within noise; batch 32 OOMs; "dots"/"full" are slower.
+        cfg = GPTConfig(
+            vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16,
+            remat_policy="big",
+        )
         batch = 16
         steps = 8
     else:  # smoke config for CPU-only environments

@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import causal_attention
 
@@ -37,8 +38,15 @@ class GPTConfig:
     remat: bool = True
     #: "full" recomputes the whole block in backward (min HBM);
     #: "dots" saves matmul outputs (recomputes only cheap elementwise —
-    #: more HBM, fewer backward FLOPs). Tune per chip generation.
+    #: more HBM, fewer backward FLOPs); "attn" saves only the attention
+    #: output (skips recomputing flash attention, the priciest recompute,
+    #: at one (b,s,d) tensor per layer); "big" saves attention + MLP
+    #: hidden. Tune per chip generation.
     remat_policy: str = "full"
+    #: Blockwise fused cross-entropy in gpt_loss: never materializes the
+    #: (tokens, vocab) logits (the largest HBM consumer at bench shapes)
+    #: and runs the lm-head matmuls in the activation dtype on the MXU.
+    fused_loss: bool = True
     attn_impl: str = "auto"           # auto|xla|flash|ring (see ops/attention)
     # Mixture-of-Experts (0 = dense MLP). Experts shard over the mesh's
     # ``ep`` axis; routing uses GShard/Switch-style dense dispatch einsums
@@ -232,18 +240,35 @@ def _block(cfg: GPTConfig, x, layer, mesh=None):
         out, aux = _moe_mlp(cfg, ln2, layer, c)
     else:
         hmid = jax.nn.gelu(ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt))
+        hmid = checkpoint_name(hmid, "mlp_mid")
         hmid = c(hmid, P(("dp", "fsdp"), "sp", "tp"))
         out = hmid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
         aux = jnp.float32(0.0)
     return x + c(out, P(("dp", "fsdp"), "sp", None)), aux
 
 
-def gpt_forward(
-    cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None, return_aux: bool = False
-):
-    """tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+_REMAT_POLICIES = {
+    "full": lambda: None,
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    # "attn" keeps the flash kernel's out+lse (tagged inside _flash_core_fwd)
+    # so the backward's rematerialization never re-runs the attention kernel
+    # — everything else (layernorms, qkv/mlp matmuls) recomputes as usual.
+    # (On the non-flash XLA fallback there is nothing tagged, so these
+    # degrade gracefully to full remat.)
+    "attn": lambda: jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"
+    ),
+    "big": lambda: jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse", "mlp_mid"
+    ),
+}
 
-    ``return_aux=True`` also returns the mean MoE load-balancing loss."""
+
+def gpt_hidden(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None):
+    """tokens (batch, seq) int32 → (final hidden (batch, seq, d_model) in the
+    activation dtype, mean MoE aux loss). The lm head is applied by the
+    caller — gpt_forward materializes logits; gpt_loss feeds the hidden to
+    the blockwise fused cross-entropy instead."""
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     x = params["embed"]["tokens"].astype(dt)[tokens]
@@ -254,33 +279,57 @@ def gpt_forward(
         return y, aux
 
     if cfg.remat:
-        if cfg.remat_policy not in ("full", "dots"):
+        if cfg.remat_policy not in _REMAT_POLICIES:
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+                f"remat_policy must be one of {sorted(_REMAT_POLICIES)}, "
+                f"got {cfg.remat_policy!r}"
             )
-        policy = (
-            jax.checkpoint_policies.checkpoint_dots
-            if cfg.remat_policy == "dots"
-            else None
-        )
+        policy = _REMAT_POLICIES[cfg.remat_policy]()
         block = jax.checkpoint(block, prevent_cse=False, policy=policy)
     x, auxes = jax.lax.scan(block, x, params["blocks"])
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return x, auxes.mean()
+
+
+def gpt_forward(
+    cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None, return_aux: bool = False
+):
+    """tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    ``return_aux=True`` also returns the mean MoE load-balancing loss."""
+    x, aux = gpt_hidden(cfg, params, tokens, mesh)
     logits = x.astype(jnp.float32) @ params["lm_head"]["kernel"]
     if return_aux:
-        return logits, auxes.mean()
+        return logits, aux
     return logits
 
 
 def gpt_loss(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.Array:
     """Next-token cross-entropy, mean over (batch, seq-1); MoE configs add
-    the weighted load-balancing aux loss."""
-    logits, aux = gpt_forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
+    the weighted load-balancing aux loss.
+
+    With ``cfg.fused_loss`` (default) the loss never materializes the
+    (tokens, vocab) logits: ``ops.fused_ce`` streams vocab chunks through
+    the MXU in the activation dtype (see its module docstring for the HBM
+    arithmetic — ~6.6 GB saved at the 406M bench shape)."""
+    hidden, aux = gpt_hidden(cfg, params, tokens[:, :-1], mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -ll.mean()
+    if cfg.fused_loss:
+        from ray_tpu.ops.fused_ce import fused_softmax_cross_entropy
+
+        b, s, d = hidden.shape
+        losses = fused_softmax_cross_entropy(
+            hidden.reshape(b * s, d),
+            params["lm_head"]["kernel"],
+            targets.reshape(-1).astype(jnp.int32),
+        )
+        loss = losses.mean()
+    else:
+        logits = hidden.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -ll.mean()
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

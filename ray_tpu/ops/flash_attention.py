@@ -290,43 +290,89 @@ def _pick_blocks(seq: int, block_q: int, block_k: int) -> tuple[int, int]:
     return max(bq, 1), max(bk, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, block_q, block_k, block_q_bwd, block_k_bwd):
     out, _ = _flash_fwd(q, k, v, block_q=block_q, block_k=block_k)
     return out
 
 
-def _flash_core_fwd(q, k, v, block_q, block_k):
+def _flash_core_fwd(q, k, v, block_q, block_k, block_q_bwd, block_k_bwd):
     out, lse = _flash_fwd(q, k, v, block_q=block_q, block_k=block_k)
+    # Name the kernel's own residuals so a jax.checkpoint policy
+    # (save_only_these_names, models/gpt.py remat_policy="attn"/"big") can
+    # keep exactly these and dead-code the whole forward kernel out of the
+    # rematerialized backward — the single biggest recompute in a
+    # full-remat transformer block.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(block_q, block_k, res, do):
+def _flash_core_bwd(block_q, block_k, block_q_bwd, block_k_bwd, res, do):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, do, block_q=block_q, block_k=block_k)
+    return _flash_bwd(q, k, v, out, lse, do, block_q=block_q_bwd, block_k=block_k_bwd)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def _env_block(name: str, default: int) -> int:
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        # a typo'd sweep var must fail loudly, or every sweep point silently
+        # benchmarks the identical default configuration
+        raise ValueError(f"{name}={raw!r} is not an integer block size") from None
+
+
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, block_q: int = 256, block_k: int = 512
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    block_q_bwd: int | None = None,
+    block_k_bwd: int | None = None,
 ) -> jax.Array:
     """Causal flash attention. q,k,v: (batch, heads, seq, head_dim).
 
     O(seq) HBM / O(block) VMEM; differentiable (custom VJP with
-    blockwise-recompute backward). On TPU, seq must tile by 128 (Mosaic lane
-    constraint) — falls back to the XLA path otherwise; interpret mode (CPU
-    CI) accepts any power-of-two-friendly blocking.
+    blockwise-recompute backward). Forward and backward block shapes tune
+    independently (the dQ/dKV kernels have different reuse patterns than the
+    forward); defaults are overridable via RAY_TPU_FLASH_{BQ,BK,BQB,BKB} for
+    sweeps. On TPU, seq must tile by 128 (Mosaic lane constraint) — falls
+    back to the XLA path otherwise; interpret mode (CPU CI) accepts any
+    power-of-two-friendly blocking.
     """
     b, h, s, d = q.shape
+    # Default 1024×1024 measured fastest on v5e at (bh 256, s 1024, d 64):
+    # fewer, fatter grid steps win — the kernel is latency-bound per step at
+    # small head_dim, not VMEM-bound (sweep: 4.1 ms/layer at 256×512 →
+    # 2.6 ms at 1024×1024; jax's own tuned kernel measures 2.2 at this
+    # shape). _pick_blocks clamps to the actual sequence length.
+    block_q = block_q if block_q is not None else _env_block("RAY_TPU_FLASH_BQ", 1024)
+    block_k = block_k if block_k is not None else _env_block("RAY_TPU_FLASH_BK", 1024)
+    block_q_bwd = (
+        block_q_bwd if block_q_bwd is not None else _env_block("RAY_TPU_FLASH_BQB", block_q)
+    )
+    block_k_bwd = (
+        block_k_bwd if block_k_bwd is not None else _env_block("RAY_TPU_FLASH_BKB", block_k)
+    )
     bq, bk = _pick_blocks(s, block_q, block_k)
-    if not _interpret() and (bq % 128 or bk % 128):
+    bqb, bkb = _pick_blocks(s, block_q_bwd, block_k_bwd)
+    if not _interpret() and (bq % 128 or bk % 128 or bqb % 128 or bkb % 128):
         from ray_tpu.ops.attention import _xla_attention
 
         return _xla_attention(q, k, v)
     merge = lambda t: t.reshape(b * h, s, d)  # noqa: E731
-    out = _flash_core(merge(q), merge(k), merge(v), bq, bk)
+    out = _flash_core(merge(q), merge(k), merge(v), bq, bk, bqb, bkb)
     return out.reshape(b, h, s, d)
 
 
