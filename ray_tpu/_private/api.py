@@ -72,12 +72,15 @@ def init(
         from ray_tpu._private.runtime import RemoteDriverContext
         from ray_tpu._private.worker_main import connect_head
 
-        conn = connect_head(address, resolve_authkey())
+        authkey = resolve_authkey()
+        conn = connect_head(address, authkey)
         conn.send(("register_driver", {}))
         kind, info = conn.recv()
         if kind != "driver_ack":
             raise rex.RayError(f"unexpected handshake reply {kind!r}")
-        ctx = RemoteDriverContext(conn, info["node_id"])
+        ctx = RemoteDriverContext(
+            conn, info["node_id"], authkey=authkey, head_host=address.rsplit(":", 1)[0]
+        )
         runtime.set_ctx(ctx)
         atexit.register(_atexit_shutdown)
         return _context_info()

@@ -1,0 +1,233 @@
+"""Peer-to-peer bulk object transfer (the data plane).
+
+The reference moves objects node→node directly: each raylet's object manager
+serves chunks over gRPC with push/pull managers
+(``src/ray/object_manager/object_manager.h:117``, ``push_manager.h:30``,
+``pull_manager.cc:48``) and the GCS holds only the directory. Round 2 of
+this build funneled every remote byte through the head as one inline RPC —
+two hops, head bandwidth = cluster bandwidth. This module is the fix:
+
+* every host (head and node agents) runs a ``DataServer`` — an
+  hmac-authenticated TCP listener that serves the host's shared-memory
+  objects (arena blocks pinned for the duration of the send; dedicated
+  segments attached read-only) in bounded chunks, zero-copy out of the
+  mapping via ``send_bytes(memoryview)``;
+* consumers ``fetch()`` straight from the owning host — the head hands out
+  only the locator (object directory role) and its data socket address;
+* receivers write into one preallocated buffer via ``recv_bytes_into``
+  (single copy off the socket), then deserialize with out-of-band buffer
+  views into it (no further copies).
+
+Connections are pooled per address and reused; a vanished object (freed or
+spilled between locator and fetch) answers ("gone", reason) and the caller
+falls back to the head's restore path, mirroring the reference's pull-retry.
+
+Known limitation (vs the reference's per-raylet spill): agent hosts do not
+spill to disk. The arena is bounded by a watermark — workers degrade to the
+head-mediated inline path (whose spill machinery applies) when their arena
+passes 90% — but over-arena-cap dedicated segments are bounded only by
+object lifetimes (the head frees them promptly, and agents sweep orphans by
+name prefix at shutdown).
+"""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Optional
+
+_CHUNK = 8 * 1024 * 1024
+
+
+class DataServer:
+    """Serves this host's shm objects to remote pullers."""
+
+    def __init__(self, authkey: bytes, host: str = "0.0.0.0"):
+        self._listener = Listener((host, 0), authkey=authkey)
+        self.port = self._listener.address[1]
+        self.bytes_served = 0
+        self._shutdown = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="data-server", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):  # noqa: BLE001 - auth failures too
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(
+                target=self._serve, args=(conn,), name="data-serve", daemon=True
+            ).start()
+
+    def _serve(self, conn) -> None:
+        from ray_tpu._private.shm_store import ShmReader
+
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if msg[0] == "stat":
+                    # liveness probe: does this host still hold the object?
+                    # (head uses it to verify a report_lost before freeing)
+                    try:
+                        ShmReader(msg[1]).close()
+                        conn.send(("ok_stat", True))
+                    except FileNotFoundError:
+                        conn.send(("ok_stat", False))
+                    continue
+                if msg[0] != "fetch":
+                    conn.send(("err", f"unknown request {msg[0]!r}"))
+                    continue
+                loc = msg[1]
+                try:
+                    reader = ShmReader(loc)
+                except FileNotFoundError as e:
+                    conn.send(("gone", str(e)))
+                    continue
+                try:
+                    mv = reader._mv()
+                    total = loc.total_size
+                    conn.send(("ok", total))
+                    off = 0
+                    while off < total:
+                        n = min(_CHUNK, total - off)
+                        conn.send_bytes(mv[off : off + n])
+                        off += n
+                    self.bytes_served += total
+                finally:
+                    reader.close()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class _Pool:
+    """Per-address client connection pool (one cached conn per (addr, thread)
+    would over-connect; a small free-list with a lock is plenty — fetches are
+    bulk transfers, not latency-bound RPCs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list] = {}
+
+    def take(self, address: tuple, authkey: bytes):
+        with self._lock:
+            conns = self._free.get(address)
+            if conns:
+                return conns.pop()
+        return Client(address, authkey=authkey)
+
+    def give(self, address: tuple, conn) -> None:
+        with self._lock:
+            self._free.setdefault(address, []).append(conn)
+
+    def clear(self) -> None:
+        with self._lock:
+            for conns in self._free.values():
+                for c in conns:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+            self._free.clear()
+
+
+_pool = _Pool()
+
+
+class ObjectGone(Exception):
+    """The owning host no longer has the object (freed/spilled/evicted)."""
+
+
+def fetch(address: tuple[str, int], authkey: bytes, loc) -> memoryview:
+    """Pull one object's laid-out bytes from its owning host.
+
+    Returns a memoryview over a freshly received buffer in the shm layout
+    ([header][buf0][buf1...], see shm_store._layout) — deserialize with
+    ``read_layout``. Raises ObjectGone when the owner dropped it, OSError
+    when the host is unreachable.
+    """
+    conn = _pool.take(address, authkey)
+    ok = False
+    try:
+        conn.send(("fetch", loc))
+        resp = conn.recv()
+        if resp[0] == "gone":
+            ok = True  # connection still healthy — pool it
+            raise ObjectGone(resp[1])
+        if resp[0] != "ok":
+            raise OSError(f"data server error: {resp!r}")
+        total = resp[1]
+        buf = bytearray(total)
+        mv = memoryview(buf)
+        off = 0
+        while off < total:
+            n = conn.recv_bytes_into(mv[off:])
+            off += n
+        ok = True
+        return mv
+    finally:
+        if ok:
+            _pool.give(address, conn)
+        else:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def read_layout(mv: memoryview, loc):
+    """Deserialize a value from fetched layout bytes (zero further copies:
+    out-of-band buffers are views into ``mv``)."""
+    import pickle
+
+    from ray_tpu._private.shm_store import layout_views
+
+    header, bufs = layout_views(mv, loc.header_len, loc.buffer_lens)
+    return pickle.loads(header, buffers=bufs)
+
+
+def stat(address: tuple[str, int], authkey: bytes, loc) -> Optional[bool]:
+    """Ask the owning host whether it still holds ``loc``. True/False from
+    the server; None when the host is unreachable (let node-death handling
+    decide — do NOT treat unreachable as gone)."""
+    try:
+        conn = _pool.take(address, authkey)
+    except OSError:
+        return None
+    ok = False
+    try:
+        conn.send(("stat", loc))
+        resp = conn.recv()
+        ok = True
+        return bool(resp[1]) if resp[0] == "ok_stat" else None
+    except (OSError, EOFError):
+        return None
+    finally:
+        if ok:
+            _pool.give(address, conn)
+        else:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def shutdown_pool() -> None:
+    _pool.clear()

@@ -174,6 +174,9 @@ class NodeState:
         self.resources_avail = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        # (host, port) of the node's data-plane server (agent nodes only;
+        # head-host nodes are served by the head's own DataServer)
+        self.data_address: Optional[tuple] = None
         self.idle_workers: list[WorkerHandle] = []
         self.all_workers: set[WorkerHandle] = set()
         self.spawning = 0
@@ -384,6 +387,16 @@ class Head:
         self._listener = None
         self._tcp_listener = None
         self.tcp_address: Optional[tuple] = None
+        # data plane (peer-to-peer bulk object transfer, data_plane.py):
+        # started alongside the TCP control plane; the head then acts as the
+        # object DIRECTORY only — bytes move host-to-host directly
+        # (reference: object_manager.h:117 + gcs object locations)
+        self.data_server = None
+        self.data_port: Optional[int] = None
+        #: bytes the head itself shipped inline for remote readers — the
+        #: legacy funnel path, kept as a fallback; the p2p test asserts this
+        #: stays 0 when the data plane is healthy
+        self.inline_bytes_served = 0
         self._threads: list[threading.Thread] = []
         self._conn_worker: dict[Any, WorkerHandle] = {}
         # startup tokens invalidated by a registration timeout: a late
@@ -428,6 +441,11 @@ class Head:
 
         self._tcp_listener = Listener((host, port), authkey=self.authkey)
         self.tcp_address = self._tcp_listener.address
+        if self.data_server is None:
+            from ray_tpu._private.data_plane import DataServer
+
+            self.data_server = DataServer(self.authkey, host)
+            self.data_port = self.data_server.port
         t = threading.Thread(
             target=self._accept_loop, args=(self._tcp_listener, True),
             name="head-accept-tcp", daemon=True,
@@ -498,7 +516,10 @@ class Head:
         for it will be spawned THERE via spawn requests over this conn."""
         node_id = self.add_node(info.get("resources") or {}, labels=info.get("labels"))
         with self.lock:
-            self.nodes[node_id.binary()].agent = AgentHandle(conn)
+            node = self.nodes[node_id.binary()]
+            node.agent = AgentHandle(conn)
+            if info.get("data_address"):
+                node.data_address = tuple(info["data_address"])
         conn.send(("agent_ack", {"node_id": node_id.binary()}))
         with self.lock:
             self._schedule()  # queued-infeasible work may now fit
@@ -527,24 +548,65 @@ class Head:
             self._run_request(conn, worker, seq, handler, payload)
 
     def _rpc_get_remote(self, obj_ids, timeout=None):
-        """get for TCP clients: shm locators are unreadable across hosts, so
-        the head reads the segment and ships the bytes inline (first-cut
-        inter-node object transfer; reference: object_manager chunked pull)."""
+        """get for TCP clients. With the data plane up, hand out the shm
+        locators untouched — the client pulls the bytes straight from the
+        owning host's data server (head = directory only; reference:
+        object_manager.h peer-to-peer chunked transfer). Without it, fall
+        back to the round-2 behavior of shipping bytes inline."""
+        if self.data_server is not None:
+            return self.get_locators(obj_ids, timeout)
+        return self.rpc_get_inline(obj_ids, timeout)
+
+    def rpc_get_inline(self, obj_ids, timeout=None):
+        """Head-mediated object fetch: read the bytes (locally, or pulled
+        from the owning agent's data server) and ship them inline on the
+        control socket. Fallback for clients that cannot reach a host's
+        data server; ``inline_bytes_served`` counts this traffic so tests
+        can assert the p2p path leaves it at zero."""
+        from ray_tpu._private import data_plane
         from ray_tpu._private.shm_store import ShmReader
 
         out = []
         for loc in self.get_locators(obj_ids, timeout):
             kind, payload, is_err = loc
-            if kind == "shm":
+            if kind != "shm":
+                out.append(loc)
+                continue
+            data = None
+            try:
                 reader = ShmReader(payload)
                 try:
                     data = reader.read_serialized_bytes()
                 finally:
                     reader.close()
-                out.append(("inline", data, is_err))
-            else:
-                out.append(loc)
+            except FileNotFoundError:
+                with self.lock:
+                    node = self.nodes.get(payload.node) if payload.node else None
+                addr = node.data_address if node is not None else None
+                if addr is not None:
+                    from ray_tpu._private.shm_store import layout_views
+
+                    mv = data_plane.fetch(addr, self.authkey, payload)
+                    header, bufs = layout_views(
+                        mv, payload.header_len, payload.buffer_lens
+                    )
+                    data = ser.SerializedValue(bytes(header), bufs).to_bytes()
+            if data is None:
+                raise FileNotFoundError("object backing unavailable")
+            self.inline_bytes_served += len(data)
+            out.append(("inline", data, is_err))
         return out
+
+    def rpc_data_address(self, node_id=None):
+        """Data-plane address for a node's host. Agent nodes advertise their
+        own server; anything else (head host, simulated local nodes,
+        unknown) maps to the head's server. host=None means "the host you
+        already reach the control plane on"."""
+        with self.lock:
+            n = self.nodes.get(node_id) if node_id else None
+            if n is not None and n.data_address is not None:
+                return tuple(n.data_address)
+        return (None, self.data_port) if self.data_port else None
 
     def _run_request(self, conn, worker, seq, handler, payload):
         try:
@@ -756,6 +818,17 @@ class Head:
                     pg.state = PG_PENDING
                     pg.ready_event.clear()
                     self._try_place_pg(pg)
+            # objects whose bytes lived on the dead host are gone: rebuild
+            # via lineage or mark LOST now, so readers fail fast instead of
+            # timing out against an unreachable data server (reference:
+            # object directory location removal on node death). Skipped
+            # during shutdown — resubmitting tasks into a dying cluster is
+            # pure noise.
+            nid = node_id.binary()
+            if not self._shutdown:
+                for oid, ent in list(self.objects.items()):
+                    if ent.shm is not None and ent.shm.node == nid:
+                        self._reconstruct(oid, ent)
             self._schedule()
             self.cv.notify_all()
 
@@ -998,6 +1071,25 @@ class Head:
             self.cv.notify_all()
             self._schedule()
 
+    def _loc_is_local(self, loc) -> bool:
+        """Does this shm locator live on the head's own host? (Simulated
+        local nodes share the host; only agent nodes are truly remote.)"""
+        if loc.node is None:
+            return True
+        n = self.nodes.get(loc.node)
+        return n is None or n.agent is None
+
+    def _release_loc(self, loc) -> None:
+        """Free an object's backing wherever it lives: locally via the
+        owner registry, or by routing a free_shm to the owning node's agent
+        (reference: object directory + raylet-local frees)."""
+        if self._loc_is_local(loc):
+            self.shm_owner.unlink(loc)
+            return
+        node = self.nodes.get(loc.node)
+        if node is not None and node.agent is not None and node.alive:
+            node.agent.send(("free_shm", loc))
+
     def _store_locator(self, obj_id: bytes, locator):
         ent = self.objects.get(obj_id)
         if ent is None:
@@ -1007,10 +1099,13 @@ class Head:
             ent.small = payload
             ent.size = len(payload)
         else:
-            self._ensure_capacity(payload.total_size)
             ent.shm = payload
             ent.size = payload.total_size
-            self.shm_owner.register(payload)
+            if self._loc_is_local(payload):
+                # only head-host bytes count toward this host's spill
+                # watermark; agent-host objects live in THEIR arenas
+                self._ensure_capacity(payload.total_size)
+                self.shm_owner.register(payload)
         ent.last_access = time.monotonic()
         ent.is_error = is_err
         self._deps_ready(obj_id)
@@ -1579,7 +1674,7 @@ class Head:
         if ent.refcount <= 0 and ent.pins <= 0 and ent.ready:
             self.objects.pop(obj_id, None)
             if ent.shm is not None:
-                self.shm_owner.unlink(ent.shm)
+                self._release_loc(ent.shm)
             if ent.spill_path is not None:
                 try:
                     os.unlink(ent.spill_path)
@@ -1616,7 +1711,12 @@ class Head:
                 # grace window: a locator handed out moments ago may not be
                 # attached yet — unlinking it would FileNotFoundError the
                 # reader (clients also re-fetch on that error as a backstop)
-                if e.shm is not None and e.pins <= 0 and now - e.last_read > 5.0
+                if e.shm is not None
+                and e.pins <= 0
+                and now - e.last_read > 5.0
+                # agent-host objects can't be spilled from here (their bytes
+                # live in another host's arena)
+                and self._loc_is_local(e.shm)
             ),
             key=lambda kv: kv[1].last_access,
         )
@@ -1752,18 +1852,56 @@ class Head:
         LOST. The caller re-issues its get, which blocks until ready."""
         from ray_tpu._private.shm_store import ShmReader
 
+        # Verify before destroying anything: a report can also mean the
+        # CALLER had a transient problem (unreachable data server, auth,
+        # network) — freeing a healthy object on hearsay would turn a
+        # blip into permanent loss for no-lineage (ray.put) objects.
+        from ray_tpu._private import data_plane
+
+        foreign: list[tuple] = []
         with self.lock:
+            lost: list[bytes] = []
             for oid in obj_ids:
                 ent = self.objects.get(oid)
                 if ent is None or ent.small is not None or ent.shm is None:
                     continue  # inline data or already being handled
-                try:
-                    ShmReader(ent.shm).close()
-                    continue  # backing is actually fine (caller raced)
-                except FileNotFoundError:
-                    pass
-                self.shm_owner.unlink(ent.shm)
-                self._reconstruct(oid, ent)  # failure stores ObjectLostError
+                if self._loc_is_local(ent.shm):
+                    try:
+                        ShmReader(ent.shm).close()
+                        continue  # backing is actually fine (caller raced)
+                    except FileNotFoundError:
+                        lost.append(oid)
+                else:
+                    node = self.nodes.get(ent.shm.node)
+                    addr = node.data_address if node is not None else None
+                    foreign.append((oid, addr, ent.shm))
+            for oid in lost:
+                ent = self.objects.get(oid)
+                if ent is not None and ent.shm is not None:
+                    self._release_loc(ent.shm)
+                    self._reconstruct(oid, ent)  # failure stores ObjectLostError
+            self.cv.notify_all()
+        if not foreign:
+            return
+        # probe owners OUTSIDE the lock (network), then act
+        verdicts = []
+        for oid, addr, loc in foreign:
+            gone = (
+                data_plane.stat(addr, self.authkey, loc) is False
+                if addr is not None
+                else False
+            )
+            # unreachable (None) or no address: leave it — if the node is
+            # actually dead the health loop's remove_node purges its objects
+            verdicts.append((oid, gone))
+        with self.lock:
+            for oid, gone in verdicts:
+                if not gone:
+                    continue
+                ent = self.objects.get(oid)
+                if ent is not None and ent.shm is not None:
+                    self._release_loc(ent.shm)
+                    self._reconstruct(oid, ent)
             self.cv.notify_all()
 
     def free_objects(self, obj_ids: list[bytes]):
@@ -1771,7 +1909,7 @@ class Head:
             for oid in obj_ids:
                 ent = self.objects.pop(oid, None)
                 if ent is not None and ent.shm is not None:
-                    self.shm_owner.unlink(ent.shm)
+                    self._release_loc(ent.shm)
 
     # -------------------------------------------------------- task cancel
 
@@ -2325,6 +2463,12 @@ class Head:
         with self.lock:
             self._shutdown = True
             workers = [w for n in self.nodes.values() for w in n.all_workers]
+            # route frees of agent-host objects while agent conns are still
+            # up — their dedicated segments would otherwise outlive the
+            # cluster (arenas die with their agents; segments don't)
+            for ent in self.objects.values():
+                if ent.shm is not None and not self._loc_is_local(ent.shm):
+                    self._release_loc(ent.shm)
             self.cv.notify_all()
         for wh in workers:
             wh.alive = False
@@ -2347,6 +2491,8 @@ class Head:
                 self._tcp_listener.close()
             except Exception:
                 pass
+        if self.data_server is not None:
+            self.data_server.shutdown()
         self._pub_queue.put(None)
         self._blocking_pool.shutdown()
         self._snapshot()

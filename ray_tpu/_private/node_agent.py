@@ -61,10 +61,34 @@ class NodeAgent:
         self._by_token: dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
         self.conn = connect_head(address, authkey)
+        # This host's slice of the object plane: a local arena for workers'
+        # writes plus a data server from which ANY node pulls this host's
+        # objects directly (reference: each raylet's plasma store + object
+        # manager; the head keeps only the directory — data_plane.py).
+        from ray_tpu._private import shm_store
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.data_plane import DataServer
+
+        self.arena_name = None
+        if GLOBAL_CONFIG.object_store_arena_bytes > 0:
+            self.arena_name = shm_store.create_arena(
+                GLOBAL_CONFIG.object_store_arena_bytes
+            )
+        import uuid as _uuid
+
+        self._seg_prefix = f"rtps-{_uuid.uuid4().hex[:8]}-"
+        self.data_server = DataServer(authkey)
+        data_address = (self._my_ip(), self.data_server.port)
         self.conn.send(
             (
                 "register_agent",
-                {"resources": self.resources, "labels": self.labels, "pid": os.getpid()},
+                {
+                    "resources": self.resources,
+                    "labels": self.labels,
+                    "pid": os.getpid(),
+                    "data_address": data_address,
+                    "arena_name": self.arena_name,
+                },
             )
         )
         kind, info = self.conn.recv()
@@ -83,6 +107,15 @@ class NodeAgent:
                     break
                 if msg[0] == "spawn_worker":
                     self._spawn(msg[1])
+                elif msg[0] == "free_shm":
+                    # the head routed a free of an object living on THIS
+                    # host (head._release_loc)
+                    from ray_tpu._private.shm_store import free_location
+
+                    try:
+                        free_location(msg[1])
+                    except Exception:  # noqa: BLE001 - frees are best-effort
+                        pass
                 elif msg[0] == "kill_worker":
                     # registration-timeout path: the head gave up on this
                     # spawn; kill it here so a wedged interpreter doesn't
@@ -105,6 +138,15 @@ class NodeAgent:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if self.arena_name:
+            # workers write their objects into THIS host's arena; the head
+            # receives only the locator (see WorkerContext.put_serialized)
+            env["RAY_TPU_ARENA"] = self.arena_name
+        else:
+            env.pop("RAY_TPU_ARENA", None)
+        # over-arena-cap objects get dedicated segments tagged with this
+        # agent's prefix, so shutdown can sweep any the head never freed
+        env["RAY_TPU_SEG_PREFIX"] = self._seg_prefix
         popen = subprocess.Popen(
             [
                 sys.executable,
@@ -125,6 +167,21 @@ class NodeAgent:
         self._procs = [p for p in self._procs if p.poll() is None]
         self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
 
+    def _my_ip(self) -> str:
+        """The IP other hosts can reach this agent's data server on: the
+        local address of the control connection to the head (routable by
+        construction; '127.0.0.1' stays loopback for same-host tests)."""
+        import socket as _socket
+
+        try:
+            s = _socket.socket(fileno=os.dup(self.conn.fileno()))
+            try:
+                return s.getsockname()[0]
+            finally:
+                s.close()  # closes only the dup'd fd
+        except OSError:
+            return "127.0.0.1"
+
     def shutdown(self) -> None:
         self._stop.set()
         for p in self._procs:
@@ -135,4 +192,18 @@ class NodeAgent:
                 p.wait(timeout=3)
             except Exception:
                 p.kill()
+        self.data_server.shutdown()
+        if self.arena_name:
+            from ray_tpu._private import shm_store
+
+            shm_store.unlink_arena(self.arena_name)
+        # sweep worker segments the head never freed (crashed producers,
+        # refs alive at shutdown) — identifiable by this agent's prefix
+        import glob as _glob
+
+        for path in _glob.glob(f"/dev/shm/{self._seg_prefix}*"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         shutdown_conn(self.conn)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import threading
+import time
 from typing import Any, Optional
 
 from ray_tpu import exceptions as rex
@@ -136,6 +137,9 @@ class BaseContext:
     def __init__(self):
         self.closed = False
         self.remote = False  # True = different host than the head (no shm)
+        self.authkey: Optional[bytes] = None  # data-plane auth (set by subclasses)
+        self.head_host: str = "127.0.0.1"  # host we reach the control plane on
+        self._data_addrs: dict = {}  # node bin -> (host, port) cache
         self._uploaded_funcs: set[bytes] = set()
         self._readers: dict[bytes, ShmReader] = {}
         self._readers_lock = threading.Lock()
@@ -207,22 +211,117 @@ class BaseContext:
             out.append(value)
         return out
 
+    def store_value(self, sv: "ser.SerializedValue", is_error: bool = False):
+        """Locator for a freshly serialized value. Large payloads go into
+        THIS host's shared memory (arena or dedicated segment) and only the
+        locator travels — on agent hosts the bytes are then served
+        peer-to-peer by the agent's data server (data_plane.py). A remote
+        process without a local store (a ``ray://`` driver) ships inline."""
+        from ray_tpu._private.shm_store import _current_write_arena, write_shm
+
+        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+            return ("inline", sv.to_bytes(), is_error)
+        if self.remote:
+            arena = _current_write_arena()
+            if arena is None:
+                # no host-local store to serve from (remote driver, or agent
+                # without the native arena): the head re-lays these into its
+                # shm and its spill watermark owns the lifetime
+                return ("inline", sv.to_bytes(), is_error)
+            if (
+                sv.total_size <= GLOBAL_CONFIG.arena_max_object_bytes
+                and arena.used + sv.total_size > 0.9 * arena.capacity
+            ):
+                # agent arena under pressure: agents have no spill of their
+                # own (the head owns object lifetimes), so degrade to the
+                # head-mediated path where the spill machinery applies
+                # instead of running the agent host out of /dev/shm
+                return ("inline", sv.to_bytes(), is_error)
+        loc = write_shm(sv)
+        loc.node = self.node_id_bin
+        return ("shm", loc, is_error)
+
+    def _data_address_for(self, node_bin) -> Optional[tuple]:
+        cached = self._data_addrs.get(node_bin)
+        now = time.monotonic()
+        if cached is not None and (cached[0] is not None or now < cached[1]):
+            addr = cached[0]
+        else:
+            try:
+                addr = self.call("data_address", node_id=node_bin)
+            except Exception:
+                addr = None
+            # a negative result is transient (control hiccup, node still
+            # registering): cache it for 5s only, or one bad lookup would
+            # disable the data plane for this node forever
+            self._data_addrs[node_bin] = (addr, now + 5.0)
+        if addr is None:
+            return None
+        host, port = addr
+        return (host or self.head_host, port)
+
+    def _fetch_via_data_plane(self, obj_id: bytes, payload):
+        """Pull an object's bytes straight from its owning host (reference:
+        pull_manager.cc chunked pulls). Returns (True, value) or (False,
+        None) when the object is gone / the data plane can't serve it —
+        callers then run the lost-object recovery path."""
+        from ray_tpu._private import data_plane
+
+        if self.authkey is None:
+            return False, None
+        addr = self._data_address_for(payload.node)
+        if addr is None:
+            return False, None
+        try:
+            mv = data_plane.fetch(addr, self.authkey, payload)
+        except data_plane.ObjectGone:
+            return False, None
+        except OSError:
+            # owner unreachable (died? network?): drop the cached address
+            # and try the head-mediated inline fallback before declaring loss
+            self._data_addrs.pop(payload.node, None)
+            try:
+                loc = self.call("get_inline", obj_ids=[obj_id], timeout=0)[0]
+            except Exception:
+                return False, None
+            if loc[0] == "inline":
+                return True, ser.deserialize_value(
+                    ser.SerializedValue.from_bytes(loc[1])
+                )
+            return False, None
+        return True, data_plane.read_layout(mv, payload)
+
     def _materialize(self, obj_id: bytes, locator, _retry: bool = True):
         kind, payload, is_err = locator
         if kind == "inline":
             return ser.deserialize_value(ser.SerializedValue.from_bytes(payload))
-        with self._readers_lock:
-            reader = self._readers.get(obj_id)
-            if reader is None:
-                try:
-                    reader = ShmReader(payload)
-                except FileNotFoundError:
-                    # segment spilled/unlinked between the head handing out
-                    # this locator and us attaching — re-fetch once (the head
-                    # restores spilled objects transparently)
-                    if not _retry:
-                        raise
-                    reader = None
+        import os as _os
+
+        force_dp = (
+            _os.environ.get("RAY_TPU_FORCE_DATA_PLANE") == "1"
+            and payload.node is not None
+            and payload.node != self.node_id_bin
+        )
+        reader = None
+        if not force_dp:
+            with self._readers_lock:
+                reader = self._readers.get(obj_id)
+                if reader is None:
+                    try:
+                        # local-first: on the owning host (or any same-host
+                        # simulated node) the shm attaches by name, zero-copy
+                        reader = ShmReader(payload)
+                    except FileNotFoundError:
+                        # not on this host — or spilled/unlinked under us
+                        reader = None
+        if reader is None:
+            # the data plane must get its shot even on the recovery retry:
+            # a lineage rebuild can land the fresh copy on a REMOTE host
+            ok, value = self._fetch_via_data_plane(obj_id, payload)
+            if ok:
+                return value
+            if not _retry:
+                raise FileNotFoundError(f"object {obj_id.hex()} unavailable")
         if reader is None:
             # tell the head the backing is gone so it can restore from spill
             # or rebuild via lineage (reference: object recovery manager),
@@ -321,6 +420,7 @@ class DriverContext(BaseContext):
         super().__init__()
         self.head = head
         self.node_id_bin = node_id_bin
+        self.authkey = head.authkey
 
     def call(self, method: str, **payload):
         if method == "subscribe":
@@ -349,11 +449,21 @@ class WorkerContext(BaseContext):
     are unreachable), and the head converts in both directions.
     """
 
-    def __init__(self, conn, node_id_bin: bytes, remote: bool = False):
+    def __init__(
+        self,
+        conn,
+        node_id_bin: bytes,
+        remote: bool = False,
+        authkey: Optional[bytes] = None,
+        head_host: Optional[str] = None,
+    ):
         super().__init__()
         self.conn = conn
         self.node_id_bin = node_id_bin
         self.remote = remote
+        self.authkey = authkey
+        if head_host:
+            self.head_host = head_host
         self._seq = itertools.count(1)
         self._send_lock = threading.Lock()
         self._pending: dict[int, list] = {}
@@ -398,15 +508,11 @@ class WorkerContext(BaseContext):
 
     def put_serialized(self, sv, is_error=False) -> bytes:
         obj_id = ObjectID.for_put().binary()
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size or self.remote:
-            # remote: shm written here would be invisible to the head's host;
-            # ship bytes — the head re-lays oversized payloads into ITS shm
-            self.call("put", obj_id=obj_id, small=sv.to_bytes(), shm=None, is_error=is_error)
+        kind, payload, err = self.store_value(sv, is_error)
+        if kind == "inline":
+            self.call("put", obj_id=obj_id, small=payload, shm=None, is_error=err)
         else:
-            from ray_tpu._private.shm_store import write_shm
-
-            loc = write_shm(sv)
-            self.call("put", obj_id=obj_id, small=None, shm=loc, is_error=is_error)
+            self.call("put", obj_id=obj_id, small=None, shm=payload, is_error=err)
         return obj_id
 
 
@@ -416,8 +522,14 @@ class RemoteDriverContext(WorkerContext):
     Same RPC surface as a worker, plus its own response pump (workers get
     theirs from worker_main's recv loop)."""
 
-    def __init__(self, conn, node_id_bin: bytes):
-        super().__init__(conn, node_id_bin, remote=True)
+    def __init__(
+        self,
+        conn,
+        node_id_bin: bytes,
+        authkey: Optional[bytes] = None,
+        head_host: Optional[str] = None,
+    ):
+        super().__init__(conn, node_id_bin, remote=True, authkey=authkey, head_host=head_host)
         self._pump = threading.Thread(
             target=self._pump_loop, name="driver-pump", daemon=True
         )

@@ -50,6 +50,12 @@ class ShmLocation:
     #: allocation for pin/free. None = dedicated POSIX segment (legacy path).
     offset: Optional[int] = None
     gen: int = 0
+    #: Binary NodeID of the node whose host holds the bytes (object
+    #: directory role — reference: object_manager's object location). The
+    #: head routes frees to the owning host and consumers on other hosts
+    #: pull via the data plane (``data_plane.py``). None = pre-directory
+    #: writer (treated as head-host).
+    node: Optional[bytes] = None
 
 
 # ---------------------------------------------------------------------------
@@ -150,6 +156,20 @@ def write_shm(sv: SerializedValue) -> ShmLocation:
     return _write_segment(sv)
 
 
+def layout_views(mv, header_len: int, buffer_lens: list[int]):
+    """Split a laid-out object ([header][buf0][buf1...], 64-byte aligned —
+    the inverse of ``_layout``) into (header view, [PickleBuffer views]).
+    THE one place the layout walk lives; shm readers, the data plane, and
+    the head's inline fallback all deserialize through it."""
+    header = mv[:header_len]
+    bufs = []
+    off = _align(header_len)
+    for n in buffer_lens:
+        bufs.append(pickle.PickleBuffer(mv[off : off + n]))
+        off = _align(off + n)
+    return header, bufs
+
+
 def _layout(sv: SerializedValue) -> tuple[list[int], int]:
     """Aligned buffer offsets + total size for [header][buf0][buf1...]."""
     hlen = len(sv.header)
@@ -184,7 +204,16 @@ def _write_arena(arena, sv: SerializedValue) -> Optional[ShmLocation]:
 
 def _write_segment(sv: SerializedValue) -> ShmLocation:
     offs, total = _layout(sv)
-    shm = shared_memory.SharedMemory(create=True, size=total)
+    # On agent hosts, segments carry a per-agent prefix so the agent can
+    # sweep orphans at shutdown (segment names are otherwise random and
+    # unattributable; the head only frees objects it was told about).
+    prefix = os.environ.get("RAY_TPU_SEG_PREFIX")
+    if prefix:
+        shm = shared_memory.SharedMemory(
+            name=f"{prefix}{uuid.uuid4().hex[:12]}", create=True, size=total
+        )
+    else:
+        shm = shared_memory.SharedMemory(create=True, size=total)
     _untrack(shm)
     try:
         lens = _copy_into(shm.buf, sv, offs)
@@ -202,36 +231,56 @@ def _quiet_close(shm: shared_memory.SharedMemory) -> None:
         shm._mmap = None
 
 
+class _PinnedBlock:
+    """Zero-copy buffer exporter over a pinned arena block (PEP 688).
+
+    Every view a deserialized value holds (numpy bases, PickleBuffers)
+    keeps this exporter — and therefore the arena pin — alive; the pin
+    drops when the last view dies, letting the allocator recycle the block.
+    This is plasma's client-side release semantics
+    (``plasma/client.cc`` Release on buffer destruction) done with the
+    buffer protocol instead of client bookkeeping: a free racing a live
+    reader defers to the last unpin (arena.cc zombie protocol), so reads
+    are safe without copying out.
+    """
+
+    def __init__(self, arena, offset: int, size: int):
+        self._arena = arena  # also keeps the mapping alive until released
+        self._offset = offset
+        self._mv = arena.view(offset, size)
+
+    def __buffer__(self, flags):
+        return self._mv
+
+    def __del__(self):
+        try:
+            self._arena.unpin(self._offset)
+        except Exception:  # noqa: BLE001 - interpreter-exit teardown
+            pass
+
+
 class ShmReader:
     """Read a stored object back.
 
     Dedicated segments expose zero-copy out-of-band buffers: the mapping must
     outlive any views handed to the deserialized value, so we keep the
     SharedMemory open and let a weak registry close it when the value is
-    garbage collected. Arena objects instead copy out under a pin (see
-    ``arena.cc``): the pin makes free-vs-read safe, and copying means the
-    block can be recycled the moment the pin drops — plasma's eviction
-    semantics without plasma's client bookkeeping. A vanished object (freed,
-    spilled, or arena gone) raises FileNotFoundError, which callers treat as
-    "re-fetch from the head" (see runtime._materialize).
+    garbage collected. Arena objects are zero-copy too: views go through a
+    ``_PinnedBlock`` exporter whose arena pin lives exactly as long as the
+    views do. A vanished object (freed, spilled, or arena gone) raises
+    FileNotFoundError, which callers treat as "re-fetch from the head"
+    (see runtime._materialize).
     """
 
     def __init__(self, loc: ShmLocation):
         self.loc = loc
         self.shm = None
-        self._arena = None
+        self._block = None
         if loc.offset is not None:
             arena = attach_arena(loc.name)
             if arena is None or not arena.pin(loc.offset, loc.gen):
                 raise FileNotFoundError(f"arena object gone: {loc.name}+{loc.offset}")
-            self._arena = arena
-            # Copy out immediately and drop the pin: the window where a
-            # concurrent free could recycle the block is exactly this copy,
-            # and the pin covers it.
-            try:
-                self._data = bytes(arena.view(loc.offset, loc.total_size))
-            finally:
-                arena.unpin(loc.offset)
+            self._block = _PinnedBlock(arena, loc.offset, loc.total_size)
             return
         self.shm = shared_memory.SharedMemory(name=loc.name)
         _untrack(self.shm)
@@ -246,19 +295,12 @@ class ShmReader:
         weakref.finalize(self, _quiet_close, self.shm)
 
     def _mv(self):
-        return memoryview(self._data) if self.shm is None else self.shm.buf
+        return memoryview(self._block) if self.shm is None else self.shm.buf
 
     def read(self):
         loc = self.loc
-        mv = self._mv()
-        header = mv[: loc.header_len]
-        bufs = []
-        off = _align(loc.header_len)
-        for n in loc.buffer_lens:
-            bufs.append(pickle.PickleBuffer(mv[off : off + n]))
-            off = _align(off + n)
-        value = pickle.loads(header, buffers=bufs)
-        return value
+        header, bufs = layout_views(self._mv(), loc.header_len, loc.buffer_lens)
+        return pickle.loads(header, buffers=bufs)
 
     def read_serialized_bytes(self) -> bytes:
         """Copy back into wire format (for shipping an object to a REMOTE
@@ -266,18 +308,15 @@ class ShmReader:
         from ray_tpu._private.serialization import SerializedValue
 
         loc = self.loc
-        mv = self._mv()
-        header = bytes(mv[: loc.header_len])
-        bufs = []
-        off = _align(loc.header_len)
-        for n in loc.buffer_lens:
-            bufs.append(pickle.PickleBuffer(bytes(mv[off : off + n])))
-            off = _align(off + n)
-        return SerializedValue(header, bufs).to_bytes()
+        header, bufs = layout_views(self._mv(), loc.header_len, loc.buffer_lens)
+        return SerializedValue(bytes(header), bufs).to_bytes()
 
     def close(self):
         if self.shm is None:
-            return  # arena reads hold no resources past __init__
+            # drop our reference; the pin releases when the last value view
+            # over the block dies (PEP 688 exporter lifetime)
+            self._block = None
+            return
         try:
             self.shm.close()
         except BufferError:
@@ -286,6 +325,24 @@ class ShmReader:
             # SharedMemory.__del__ so it doesn't retry and print at exit.
             self.shm._buf = None
             self.shm._mmap = None
+
+
+def free_location(loc: ShmLocation) -> None:
+    """Free a stored object's backing on THIS host: arena blocks go back to
+    the allocator (deferred to last unpin if readers are active), dedicated
+    segments are unlinked. Used by node agents when the head routes a free
+    of an agent-host object (``head._release_loc``)."""
+    if loc.offset is not None:
+        arena = attach_arena(loc.name)
+        if arena is not None:
+            arena.free(loc.offset, loc.gen)
+        return
+    try:
+        shm = shared_memory.SharedMemory(name=loc.name)
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
 
 
 class ShmOwner:

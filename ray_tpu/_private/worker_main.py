@@ -135,7 +135,10 @@ def main(
     except FileNotFoundError:
         # cluster shut down while this worker was spawning — exit quietly
         os._exit(0)
-    ctx = WorkerContext(conn, node_id_bin, remote=remote)
+    head_host = socket_path.rsplit(":", 1)[0] if remote and ":" in socket_path else None
+    ctx = WorkerContext(
+        conn, node_id_bin, remote=remote, authkey=authkey, head_host=head_host
+    )
     set_ctx(ctx)
     state = WorkerState(ctx)
     ctx.send_raw(
@@ -285,14 +288,10 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
         except Exception as e:  # unserializable return
             sv = ser.serialize(rex.RayTaskError.from_exception(spec.get("name", "task"), e))
             is_error = True
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size or state.ctx.remote:
-            # remote workers always inline: their shm lives on another host;
-            # the head re-lays oversized inlines into ITS shm on receipt
-            results.append((rid, ("inline", sv.to_bytes(), is_error)))
-        else:
-            from ray_tpu._private.shm_store import write_shm
-
-            results.append((rid, ("shm", write_shm(sv), is_error)))
+        # large results land in THIS host's shm and only the locator travels
+        # (agent hosts serve the bytes peer-to-peer; see data_plane.py) —
+        # remote processes without a local store fall back to inline
+        results.append((rid, state.ctx.store_value(sv, is_error)))
     return results
 
 

@@ -1,0 +1,139 @@
+"""Peer-to-peer object plane: bytes move host-to-host through per-node data
+servers; the head is directory only.
+
+Reference: ``src/ray/object_manager/object_manager.h:117`` (node-to-node
+chunked transfer), ``pull_manager.cc:48`` / ``push_manager.h:30``. The
+"hosts" here are separate agent processes on loopback — same wire path as
+real hosts. RAY_TPU_FORCE_DATA_PLANE=1 makes consumers skip the same-machine
+shm shortcut so the test exercises the actual network path.
+"""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import shm_store
+from ray_tpu._private.config import resolve_authkey
+from ray_tpu._private.head import Head
+from ray_tpu._private.node_agent import NodeAgent
+
+
+@pytest.fixture
+def p2p_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FORCE_DATA_PLANE", "1")
+    authkey = resolve_authkey()
+    session = tempfile.mkdtemp(prefix="ray_tpu_p2p_")
+    head = Head(os.path.join(session, "head.sock"), authkey=authkey)
+    head.start()
+    host, port = head.listen_tcp("127.0.0.1", 0)
+    head.add_node({"CPU": 0.0})
+    addr = f"{host}:{port}"
+    a = NodeAgent(addr, authkey, resources={"CPU": 2.0, "nodeA": 10.0}).start()
+    b = NodeAgent(addr, authkey, resources={"CPU": 2.0, "nodeB": 10.0}).start()
+    yield {"head": head, "a": a, "b": b, "address": addr}
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    a.shutdown()
+    b.shutdown()
+    head.shutdown()
+
+
+SIZE = 8 * 1024 * 1024  # 8 MB payload -> multiple data-plane chunks at 8M? no: 1 chunk; still >> inline
+
+
+def test_p2p_fetch_bypasses_head(p2p_cluster):
+    ray_tpu.init(address=p2p_cluster["address"])
+    head = p2p_cluster["head"]
+
+    @ray_tpu.remote(resources={"nodeA": 1.0})
+    def produce():
+        return np.arange(SIZE // 8, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"nodeB": 1.0})
+    def consume(arr):
+        return int(arr[::4096].sum())
+
+    ref = produce.remote()
+    expect = int(np.arange(SIZE // 8, dtype=np.int64)[::4096].sum())
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == expect
+
+    # the bytes moved A -> B directly: A's data server served them, and the
+    # head shipped ZERO object bytes inline (directory role only)
+    assert head.inline_bytes_served == 0
+    assert p2p_cluster["a"].data_server.bytes_served >= SIZE
+
+
+def test_result_bytes_live_on_producing_host(p2p_cluster):
+    ray_tpu.init(address=p2p_cluster["address"])
+
+    @ray_tpu.remote(resources={"nodeA": 1.0})
+    def produce():
+        return np.ones(SIZE // 8, dtype=np.int64)
+
+    ref = produce.remote()
+    # wait for completion via a driver get: the driver (same machine in this
+    # test) still resolves through the locator; the locator must point at A
+    out = ray_tpu.get(ref, timeout=60)
+    assert out.shape == (SIZE // 8,)
+    with p2p_cluster["head"].lock:
+        ents = [
+            e
+            for e in p2p_cluster["head"].objects.values()
+            if e.shm is not None and e.shm.node == p2p_cluster["a"].node_id_bin
+        ]
+    assert ents, "producer's result locator should carry the producing node"
+
+
+def test_free_routes_to_owning_host(p2p_cluster):
+    ray_tpu.init(address=p2p_cluster["address"])
+    agent = p2p_cluster["a"]
+    arena = shm_store.attach_arena(agent.arena_name)
+    base = arena.n_objects
+
+    @ray_tpu.remote(resources={"nodeA": 1.0})
+    def produce():
+        return np.zeros(SIZE // 8, dtype=np.int64)
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=60)
+    assert arena.n_objects == base + 1  # result landed in A's arena
+    del ref
+    import gc
+
+    gc.collect()
+    deadline = time.monotonic() + 20
+    while arena.n_objects != base and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert arena.n_objects == base  # head routed the free to A's agent
+
+
+def test_owner_node_death_recovers_via_lineage(p2p_cluster):
+    ray_tpu.init(address=p2p_cluster["address"])
+    head = p2p_cluster["head"]
+
+    @ray_tpu.remote(resources={"nodeA": 1.0}, max_retries=2)
+    def produce():
+        return np.full(SIZE // 8, 7, dtype=np.int64)
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=60)
+    # A dies; its bytes are gone. The head must rebuild via lineage — but
+    # the task is pinned to nodeA resources, so re-add capacity via B? No:
+    # kill A's node, then the resubmitted task becomes infeasible until A's
+    # agent re-registers. Use a second agent with nodeA resources instead.
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.node_agent import NodeAgent as NA
+
+    a2 = NA(p2p_cluster["address"], resolve_authkey(), resources={"CPU": 2.0, "nodeA": 10.0}).start()
+    try:
+        head.remove_node(NodeID(p2p_cluster["a"].node_id_bin))
+        out = ray_tpu.get(ref, timeout=60)
+        assert (out[::4096] == 7).all()
+    finally:
+        a2.shutdown()
