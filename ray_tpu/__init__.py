@@ -29,7 +29,7 @@ from ray_tpu._private.api import (  # noqa: F401
     timeline,
     wait,
 )
-from ray_tpu._private.runtime import ObjectRef  # noqa: F401
+from ray_tpu._private.runtime import ObjectRef, ObjectRefGenerator  # noqa: F401
 from ray_tpu.actor import get_actor, method  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 

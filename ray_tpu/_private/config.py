@@ -101,6 +101,12 @@ class Config:
     #: (pool workers).
     worker_spawn_retries: int = 3
 
+    #: Streaming-generator backpressure window: a producer pauses once this
+    #: many yielded items are unconsumed (reference:
+    #: ``_generator_backpressure_num_objects``). Consumer progress is pushed
+    #: back to the worker as stream_ack messages.
+    streaming_backpressure_items: int = 16
+
     # -- actors ------------------------------------------------------------
     default_max_restarts: int = 0
     default_max_task_retries: int = 0

@@ -33,6 +33,7 @@ name prefix at shutdown).
 from __future__ import annotations
 
 import threading
+import time
 from multiprocessing.connection import Client, Listener
 from typing import Optional
 
@@ -56,9 +57,11 @@ class DataServer:
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError, Exception):  # noqa: BLE001 - auth failures too
+            except Exception:  # noqa: BLE001 - auth failures, fd exhaustion
                 if self._shutdown:
                     return
+                # don't hot-spin on a persistent accept error (e.g. EMFILE)
+                time.sleep(0.05)
                 continue
             threading.Thread(
                 target=self._serve, args=(conn,), name="data-serve", daemon=True

@@ -405,6 +405,16 @@ class Head:
         self._revoked_tokens: dict[str, bool] = {}
         self.task_events: list[dict] = []  # observability feed (state API)
         self._infeasible_warned: dict[bytes, float] = {}
+        # streaming-generator returns: task_id -> {"items": {index: obj_id},
+        # "count": Optional[int] (set at completion), "next": next index a
+        # consumer will ask for} (reference: task_manager.cc streaming
+        # generator bookkeeping, _raylet.pyx:1230)
+        self.streams: dict[bytes, dict] = {}
+        # disposed stream ids (bounded): late stream_items/task_done from a
+        # producer that had not yet seen the cancel must NOT resurrect the
+        # stream entry (it would leak the items forever — nobody consumes a
+        # disposed stream); their objects are freed on arrival instead
+        self._disposed_streams: dict[bytes, bool] = {}
 
     # ---------------------------------------------------------------- wiring
 
@@ -492,6 +502,8 @@ class Head:
                     self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
                 elif kind == "task_done":
                     self._on_task_done(worker, msg[1])
+                elif kind == "stream_item":
+                    self._on_stream_item(worker, msg[1])
                 elif kind == "actor_ready":
                     self._on_actor_ready(worker, msg[1])
         finally:
@@ -534,7 +546,7 @@ class Head:
             handler = getattr(self, "rpc_" + method)
         if remote and method == "get":
             handler = self._rpc_get_remote
-        blocking = method in ("get", "wait", "pg_ready", "get_actor_named")
+        blocking = method in ("get", "wait", "pg_ready", "get_actor_named", "stream_next")
         if blocking:
             # blocking RPCs park until objects/actors materialize; run them
             # on a cached high-cap pool so the hot path reuses threads
@@ -1032,6 +1044,114 @@ class Head:
 
     # ------------------------------------------------------------ completion
 
+    # ------------------------------------------------- streaming generators
+
+    def _on_stream_item(self, wh: WorkerHandle, payload: dict):
+        """A streaming task yielded one item: store its object and publish
+        the index so blocked ``stream_next`` calls wake (reference:
+        ReportGeneratorItemReturns, task_manager.cc)."""
+        task_id = payload["task_id"]
+        locator = self._normalize_locator(payload["locator"])
+        with self.lock:
+            self._store_locator(payload["obj_id"], locator)
+            ent = self.objects.get(payload["obj_id"])
+            if task_id in self._disposed_streams:
+                # consumer walked away; the producer raced the cancel —
+                # free the stored bytes immediately instead of leaking them
+                if ent is not None:
+                    self._maybe_evict(payload["obj_id"], ent)
+                return
+            st = self.streams.setdefault(
+                task_id, {"items": {}, "count": None, "next": 0}
+            )
+            if ent is not None:
+                ent.refcount += 1  # held by the stream until handed out/disposed
+            st["items"][payload["index"]] = payload["obj_id"]
+            self.cv.notify_all()
+
+    def rpc_stream_next(self, task_id, index, timeout=None):
+        """Blocking: ('item', obj_id) when the index exists; ('end', count)
+        past the final item; ('error', completion_obj_id) when the task
+        failed (the completion object holds the exception). Acks the
+        consumed index to the producing worker for backpressure."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.lock:
+            while True:
+                if task_id in self._disposed_streams:
+                    return ("end", 0)
+                st = self.streams.get(task_id)
+                if st is not None:
+                    if index in st["items"]:
+                        oid = st["items"][index]
+                        st["next"] = max(st["next"], index + 1)
+                        rec = self.tasks.get(task_id)
+                        wh = rec.get("worker") if rec is not None else None
+                        break
+                    if st["count"] is not None and index >= st["count"]:
+                        comp = st.get("completion")
+                        if comp is not None:
+                            ent = self.objects.get(comp)
+                            if ent is not None and ent.is_error:
+                                return ("error", comp)
+                        return ("end", st["count"])
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise rex.GetTimeoutError(f"stream_next timed out on {TaskID(task_id)}")
+                if self._shutdown:
+                    raise rex.RayError("shutting down")
+                self.cv.wait(timeout=min(remaining, 1.0) if remaining else 1.0)
+        if wh is not None and wh.alive:
+            wh.send(("stream_ack", {"task_id": task_id, "consumed": index + 1}))
+        return ("item", oid)
+
+    def rpc_stream_dispose(self, task_id):
+        """Consumer dropped its generator: cancel the producer if it is
+        still running and release items never handed out (reference:
+        streaming generator cancellation + unconsumed-return GC)."""
+        with self.lock:
+            st = self.streams.pop(task_id, None)
+            self._disposed_streams[task_id] = True
+            while len(self._disposed_streams) > 4096:
+                self._disposed_streams.pop(next(iter(self._disposed_streams)))
+            running = task_id in self.tasks
+            if st is not None:
+                for idx, oid in st["items"].items():
+                    if idx >= st["next"]:
+                        ent = self.objects.get(oid)
+                        if ent is not None:
+                            ent.refcount -= 1
+                            self._maybe_evict(oid, ent)
+        if running:
+            self.cancel_task(task_id, force=False)
+        return True
+
+    def _fail_stream_locked(self, spec: dict) -> None:
+        """Lock held. A streaming task's producer died: cap the stream at
+        what was produced and point completion at the stored error, so
+        consumers drain then raise instead of blocking forever."""
+        if spec.get("num_returns") != "streaming":
+            return
+        if spec["task_id"] in self._disposed_streams:
+            return
+        st = self.streams.setdefault(
+            spec["task_id"], {"items": {}, "count": None, "next": 0}
+        )
+        if st["count"] is None:
+            st["count"] = len(st["items"])
+            st["completion"] = spec["return_ids"][0]
+
+    def _finish_stream_locked(self, task_id: bytes, payload: dict):
+        """task_done of a streaming task: record the final item count and
+        where the completion object (error carrier) lives."""
+        if task_id in self._disposed_streams:
+            return
+        st = self.streams.setdefault(task_id, {"items": {}, "count": None, "next": 0})
+        st["count"] = payload.get("stream_count", len(st["items"]))
+        results = payload.get("results") or []
+        if results:
+            st["completion"] = results[0][0]
+        self.cv.notify_all()
+
     def _on_task_done(self, wh: WorkerHandle, payload: dict):
         task_id = payload["task_id"]
         if payload.get("results"):
@@ -1040,6 +1160,8 @@ class Head:
                 (rid, self._normalize_locator(loc)) for rid, loc in payload["results"]
             ]
         with self.lock:
+            if "stream_count" in payload:
+                self._finish_stream_locked(task_id, payload)
             rec = self.tasks.pop(task_id, None)
             if rec is None:
                 if wh is not None:
@@ -1062,6 +1184,10 @@ class Head:
                         self._lineage_track(obj_id, rec["spec"])
             self._event(rec, "FINISHED" if not payload.get("results_error") else "FAILED")
             spec = rec["spec"]
+            if spec.get("num_returns") == "streaming" and "stream_count" not in payload:
+                # the task function itself failed before yielding anything:
+                # close the stream so consumers surface the error
+                self._finish_stream_locked(task_id, payload)
             if spec["kind"] == "actor_method":
                 actor = self.actors.get(spec["actor_id"])
                 if actor is not None:
@@ -1398,6 +1524,7 @@ class Head:
             self._unpin_deps(spec)
             for rid in spec["return_ids"]:
                 self._store_error(rid, error)
+            self._fail_stream_locked(spec)
             self.cv.notify_all()
 
     # ---------------------------------------------------------------- actors
@@ -1517,6 +1644,10 @@ class Head:
             for s in inflight:
                 rec = self.tasks.get(s["task_id"])
                 left = rec["retries_left"] if rec is not None else 0
+                if s.get("num_returns") == "streaming":
+                    # never replay a stream: the consumer may have consumed
+                    # items of the dead run already (same rule as tasks)
+                    left = 0
                 if left != 0:
                     if rec is not None and left > 0:
                         rec["retries_left"] -= 1
@@ -1526,6 +1657,7 @@ class Head:
                     self._unpin_deps(s)
                     for rid in s["return_ids"]:
                         self._store_error(rid, rex.RayActorError(msg="actor died; restarting"))
+                    self._fail_stream_locked(s)
             for s in reversed(retry):
                 actor.pending_calls.appendleft(s)
             # If the worker died mid-creation, reap the in-flight create task:
@@ -1557,6 +1689,7 @@ class Head:
             self._unpin_deps(s)
             for rid in s["return_ids"]:
                 self._store_error(rid, err)
+            self._fail_stream_locked(s)
         actor.inflight.clear()
         actor.pending_calls.clear()
         self._actor_create_recs.pop(actor.actor_id, None)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import os
 import threading
 import time
 from typing import Any, Optional
@@ -133,10 +134,76 @@ def _deserialized_ref(id_bytes: bytes, nonce: bytes = None) -> ObjectRef:
 # --------------------------------------------------------------------------
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's per-item ObjectRefs
+    (``num_returns="streaming"``; reference: ``ObjectRefGenerator`` in
+    _raylet.pyx:1230 + streaming bookkeeping in task_manager.cc).
+
+    Each ``next()`` blocks until the producer has yielded that item, then
+    returns an owned ObjectRef resolving to the yielded value — items arrive
+    while the task is still running, with a consumer-acked backpressure
+    window on the producer. A mid-stream producer exception is raised from
+    ``next()`` once the already-produced items are drained. Dropping the
+    generator cancels a still-running producer and frees unconsumed items.
+    """
+
+    def __init__(self, task_id: bytes, completion_ref: "ObjectRef", ctx):
+        self._task_id = task_id
+        self._completion_ref = completion_ref  # holds the error carrier alive
+        self._ctx = ctx
+        self._i = 0
+        self._done = False
+        self._disposed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self._next(timeout=None)
+
+    def _next(self, timeout: Optional[float]) -> "ObjectRef":
+        if self._done or self._disposed:
+            raise StopIteration
+        kind, payload = self._ctx.call(
+            "stream_next", task_id=self._task_id, index=self._i, timeout=timeout
+        )
+        if kind == "end":
+            self._done = True
+            raise StopIteration
+        if kind == "error":
+            self._done = True
+            # the completion object carries the producer's exception;
+            # resolving it raises with proper cause chaining
+            self._ctx.get([ObjectRef(payload)], timeout=30)
+            raise rex.RayError("stream failed but completion held no error")
+        self._i += 1
+        return ObjectRef(payload, owned=True)
+
+    def close(self) -> None:
+        if not self._disposed:
+            self._disposed = True
+            try:
+                self._ctx.call("stream_dispose", task_id=self._task_id)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:8]}, next={self._i})"
+
+
 class BaseContext:
     def __init__(self):
         self.closed = False
         self.remote = False  # True = different host than the head (no shm)
+        # test hook, read once per context (not per get): skip the same-host
+        # shm shortcut so same-machine tests exercise the real network path
+        self._force_dp = os.environ.get("RAY_TPU_FORCE_DATA_PLANE") == "1"
         self.authkey: Optional[bytes] = None  # data-plane auth (set by subclasses)
         self.head_host: str = "127.0.0.1"  # host we reach the control plane on
         self._data_addrs: dict = {}  # node bin -> (host, port) cache
@@ -295,10 +362,8 @@ class BaseContext:
         kind, payload, is_err = locator
         if kind == "inline":
             return ser.deserialize_value(ser.SerializedValue.from_bytes(payload))
-        import os as _os
-
         force_dp = (
-            _os.environ.get("RAY_TPU_FORCE_DATA_PLANE") == "1"
+            self._force_dp
             and payload.node is not None
             and payload.node != self.node_id_bin
         )

@@ -70,10 +70,23 @@ _write_arena_name: Optional[str] = None
 
 def create_arena(size: int) -> Optional[str]:
     """Head-side: create this host's arena. Returns its name (for worker env
-    + later unlink) or None when the native library is unavailable."""
+    + later unlink) or None when the native library is unavailable.
+
+    The requested size is clamped to 80% of the shm filesystem's FREE space:
+    the segment is sparse, so ftruncate would happily "succeed" past the
+    tmpfs limit and the first write into an uncommittable page then SIGBUSes
+    the writer (common in containers with a small --shm-size). Clamping
+    keeps the 90%-of-capacity degrade watermark (runtime.store_value)
+    meaningful."""
     global _write_arena_name
     from ray_tpu import _native
 
+    try:
+        st = os.statvfs("/dev/shm")
+        free = st.f_bavail * st.f_frsize
+        size = max(min(size, int(free * 0.8)), 1024 * 1024)
+    except OSError:
+        pass
     name = f"/rta-{os.getpid():x}-{uuid.uuid4().hex[:8]}"
     arena = _native.Arena.create(name, size)
     if arena is None:

@@ -53,6 +53,10 @@ class WorkerState:
         # pool thread for max_concurrency>1 actors) — cancel targets THAT
         # thread, never the dispatch loop.
         self.task_threads: dict[bytes, int] = {}
+        # streaming-generator backpressure: task_id -> highest consumer-acked
+        # index+1, fed by the head's stream_ack pushes (_recv_loop)
+        self.stream_acked: dict[bytes, int] = {}
+        self.stream_cv = threading.Condition()
 
 
 def connect_head(address: str, authkey: bytes, retries: int = 3):
@@ -168,6 +172,13 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
             state.task_queue.put(msg[1])
         elif kind == "cancel":
             _handle_cancel(state, msg[1])
+        elif kind == "stream_ack":
+            with state.stream_cv:
+                tid = msg[1]["task_id"]
+                state.stream_acked[tid] = max(
+                    state.stream_acked.get(tid, 0), msg[1]["consumed"]
+                )
+                state.stream_cv.notify_all()
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
@@ -295,6 +306,98 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
     return results
 
 
+def _stream_results(state: WorkerState, spec: dict, gen) -> None:
+    """Drive a streaming-generator task (num_returns="streaming"): each
+    yielded item becomes its own object, reported to the head as it is
+    produced (reference: ReportGeneratorItemReturns, _raylet.pyx:1230),
+    with a consumer-acked backpressure window
+    (``streaming_backpressure_items``). The task's single declared return
+    becomes the completion object: None on success, the exception on a
+    mid-stream failure."""
+    from ray_tpu._private.ids import ObjectID, TaskID
+
+    task_id = spec["task_id"]
+    cap = max(1, GLOBAL_CONFIG.streaming_backpressure_items)
+    idx = 0
+    err = None
+    try:
+        it = iter(gen)
+    except TypeError:
+        err = rex.RayTaskError.from_exception(
+            spec.get("name", "task"),
+            TypeError(
+                f'num_returns="streaming" requires the task to return an '
+                f"iterable/generator, got {type(gen).__name__}"
+            ),
+        )
+        it = iter(())
+    while err is None:
+        if task_id in state.cancel_requested:
+            err = rex.TaskCancelledError()
+            break
+        try:
+            item = next(it)
+        except StopIteration:
+            break
+        except BaseException as e:  # noqa: BLE001 - ships to consumer
+            err = e if isinstance(e, rex.RayTaskError) else rex.RayTaskError.from_exception(
+                spec.get("name", "task"), e
+            )
+            break
+        try:
+            sv = ser.serialize(item)
+        except Exception as e:  # unserializable item
+            err = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
+            break
+        locator = state.ctx.store_value(sv)
+        with state.stream_cv:
+            while (
+                idx - state.stream_acked.get(task_id, 0) >= cap
+                and task_id not in state.cancel_requested
+            ):
+                state.stream_cv.wait(timeout=0.5)
+        if task_id in state.cancel_requested:
+            err = rex.TaskCancelledError()
+            break
+        oid = ObjectID.for_task_return(TaskID(task_id), 1 + idx).binary()
+        state.ctx.send_raw(
+            ("stream_item", {"task_id": task_id, "index": idx, "obj_id": oid, "locator": locator})
+        )
+        idx += 1
+    with state.stream_cv:
+        state.stream_acked.pop(task_id, None)
+    is_error = err is not None
+    try:
+        results = _store_results(state, spec, err if is_error else None, is_error)
+    except BaseException:  # noqa: BLE001
+        traceback.print_exc()
+        results = []
+    state.ctx.send_raw(
+        (
+            "task_done",
+            {
+                "task_id": task_id,
+                "results": results,
+                "results_error": is_error,
+                "stream_count": idx,
+            },
+        )
+    )
+
+
+def _sync_over_asyncgen(agen, loop):
+    """Bridge an async generator to a plain iterator: every ``__anext__``
+    is marshalled onto the actor's event loop thread (state invariant),
+    while the consuming ``_stream_results`` loop runs on a pool thread."""
+    import asyncio
+
+    while True:
+        try:
+            yield asyncio.run_coroutine_threadsafe(agen.__anext__(), loop).result()
+        except StopAsyncIteration:
+            return
+
+
 def _run_task(state: WorkerState, spec: dict):
     from ray_tpu._private import runtime_env as renv
 
@@ -326,6 +429,10 @@ def _run_task(state: WorkerState, spec: dict):
         state.current_task_id = None
         state.task_threads.pop(task_id, None)
         state.cancel_requested.discard(task_id)
+    if spec.get("num_returns") == "streaming" and not is_error:
+        # the function returned a generator: drive it item by item
+        _stream_results(state, spec, value)
+        return
     try:
         results = _store_results(state, spec, value, is_error)
     except BaseException:  # noqa: BLE001
@@ -426,6 +533,7 @@ def _setup_actor_concurrency(state: WorkerState, spec: dict) -> None:
     cls = type(state.actor_instance)
     is_async = any(
         inspect.iscoroutinefunction(getattr(cls, n, None))
+        or inspect.isasyncgenfunction(getattr(cls, n, None))
         for n in dir(cls)
         if not n.startswith("__")
     )
@@ -557,6 +665,14 @@ async def _arun(state: WorkerState, spec: dict):
     finally:
         state.async_tasks.pop(task_id, None)
         state.cancel_requested.discard(task_id)
+    if spec.get("num_returns") == "streaming" and not is_error:
+        # drive the generator off the loop thread; async generators are
+        # bridged so each __anext__ still runs ON the loop (single-thread
+        # actor-state invariant)
+        if inspect.isasyncgen(value):
+            value = _sync_over_asyncgen(value, loop)
+        state.async_done_pool.submit(_stream_results, state, spec, value)
+        return
     # fire-and-forget onto the dedicated completion pool: must not be
     # cancellable, must not serialize on the loop thread, and must not queue
     # behind blocked arg fetches (see _setup_actor_concurrency)

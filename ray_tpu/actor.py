@@ -95,8 +95,11 @@ class ActorHandle:
 
     def _submit_method(self, name, args, kwargs, num_returns, concurrency_group=None):
         ctx = get_ctx()
+        streaming = num_returns == "streaming"
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
-        task_id, return_ids = ctx.new_task_returns(max(num_returns, 1))
+        task_id, return_ids = ctx.new_task_returns(
+            1 if streaming else max(num_returns, 1)
+        )
         spec = {
             "task_id": task_id,
             "kind": "actor_method",
@@ -111,6 +114,10 @@ class ActorHandle:
         if concurrency_group:
             spec["concurrency_group"] = concurrency_group
         refs = ctx.submit_actor_task(spec)
+        if streaming:
+            from ray_tpu._private.runtime import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id, refs[0], ctx)
         return refs[0] if num_returns == 1 else refs
 
     def __repr__(self):

@@ -88,6 +88,29 @@ def _run_read_task(read_task, target_bytes: int, target_rows: int):
     return _finish(list(read_task()), target_bytes, target_rows)
 
 
+def _stream_read_task(read_task, target_bytes: int, target_rows: int):
+    """Streaming read body (num_returns="streaming"): yield one
+    (blocks_ref, metas) bundle per ~target_bytes of input as the datasource
+    produces blocks, so downstream map stages start while the read is still
+    running (reference: read tasks as streaming generators feeding the
+    executor's block queue). Blocks are put from the worker; only the small
+    (ref, metas) tuple rides the stream."""
+    import ray_tpu
+
+    yielded = False
+    # one bundle per datasource-yielded block (split to target size if the
+    # block is huge) — the yield boundary IS the streaming unit, like the
+    # reference's dynamic block splitting; downstream consolidation happens
+    # in the map stages' own _finish/_rechunk
+    for block in read_task():
+        blocks, metas = _finish([block], target_bytes, target_rows)
+        yield (ray_tpu.put(blocks), metas)
+        yielded = True
+    if not yielded:  # empty source still emits one (empty) bundle
+        blocks, metas = _finish([], target_bytes, target_rows)
+        yield (ray_tpu.put(blocks), metas)
+
+
 def _run_map_task(transform, blocks: list[Block], target_bytes: int, target_rows: int):
     return _finish(list(transform(iter(blocks))), target_bytes, target_rows)
 
@@ -263,6 +286,10 @@ class PhysicalOp:
         if self.inputs_done and not self.input_queue and not self.pending:
             self.finished = True
 
+    def poll(self, ctx: DataContext) -> None:
+        """Called every loop step: ops with out-of-band progress (streaming
+        reads) move it into output_queue here."""
+
     def shutdown(self):
         pass
 
@@ -286,25 +313,85 @@ class InputOp(PhysicalOp):
 
 
 class ReadOp(PhysicalOp):
+    """Reads stream: each read task runs as a streaming-generator task whose
+    items become bundles as the datasource produces blocks — downstream
+    stages start on a big file's first blocks while its tail is still being
+    read. Bundles still emit in dispatch order (ordered-dataset semantics):
+    the front stream flows through immediately; later streams buffer until
+    it finishes."""
+
     def __init__(self, read_tasks: list, remote_opts: dict):
         super().__init__("Read", [])
         self._tasks = collections.deque(read_tasks)
         self.inputs_done = True
-        self._remote = ray_tpu.remote(_run_read_task).options(num_returns=2, **remote_opts)
+        self._remote = ray_tpu.remote(_stream_read_task).options(
+            num_returns="streaming", **remote_opts
+        )
+        import threading
+
+        self._slock = threading.Lock()
+        self._streams: collections.deque[dict] = collections.deque()
 
     def can_dispatch(self, ctx):
-        return bool(self._tasks) and len(self.pending) < ctx.max_tasks_per_op
+        return bool(self._tasks) and len(self._streams) < ctx.max_tasks_per_op
 
     def dispatch(self, ctx):
+        import threading
+
         rt = self._tasks.popleft()
-        blocks_ref, meta_ref = self._remote.remote(
+        gen = self._remote.remote(
             rt, ctx.target_max_block_size, ctx.target_max_rows_per_block
         )
-        self._track(meta_ref, blocks_ref)
+        rec = {"gen": gen, "buf": collections.deque(), "done": False, "err": None}
+        with self._slock:
+            self._streams.append(rec)
+        threading.Thread(
+            target=self._feed, args=(gen, rec), name="read-stream-feed", daemon=True
+        ).start()
+
+    def _feed(self, gen, rec):
+        try:
+            for item_ref in gen:
+                blocks_ref, metas = ray_tpu.get(item_ref)
+                with self._slock:
+                    rec["buf"].append(RefBundle(blocks_ref, metas))
+        except BaseException as e:  # noqa: BLE001 - surfaced in poll()
+            rec["err"] = e
+        finally:
+            rec["done"] = True
+
+    def poll(self, ctx):
+        if self.finished:
+            self.shutdown()
+            return
+        err = None
+        with self._slock:
+            while self._streams:
+                rec = self._streams[0]
+                while rec["buf"]:
+                    self.output_queue.append(rec["buf"].popleft())
+                if rec["err"] is not None:
+                    err = rec["err"]
+                    break
+                if rec["done"]:
+                    self._streams.popleft()
+                    continue
+                break
+        if err is not None:
+            raise err
 
     def maybe_finish(self):
-        if not self._tasks and not self.pending:
+        if not self._tasks and not self._streams and not self.pending:
             self.finished = True
+
+    def shutdown(self):
+        with self._slock:
+            for rec in self._streams:
+                try:
+                    rec["gen"].close()
+                except Exception:
+                    pass
+            self._streams.clear()
 
 
 class TaskMapOp(PhysicalOp):
@@ -696,6 +783,8 @@ class StreamingExecutor:
                     continue  # backpressure: stop ingesting, keep draining
                 op.dispatch(ctx)
                 dispatched = True
+            for op in self.ops:
+                op.poll(ctx)
             # Harvest completions.
             pending = [(ref, op) for op in self.ops for ref in op.pending]
             if pending:

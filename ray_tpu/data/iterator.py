@@ -11,7 +11,6 @@ host→HBM copies overlap the step — the device-feeding role
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 from typing import Any, Callable, Iterator, Optional
@@ -32,15 +31,21 @@ class DataIterator:
     # -- raw ----------------------------------------------------------------
 
     def _iter_blocks(self, prefetch: int) -> Iterator[Block]:
-        """Fetch block-list objects with a bounded prefetch window."""
-        refs = self._source()
-        window: collections.deque = collections.deque()
-        for ref in refs:
-            window.append(ref)
-            while len(window) > max(prefetch, 0):
-                yield from ray_tpu.get(window.popleft())
-        while window:
-            yield from ray_tpu.get(window.popleft())
+        """Yield blocks as bundles arrive. The ref stream + object fetch run
+        on a background thread with a bounded queue: gets overlap with
+        consumer compute, and — unlike a hold-back window — an
+        already-available block is NEVER gated on the producer's next bundle
+        (matters for streaming reads, where the first block can be ready
+        seconds before a slow source finishes)."""
+
+        def produce() -> Iterator[Block]:
+            for ref in self._source():
+                yield from ray_tpu.get(ref)
+
+        if prefetch > 0:
+            yield from _bg_prefetch(produce, prefetch)
+        else:
+            yield from produce()
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self._iter_blocks(prefetch=1):

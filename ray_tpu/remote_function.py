@@ -48,8 +48,11 @@ class RemoteFunction:
             self._blob = ser.dumps(self._fn)
         func_id = ctx.upload_function(self._blob)
         num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
-        task_id, return_ids = ctx.new_task_returns(max(num_returns, 1))
+        task_id, return_ids = ctx.new_task_returns(
+            1 if streaming else max(num_returns, 1)
+        )
         spec = {
             "task_id": task_id,
             "kind": "task",
@@ -60,7 +63,12 @@ class RemoteFunction:
             "return_ids": return_ids,
             "resources": opt.to_resources(options, is_actor=False),
             "strategy": opt.to_strategy(options),
-            "max_retries": options.get("max_retries", GLOBAL_CONFIG.default_max_retries),
+            # streaming tasks never retry: items already handed to the
+            # consumer cannot be un-consumed (reference disables lineage
+            # reconstruction for streaming generators the same way)
+            "max_retries": 0
+            if streaming
+            else options.get("max_retries", GLOBAL_CONFIG.default_max_retries),
             "name": options.get("name") or getattr(self._fn, "__qualname__", "task"),
         }
         if options.get("runtime_env"):
@@ -68,6 +76,10 @@ class RemoteFunction:
 
             spec["runtime_env"] = renv.package(options["runtime_env"], ctx)
         refs = ctx.submit_task(spec)
+        if streaming:
+            from ray_tpu._private.runtime import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id, refs[0], ctx)
         if num_returns == 1:
             return refs[0]
         return refs
