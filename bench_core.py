@@ -15,13 +15,18 @@ import time
 import numpy as np
 
 
-def timeit(name: str, fn, unit: str = "per_s", warmup=True) -> dict:
+def timeit(name: str, fn, unit: str = "per_s", warmup=True, windows: int = 3) -> dict:
+    """Median of three measurement windows (like bench.py's TPU metric):
+    single short windows on a shared VM swing ±40% with scheduler noise,
+    which round 3 initially misread as regressions."""
     if warmup:
         fn()
-    t0 = time.perf_counter()
-    n = fn()
-    dt = time.perf_counter() - t0
-    rec = {"metric": name, "value": round(n / dt, 2), "unit": unit}
+    rates = []
+    for _ in range(max(windows, 1)):
+        t0 = time.perf_counter()
+        n = fn()
+        rates.append(n / (time.perf_counter() - t0))
+    rec = {"metric": name, "value": round(sorted(rates)[len(rates) // 2], 2), "unit": unit}
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -37,12 +42,12 @@ def main() -> list[dict]:
     def noop():
         return None
 
-    def tasks_sync(n=200):
+    def tasks_sync(n=600):
         for _ in range(n):
             ray_tpu.get(noop.remote())
         return n
 
-    def tasks_async(n=1000):
+    def tasks_async(n=3000):
         ray_tpu.get([noop.remote() for _ in range(n)])
         return n
 
@@ -58,12 +63,12 @@ def main() -> list[dict]:
     a = A.remote()
     ray_tpu.get(a.noop.remote())
 
-    def actor_sync(n=200):
+    def actor_sync(n=600):
         for _ in range(n):
             ray_tpu.get(a.noop.remote())
         return n
 
-    def actor_async(n=1000):
+    def actor_async(n=3000):
         ray_tpu.get([a.noop.remote() for _ in range(n)])
         return n
 

@@ -101,6 +101,14 @@ class Config:
     #: (pool workers).
     worker_spawn_retries: int = 3
 
+    #: Pipeline up to this many plain tasks of identical scheduling
+    #: signature onto one worker (followers ride the head task's resource
+    #: lease; alloc transfers at completion). Hides the head<->worker
+    #: round-trip entirely for small-task storms (reference:
+    #: ``max_tasks_in_flight_per_worker``, direct task submitter). 1
+    #: disables pipelining.
+    max_tasks_in_flight_per_worker: int = 4
+
     #: Streaming-generator backpressure window: a producer pauses once this
     #: many yielded items are unconsumed (reference:
     #: ``_generator_backpressure_num_objects``). Consumer progress is pushed

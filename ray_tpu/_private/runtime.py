@@ -500,10 +500,18 @@ class DriverContext(BaseContext):
             return self.head.get_locators(payload["obj_ids"], payload.get("timeout"))
         if method == "wait":
             return self.head.wait_objects(payload["obj_ids"], payload["num_returns"], payload.get("timeout"))
-        return getattr(self.head, "rpc_" + method)(**payload)
+        try:
+            return getattr(self.head, "rpc_" + method)(**payload)
+        finally:
+            # in-process calls bypass _run_request: drain any worker sends
+            # this call queued (head.flush_outbox docstring)
+            self.head.flush_outbox()
 
     def put_serialized(self, sv, is_error=False) -> bytes:
-        return self.head.put_serialized(sv, is_error)
+        try:
+            return self.head.put_serialized(sv, is_error)
+        finally:
+            self.head.flush_outbox()
 
 
 class WorkerContext(BaseContext):

@@ -57,6 +57,12 @@ class WorkerState:
         # index+1, fed by the head's stream_ack pushes (_recv_loop)
         self.stream_acked: dict[bytes, int] = {}
         self.stream_cv = threading.Condition()
+        # completion batching: while more tasks are queued locally, done
+        # payloads buffer and ship as ONE tasks_done_batch message — one
+        # head lock region / wakeup / scheduling pass per batch (the head
+        # amortizes, see _on_task_done_batch). Flushed the moment the local
+        # queue drains, so an idle worker never delays a result.
+        self.done_buf: list[dict] = []
 
 
 def connect_head(address: str, authkey: bytes, retries: int = 3):
@@ -372,16 +378,14 @@ def _stream_results(state: WorkerState, spec: dict, gen) -> None:
     except BaseException:  # noqa: BLE001
         traceback.print_exc()
         results = []
-    state.ctx.send_raw(
-        (
-            "task_done",
-            {
-                "task_id": task_id,
-                "results": results,
-                "results_error": is_error,
-                "stream_count": idx,
-            },
-        )
+    _emit_done(
+        state,
+        {
+            "task_id": task_id,
+            "results": results,
+            "results_error": is_error,
+            "stream_count": idx,
+        },
     )
 
 
@@ -438,9 +442,25 @@ def _run_task(state: WorkerState, spec: dict):
     except BaseException:  # noqa: BLE001
         traceback.print_exc()
         results = []
-    state.ctx.send_raw(
-        ("task_done", {"task_id": task_id, "results": results, "results_error": is_error})
+    _emit_done(
+        state, {"task_id": task_id, "results": results, "results_error": is_error}
     )
+
+
+def _emit_done(state: WorkerState, payload: dict) -> None:
+    # batching is only safe (and only useful) on the serial exec-loop
+    # thread; concurrent actor pool threads would race the buffer swap —
+    # they send directly, as before
+    if threading.get_ident() != state.exec_thread_id:
+        state.ctx.send_raw(("task_done", payload))
+        return
+    state.done_buf.append(payload)
+    if len(state.done_buf) >= 8 or state.task_queue.qsize() == 0:
+        buf, state.done_buf = state.done_buf, []
+        if len(buf) == 1:
+            state.ctx.send_raw(("task_done", buf[0]))
+        else:
+            state.ctx.send_raw(("tasks_done_batch", buf))
 
 
 def _resolve_actor_method(state: WorkerState, name: str):

@@ -25,7 +25,35 @@ class RemoteFunction:
         self._options = default_options or {}
         opt.validate(self._options, is_actor=False)
         self._blob: Optional[bytes] = None
+        self._spec_template: Optional[dict] = None
         functools.update_wrapper(self, fn)
+
+    def _template(self) -> dict:
+        """Static per-(fn, options) spec fields, computed once — option
+        resolution (resource folding, strategy validation) off the
+        per-.remote() hot path. Values are shared by reference across
+        submissions; the head treats spec contents as read-only (the only
+        per-dispatch key, _pg_bundle, is set on the per-call spec copy)."""
+        tpl = self._spec_template
+        if tpl is None:
+            o = self._options
+            num_returns = o.get("num_returns", 1)
+            tpl = self._spec_template = {
+                "kind": "task",
+                "num_returns": num_returns,
+                "resources": opt.to_resources(o, is_actor=False),
+                "strategy": opt.to_strategy(o),
+                # streaming tasks never retry: items already handed to the
+                # consumer cannot be un-consumed (reference disables lineage
+                # reconstruction for streaming generators the same way).
+                # None = not pinned by options: resolved against the LIVE
+                # config at each submission (the config is mutable).
+                "max_retries": 0
+                if num_returns == "streaming"
+                else o.get("max_retries"),
+                "name": o.get("name") or getattr(self._fn, "__qualname__", "task"),
+            }
+        return tpl
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -47,30 +75,26 @@ class RemoteFunction:
         if self._blob is None:
             self._blob = ser.dumps(self._fn)
         func_id = ctx.upload_function(self._blob)
-        num_returns = options.get("num_returns", 1)
+        if options is self._options:
+            tpl = self._template()
+        else:  # explicit options dict (DAG execution paths)
+            tpl = RemoteFunction(self._fn, options)._template()
+        num_returns = tpl["num_returns"]
         streaming = num_returns == "streaming"
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
         task_id, return_ids = ctx.new_task_returns(
             1 if streaming else max(num_returns, 1)
         )
         spec = {
+            **tpl,
             "task_id": task_id,
-            "kind": "task",
             "func_id": func_id,
             "args": s_args,
             "kwargs": s_kwargs,
-            "num_returns": num_returns,
             "return_ids": return_ids,
-            "resources": opt.to_resources(options, is_actor=False),
-            "strategy": opt.to_strategy(options),
-            # streaming tasks never retry: items already handed to the
-            # consumer cannot be un-consumed (reference disables lineage
-            # reconstruction for streaming generators the same way)
-            "max_retries": 0
-            if streaming
-            else options.get("max_retries", GLOBAL_CONFIG.default_max_retries),
-            "name": options.get("name") or getattr(self._fn, "__qualname__", "task"),
         }
+        if spec["max_retries"] is None:
+            spec["max_retries"] = GLOBAL_CONFIG.default_max_retries
         if options.get("runtime_env"):
             from ray_tpu._private import runtime_env as renv
 

@@ -39,7 +39,7 @@ def test_oom_kill_retries_then_fails(oom_cluster):
             ray_tpu.get(fut, timeout=60)
     finally:
         head._memory_sampler = None
-    events = [e for e in head.task_events if e["state"] == "OOM_KILLED"]
+    events = [e for e in head.rpc_task_events() if e["state"] == "OOM_KILLED"]
     assert len(events) >= 2  # first run + its retry both OOM-killed
 
 
@@ -56,7 +56,7 @@ def test_no_kill_below_threshold(oom_cluster):
         assert ray_tpu.get(quick.remote(), timeout=60) == 7
     finally:
         head._memory_sampler = None
-    assert not [e for e in head.task_events if e["state"] == "OOM_KILLED"]
+    assert not [e for e in head.rpc_task_events() if e["state"] == "OOM_KILLED"]
 
 
 def test_memory_usage_fraction_reads_proc(oom_cluster):
