@@ -119,6 +119,14 @@ class Config:
     default_max_restarts: int = 0
     default_max_task_retries: int = 0
 
+    #: After a head crash/restart, node agents and detached-actor workers
+    #: retry the head address this long before giving up (reference: the
+    #: raylet reconnect window, ray_config_def.h:56-60
+    #: ``gcs_rpc_server_reconnect_timeout_s``). The restarted head holds
+    #: restored detached actors for the same window before re-creating
+    #: them fresh.
+    head_reconnect_grace_s: float = 30.0
+
     # -- health ------------------------------------------------------------
     #: Interval of the head's liveness sweep over worker processes
     #: (reference: GcsHealthCheckManager probing raylets).

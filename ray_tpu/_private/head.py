@@ -175,6 +175,27 @@ class AgentHandle:
             return False
 
 
+def _close_listener(listener) -> None:
+    """Close an mp.connection Listener so its PORT is actually released.
+
+    ``Listener.close()`` alone leaves the socket listening while another
+    thread is blocked in ``accept()`` (the in-flight syscall pins the
+    socket), so a restarted head could never rebind the address. A
+    ``shutdown(SHUT_RDWR)`` first wakes the accepter, then close releases
+    the fd."""
+    import socket as _socket
+
+    try:
+        sock = listener._listener._socket
+        sock.shutdown(_socket.SHUT_RDWR)
+    except (OSError, AttributeError):
+        pass
+    try:
+        listener.close()
+    except Exception:
+        pass
+
+
 class NodeState:
     def __init__(self, node_id: NodeID, resources: dict[str, float], labels=None):
         self.node_id = node_id
@@ -466,16 +487,19 @@ class Head:
         # pool would queue NEW gets behind parked ones)
         self._blocking_pool = _DaemonPool(4096, "head-rpc")
         self._snapshot_due = 0.0
+        # detached actors restored from a snapshot, waiting for their old
+        # worker to reconnect; past the grace window they re-create fresh
+        self._restored_actors: set[bytes] = set()
+        self._restore_time = time.monotonic()
         self._lineage_fifo: deque = deque()
         self._lineage_total = 0
-        if self._snapshot_path:
-            self._load_snapshot()  # after the tables above exist
-
         self.nodes: dict[bytes, NodeState] = {}
         self.node_order: list[bytes] = []
         self.actors: dict[bytes, ActorState] = {}
         self.named_actors: dict[str, bytes] = {}
         self.placement_groups: dict[bytes, PlacementGroupState] = {}
+        if self._snapshot_path:
+            self._load_snapshot()  # after the tables above exist
 
         # tasks waiting on deps: obj_id -> set of task records
         self.dep_waiters: dict[bytes, set] = {}
@@ -612,6 +636,7 @@ class Head:
         measurably caps task throughput."""
         worker: Optional[WorkerHandle] = None
         agent_node: Optional[NodeID] = None
+        handover = False
         try:
             while not self._shutdown:
                 try:
@@ -622,8 +647,11 @@ class Head:
                 if kind == "register":
                     worker = self._on_register(conn, msg[1], remote=remote)
                     self.flush_outbox()
+                    if worker is None:
+                        break  # rejected (unknown node): close so it retries
                     self._adopt_worker_conn(conn, worker, remote)
                     worker = None  # selector owns disconnect handling now
+                    handover = True
                     return
                 elif kind == "register_agent":
                     agent_node = self._on_register_agent(conn, msg[1])
@@ -633,6 +661,13 @@ class Head:
                     _, seq, method, payload = msg
                     self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
         finally:
+            # close OUR side whatever ended the loop (rejection, peer EOF,
+            # handler exception): a conn left open but unserved would park
+            # the peer in recv forever instead of letting it retry
+            if not handover:
+                from ray_tpu._private.node_agent import shutdown_conn
+
+                shutdown_conn(conn)
             if worker is not None:
                 self._on_worker_disconnect(worker)
             if agent_node is not None:
@@ -716,8 +751,20 @@ class Head:
 
     def _on_register_agent(self, conn, info) -> NodeID:
         """A remote host's node agent attached: register its node; workers
-        for it will be spawned THERE via spawn requests over this conn."""
-        node_id = self.add_node(info.get("resources") or {}, labels=info.get("labels"))
+        for it will be spawned THERE via spawn requests over this conn. An
+        agent reattaching after a head restart presents its previous node
+        id and keeps it (dead or unknown here — a LIVE id means a rogue
+        duplicate and gets a fresh one)."""
+        want = info.get("node_id")
+        keep = None
+        if want:
+            with self.lock:
+                old = self.nodes.get(want)
+                if old is None or not old.alive:
+                    keep = NodeID(want)
+        node_id = self.add_node(
+            info.get("resources") or {}, labels=info.get("labels"), node_id=keep
+        )
         with self.lock:
             node = self.nodes[node_id.binary()]
             node.agent = AgentHandle(conn)
@@ -862,6 +909,11 @@ class Head:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         if self.arena_name:
             env["RAY_TPU_ARENA"] = self.arena_name
+        if self.tcp_address is not None:
+            # detached-actor workers reconnect here after a head restart —
+            # the unix socket dies with the old head process, the TCP
+            # address is what a restarted head rebinds
+            env["RAY_TPU_HEAD_TCP"] = f"{self.tcp_address[0]}:{self.tcp_address[1]}"
         popen = subprocess.Popen(
             [
                 sys.executable,
@@ -884,12 +936,18 @@ class Head:
             node.all_workers.add(wh)
         # registration arrives on its own connection; matched in _on_register
 
-    def _on_register(self, conn, info, remote: bool = False) -> WorkerHandle:
+    def _on_register(self, conn, info, remote: bool = False) -> Optional[WorkerHandle]:
         node_id = info["node_id"]
         pid = info["pid"]
         token = info.get("token")
         with self.lock:
-            node = self.nodes[node_id]
+            node = self.nodes.get(node_id)
+            if node is None:
+                # e.g. a detached-actor worker reconnecting after a head
+                # restart BEFORE its node's agent has reattached: reject by
+                # closing the conn (caller) — the worker's reconnect loop
+                # retries until the node exists again
+                return None
             wh = None
             if token:
                 for cand in node.all_workers:
@@ -914,9 +972,33 @@ class Head:
                 wh = WorkerHandle(node, None)
                 node.all_workers.add(wh)
             wh.conn = conn
-            if wh.actor_id is None:
+            claim = info.get("actor_id")
+            if wh.actor_id is None and claim is None:
+                # not a reconnect claim: this registration consumes a spawn
+                # slot (a reconnecting worker never occupied one)
                 node.spawning = max(0, node.spawning - 1)
             self._conn_worker[conn] = wh
+            if claim is not None:
+                # a detached actor's worker reconnecting after a head
+                # restart: rebind it to the restored ActorState (its
+                # actor_ready message completes the transition to ALIVE
+                # through _on_actor_ready). Reject if the actor is gone OR
+                # already re-bound/re-creating — two workers bound to one
+                # actor id would split its state.
+                actor = self.actors.get(claim)
+                if (
+                    actor is None
+                    or actor.state == ACTOR_DEAD
+                    or actor.worker is not None
+                    or actor.create_spec["task_id"] in self.tasks
+                ):
+                    wh.alive = False
+                    wh.send(("exit", None))
+                    return wh
+                wh.actor_id = claim
+                actor.node_id = node.node_id
+                self._restored_actors.discard(claim)
+                return wh
             if wh.actor_id is not None:
                 rec = self._actor_create_recs.pop(wh.actor_id, None)
                 if rec is not None and rec["task_id"] in self.cancelled:
@@ -954,16 +1036,12 @@ class Head:
             if rec["task_id"] in self.cancelled:
                 self._finish_cancelled(rec)
                 continue
-            if self._dispatch_to_worker(wh, rec):
-                return
-            if not wh.alive:
-                # dispatch failure killed the worker (and requeued rec);
-                # don't feed further queued tasks to a dead worker.
-                return
+            self._dispatch_to_worker(wh, rec)
+            return
         if wh not in node.idle_workers:
             node.idle_workers.append(wh)
 
-    def _dispatch_to_worker(self, wh: WorkerHandle, rec: dict) -> bool:
+    def _dispatch_to_worker(self, wh: WorkerHandle, rec: dict) -> None:
         spec = rec["spec"]
         wh.queued_recs.append(rec)
         wh.current_task = wh.queued_recs[0]
@@ -979,10 +1057,10 @@ class Head:
         rec["state"] = "RUNNING"
         rec["started_at"] = time.monotonic()  # OOM policy: newest-first victim
         self._event(rec, "RUNNING")
-        # send OUTSIDE the head lock (sender thread); a dead conn surfaces
-        # there as worker death, which requeues the whole dispatch FIFO
+        # send OUTSIDE the head lock (flush_outbox); a dead conn surfaces
+        # there as worker death, which requeues the whole dispatch FIFO —
+        # dispatch itself can no longer fail synchronously
         self._enqueue_send(wh, ("run_task", spec))
-        return True
 
     def _enqueue_send(self, wh: WorkerHandle, msg) -> None:
         """Lock held: queue a worker-bound message. The socket write (plus
@@ -1050,16 +1128,21 @@ class Head:
                 ):
                     rec["node"] = node.node_id
                     rec["state"] = "ASSIGNED"
-                    return self._dispatch_to_worker(wh, rec)
+                    self._dispatch_to_worker(wh, rec)
+                    return True
         return False
 
     # ------------------------------------------------------------ node admin
 
-    def add_node(self, resources: dict[str, float], labels=None) -> NodeID:
-        node_id = NodeID.from_random()
+    def add_node(self, resources: dict[str, float], labels=None, node_id=None) -> NodeID:
+        """``node_id`` lets a reattaching agent keep its identity across a
+        head restart, so restored object locators (loc.node) stay routable
+        (reference: raylet re-registration after GCS failover)."""
+        node_id = node_id or NodeID.from_random()
         with self.lock:
             self.nodes[node_id.binary()] = NodeState(node_id, resources, labels)
-            self.node_order.append(node_id.binary())
+            if node_id.binary() not in self.node_order:
+                self.node_order.append(node_id.binary())
             self._sched_gen += 1
             self._retry_pending_pgs()
             self._schedule()
@@ -1639,6 +1722,24 @@ class Head:
                 self._on_worker_dead(wh)
             for wh in timed_out:
                 self._respawn_timed_out(wh)
+            # restored detached actors whose old workers never reconnected:
+            # past the grace window, re-create them fresh (reference:
+            # gcs_actor_manager restart of registered actors on failover)
+            if (
+                self._restored_actors
+                and now - self._restore_time > GLOBAL_CONFIG.head_reconnect_grace_s
+            ):
+                with self.lock:
+                    for aid in list(self._restored_actors):
+                        self._restored_actors.discard(aid)
+                        actor = self.actors.get(aid)
+                        if (
+                            actor is not None
+                            and actor.state == ACTOR_RESTARTING
+                            and actor.worker is None
+                        ):
+                            self._recreate_actor_locked(actor)
+                    self._schedule()
             self.flush_outbox()
 
     def _respawn_timed_out(self, wh: WorkerHandle) -> None:
@@ -1797,20 +1898,32 @@ class Head:
         node.all_workers.discard(wh)
         if wh in node.idle_workers:
             node.idle_workers.remove(wh)
-        # the whole dispatch FIFO dies with the worker — requeue/fail every
-        # queued rec, not just the running head (pipelined followers too)
+        # the whole dispatch FIFO dies with the worker. Only the HEAD of the
+        # queue was executing — it is charged a retry (or failed). Pipelined
+        # followers never ran an instruction: they requeue to the scheduler
+        # free of charge (the reference likewise only charges attempts that
+        # actually started).
+        first = True
         for rec in list(wh.queued_recs):
             if rec["task_id"] in self.tasks and rec["spec"]["kind"] == "task":
-                self.tasks.pop(rec["task_id"], None)
-                cause = (
-                    rex.OutOfMemoryError(
-                        f"Task {rec['spec'].get('name')} was killed by the memory "
-                        f"monitor to relieve host memory pressure"
+                if first:
+                    self.tasks.pop(rec["task_id"], None)
+                    cause = (
+                        rex.OutOfMemoryError(
+                            f"Task {rec['spec'].get('name')} was killed by the memory "
+                            f"monitor to relieve host memory pressure"
+                        )
+                        if rec.get("oom_killed")
+                        else rex.WorkerCrashedError()
                     )
-                    if rec.get("oom_killed")
-                    else rex.WorkerCrashedError()
-                )
-                self._requeue_or_fail(rec, cause)
+                    self._requeue_or_fail(rec, cause)
+                else:
+                    self._release_alloc(rec)
+                    rec["state"] = "PENDING"
+                    rec["worker"] = None
+                    rec["spec"].pop("_pg_bundle", None)
+                    self.pending_sched.append(rec)
+            first = False
         wh.queued_recs.clear()
         wh.current_task = None
         if wh.actor_id is not None:
@@ -1895,6 +2008,13 @@ class Head:
             if rec is not None:
                 actor.alloc = rec.pop("alloc", None)
                 self._event(rec, "FINISHED")
+            elif actor.alloc is None:
+                # reconnected after head restart: no create task carried an
+                # allocation — re-reserve the actor's lifetime resources on
+                # its node (may briefly oversubscribe right after failover)
+                res = self._effective_resources(actor.create_spec)
+                wh.node.allocate(res)
+                actor.alloc = (wh.node.node_id.binary(), res, None)
             for rid in actor.create_spec["return_ids"]:
                 sv = ser.serialize(None)
                 self._store_locator(rid, ("inline", sv.to_bytes(), False))
@@ -1978,24 +2098,29 @@ class Head:
                     self._fail_stream_locked(s)
             for s in reversed(retry):
                 actor.pending_calls.appendleft(s)
-            # If the worker died mid-creation, reap the in-flight create task:
-            # release its allocation and carry its return ids into the retry so
-            # they eventually resolve.
-            old_rec = self.tasks.pop(actor.create_spec["task_id"], None)
-            if old_rec is not None:
-                self._release_alloc(old_rec)
-            cspec = dict(actor.create_spec)
-            cspec["task_id"] = TaskID.from_random().binary()
-            cspec["return_ids"] = actor.create_spec["return_ids"] if old_rec is not None else []
-            # Future lookups (ready/kill) must see the re-creation task's id,
-            # or its record + resource allocation leak forever.
-            actor.create_spec = cspec
-            rec = {"task_id": cspec["task_id"], "spec": cspec, "deps": set(), "state": "PENDING", "worker": None, "retries_left": 0}
-            self.tasks[cspec["task_id"]] = rec
-            self.pending_sched.append(rec)
+            self._recreate_actor_locked(actor)
         else:
             self._kill_actor_locked(actor, "worker died", restart=False, inflight=inflight)
         self.cv.notify_all()
+
+    def _recreate_actor_locked(self, actor: ActorState) -> None:
+        """Lock held. Queue a fresh creation task for a RESTARTING actor.
+
+        If the worker died mid-creation, reap the in-flight create task:
+        release its allocation and carry its return ids into the retry so
+        they eventually resolve."""
+        old_rec = self.tasks.pop(actor.create_spec["task_id"], None)
+        if old_rec is not None:
+            self._release_alloc(old_rec)
+        cspec = dict(actor.create_spec)
+        cspec["task_id"] = TaskID.from_random().binary()
+        cspec["return_ids"] = actor.create_spec["return_ids"] if old_rec is not None else []
+        # Future lookups (ready/kill) must see the re-creation task's id,
+        # or its record + resource allocation leak forever.
+        actor.create_spec = cspec
+        rec = {"task_id": cspec["task_id"], "spec": cspec, "deps": set(), "state": "PENDING", "worker": None, "retries_left": 0}
+        self.tasks[cspec["task_id"]] = rec
+        self.pending_sched.append(rec)
 
     def _kill_actor_locked(self, actor: ActorState, cause, restart: bool, inflight=None):
         actor.state = ACTOR_DEAD
@@ -2608,17 +2733,64 @@ class Head:
 
     def _snapshot(self) -> None:
         """Persist restartable head state (reference: GCS table storage —
-        gcs_table_storage.cc with the Redis backend for HA). Scope: the KV
-        store and function table; live processes (workers/actors) are not
-        resurrectable across a head restart by design."""
+        gcs_table_storage.cc + gcs_init_data.cc reloading every table on
+        failover). Scope:
+
+        * KV (carries the job table) + function table,
+        * DETACHED actors (create spec + restart budget — their workers
+          outlive the head and reconnect; non-detached actors die with
+          their driver anyway),
+        * placement groups (re-placed as nodes reattach),
+        * the object directory for entries whose BYTES survive a head
+          crash: spilled files, agent-host objects, and head-host shm
+          (/dev/shm persists across a head process crash; only a clean
+          shutdown unlinks it) plus the arena name for re-attach.
+        """
         path = self._snapshot_path
         if not path:
             return
         import pickle as _pickle
 
         with self.lock:
+            actors = {
+                aid: {
+                    "create_spec": a.create_spec,
+                    "restarts_left": a.restarts_left,
+                    "max_task_retries": a.max_task_retries,
+                    "num_handles": a.num_handles,
+                }
+                for aid, a in self.actors.items()
+                if a.detached and a.state != ACTOR_DEAD
+            }
+            pgs = {
+                pg_id: {"bundles": pg.bundles, "strategy": pg.strategy, "name": pg.name}
+                for pg_id, pg in self.placement_groups.items()
+                if pg.state != PG_REMOVED
+            }
+            objects = {}
+            for oid, e in self.objects.items():
+                if not e.ready:
+                    continue
+                rec = {"refcount": e.refcount, "size": e.size, "is_error": e.is_error}
+                if e.spill_path is not None:
+                    rec["spill_path"] = e.spill_path
+                elif e.shm is not None:
+                    rec["shm"] = e.shm
+                elif e.small is not None and len(e.small) <= 65536:
+                    rec["small"] = e.small
+                else:
+                    continue
+                objects[oid] = rec
             blob = _pickle.dumps(
-                {"version": 1, "kv": dict(self.kv), "functions": dict(self.functions)}
+                {
+                    "version": 2,
+                    "kv": dict(self.kv),
+                    "functions": dict(self.functions),
+                    "actors": actors,
+                    "placement_groups": pgs,
+                    "objects": objects,
+                    "arena_name": self.arena_name,
+                }
             )
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
@@ -2640,10 +2812,64 @@ class Head:
         try:
             with open(path, "rb") as f:
                 data = _pickle.loads(f.read())
+        except Exception:
+            return  # a torn snapshot must not block cluster start
+        try:
             self.kv.update(data.get("kv", {}))
             self.functions.update(data.get("functions", {}))
+            # detached actors come back RESTARTING: a surviving worker
+            # reconnects and rebinds (state preserved); otherwise the next
+            # node registration triggers a fresh create (state lost, like a
+            # reference actor restart)
+            for aid, rec in data.get("actors", {}).items():
+                actor = ActorState(aid, rec["create_spec"])
+                actor.restarts_left = rec.get("restarts_left", 0)
+                actor.max_task_retries = rec.get("max_task_retries", 0)
+                actor.num_handles = rec.get("num_handles", 1)
+                actor.state = ACTOR_RESTARTING
+                self.actors[aid] = actor
+                if actor.name:
+                    self.named_actors[actor.name] = aid
+                self._restored_actors.add(aid)
+            for pg_id, rec in data.get("placement_groups", {}).items():
+                pg = PlacementGroupState(
+                    pg_id, rec["bundles"], rec["strategy"], rec["name"]
+                )
+                pg.bundle_nodes = [None] * len(rec["bundles"])
+                self.placement_groups[pg_id] = pg
+            from ray_tpu._private.shm_store import ShmReader as _ShmReader
+
+            for oid, rec in data.get("objects", {}).items():
+                ent = ObjectEntry()
+                ent.refcount = max(rec.get("refcount", 0), 1)
+                ent.size = rec.get("size", 0)
+                ent.is_error = rec.get("is_error", False)
+                ent.spill_path = rec.get("spill_path")
+                ent.shm = rec.get("shm")
+                ent.small = rec.get("small")
+                if ent.spill_path or ent.shm is not None or ent.small is not None:
+                    self.objects[oid] = ent
+                    if ent.shm is not None:
+                        # node table is empty at restore time, so locality
+                        # can't be judged from loc.node — probe instead:
+                        # only segments attachable on THIS host count
+                        # toward its spill accounting
+                        try:
+                            _ShmReader(ent.shm).close()
+                            self.shm_owner.register(ent.shm)
+                        except FileNotFoundError:
+                            pass  # foreign host's bytes (or gone)
+            prev_arena = data.get("arena_name")
+            if prev_arena and self.arena_name is None:
+                from ray_tpu._private import shm_store as _shm
+
+                if _shm.attach_arena(prev_arena) is not None:
+                    self.arena_name = prev_arena
+                    _shm.set_write_arena(prev_arena)
         except Exception:
-            pass  # a torn snapshot must not block cluster start
+            import traceback as _tb
+
+            _tb.print_exc()  # partial restore is better than none
 
     def rpc_put(self, obj_id, small, shm, is_error=False):
         locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
@@ -2939,15 +3165,9 @@ class Head:
                 wh.proc.join(timeout=max(0.0, deadline - time.monotonic()))
                 if wh.proc.is_alive():
                     wh.proc.terminate()
-        try:
-            self._listener.close()
-        except Exception:
-            pass
+        _close_listener(self._listener)
         if self._tcp_listener is not None:
-            try:
-                self._tcp_listener.close()
-            except Exception:
-                pass
+            _close_listener(self._tcp_listener)
         if self.data_server is not None:
             self.data_server.shutdown()
         self._pub_queue.put(None)

@@ -98,13 +98,19 @@ class NodeAgent:
     # -- serve loop --------------------------------------------------------
 
     def run(self) -> None:
-        """Blocks serving spawn requests until the head hangs up."""
+        """Blocks serving spawn requests until the head hangs up for good
+        (a restarted head is retried for head_reconnect_grace_s; the agent
+        re-registers under its ORIGINAL node id so restored object
+        locators stay routable — reference: raylet reconnect window,
+        ray_config_def.h:56-60)."""
         try:
             while not self._stop.is_set():
                 try:
                     msg = self.conn.recv()
                 except (EOFError, OSError):
-                    break
+                    if self._stop.is_set() or not self._reconnect():
+                        break
+                    continue
                 if msg[0] == "spawn_worker":
                     self._spawn(msg[1])
                 elif msg[0] == "free_shm":
@@ -167,14 +173,47 @@ class NodeAgent:
         self._procs = [p for p in self._procs if p.poll() is None]
         self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
 
-    def _my_ip(self) -> str:
+    def _reconnect(self) -> bool:
+        import time
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.worker_main import connect_head
+
+        deadline = time.monotonic() + GLOBAL_CONFIG.head_reconnect_grace_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                conn = connect_head(self.address, self.authkey, retries=1)
+                conn.send(
+                    (
+                        "register_agent",
+                        {
+                            "resources": self.resources,
+                            "labels": self.labels,
+                            "pid": os.getpid(),
+                            "data_address": (self._my_ip(conn), self.data_server.port),
+                            "arena_name": self.arena_name,
+                            "node_id": self.node_id_bin,
+                        },
+                    )
+                )
+                kind, info = conn.recv()
+                if kind != "agent_ack":
+                    raise OSError(f"unexpected reattach reply {kind!r}")
+                self.conn = conn
+                self.node_id_bin = info["node_id"]
+                return True
+            except Exception:
+                time.sleep(0.5)
+        return False
+
+    def _my_ip(self, conn=None) -> str:
         """The IP other hosts can reach this agent's data server on: the
         local address of the control connection to the head (routable by
         construction; '127.0.0.1' stays loopback for same-host tests)."""
         import socket as _socket
 
         try:
-            s = _socket.socket(fileno=os.dup(self.conn.fileno()))
+            s = _socket.socket(fileno=os.dup((conn or self.conn).fileno()))
             try:
                 return s.getsockname()[0]
             finally:

@@ -57,12 +57,6 @@ class WorkerState:
         # index+1, fed by the head's stream_ack pushes (_recv_loop)
         self.stream_acked: dict[bytes, int] = {}
         self.stream_cv = threading.Condition()
-        # completion batching: while more tasks are queued locally, done
-        # payloads buffer and ship as ONE tasks_done_batch message — one
-        # head lock region / wakeup / scheduling pass per batch (the head
-        # amortizes, see _on_task_done_batch). Flushed the moment the local
-        # queue drains, so an idle worker never delays a result.
-        self.done_buf: list[dict] = []
 
 
 def connect_head(address: str, authkey: bytes, retries: int = 3):
@@ -151,6 +145,8 @@ def main(
     )
     set_ctx(ctx)
     state = WorkerState(ctx)
+    state.head_address = socket_path  # for detached-actor reconnect
+    state.detached = False
     ctx.send_raw(
         ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
     )
@@ -160,11 +156,56 @@ def main(
     _exec_loop(state)
 
 
+def _try_reconnect(state: WorkerState, ctx: WorkerContext):
+    """Detached-actor worker lost the head: retry the address for the
+    reconnect grace window, re-register claiming our actor id, and
+    re-announce readiness so the restored head rebinds us (state intact)."""
+    import time
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    addresses = [state.head_address]
+    tcp = os.environ.get("RAY_TPU_HEAD_TCP")
+    if tcp and tcp not in addresses:
+        # a restarted head rebinds its TCP address; the old unix socket
+        # died with the old process
+        addresses.append(tcp)
+    deadline = time.monotonic() + GLOBAL_CONFIG.head_reconnect_grace_s
+    attempt = 0
+    while time.monotonic() < deadline and state.running:
+        address = addresses[attempt % len(addresses)]
+        attempt += 1
+        try:
+            conn = connect_head(address, ctx.authkey, retries=1)
+            conn.send(
+                (
+                    "register",
+                    {
+                        "pid": os.getpid(),
+                        "node_id": ctx.node_id_bin,
+                        "token": "",
+                        "actor_id": state.actor_id,
+                    },
+                )
+            )
+            conn.send(("actor_ready", {"actor_id": state.actor_id, "error": None}))
+            ctx.conn = conn
+            return conn
+        except Exception:
+            time.sleep(0.5)
+    return None
+
+
 def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
     while state.running:
         try:
             msg = conn.recv()
         except (EOFError, OSError):
+            if state.actor_id is not None and getattr(state, "detached", False):
+                newconn = _try_reconnect(state, ctx)
+                if newconn is not None:
+                    conn = newconn
+                    continue
             state.running = False
             state.task_queue.put(None)
             return
@@ -448,19 +489,13 @@ def _run_task(state: WorkerState, spec: dict):
 
 
 def _emit_done(state: WorkerState, payload: dict) -> None:
-    # batching is only safe (and only useful) on the serial exec-loop
-    # thread; concurrent actor pool threads would race the buffer swap —
-    # they send directly, as before
-    if threading.get_ident() != state.exec_thread_id:
-        state.ctx.send_raw(("task_done", payload))
-        return
-    state.done_buf.append(payload)
-    if len(state.done_buf) >= 8 or state.task_queue.qsize() == 0:
-        buf, state.done_buf = state.done_buf, []
-        if len(buf) == 1:
-            state.ctx.send_raw(("task_done", buf[0]))
-        else:
-            state.ctx.send_raw(("tasks_done_batch", buf))
+    # Completions ship immediately. An earlier revision batched them while
+    # more tasks were queued locally, but that withholds a finished task's
+    # result for the DURATION of the next pipelined task (a slow follower
+    # could stall an unrelated ray.get for minutes) and measured no
+    # throughput win — the head still accepts tasks_done_batch for any
+    # future sender that can batch safely.
+    state.ctx.send_raw(("task_done", payload))
 
 
 def _resolve_actor_method(state: WorkerState, name: str):
@@ -753,6 +788,10 @@ def _run_actor_create(state: WorkerState, spec: dict):
         with renv.applied(spec.get("runtime_env"), state.ctx, permanent=True):
             state.actor_instance = cls(*args, **kwargs)
         state.actor_id = spec["actor_id"]
+        # detached actors outlive the head: on conn loss they retry the
+        # head address and rebind instead of dying (reference: raylet
+        # reconnect window; gcs_actor_manager re-registration on failover)
+        state.detached = spec.get("lifetime") == "detached"
         state.ctx.current_actor = spec["actor_id"].hex()  # for get_runtime_context()
         _setup_actor_concurrency(state, spec)
         state.ctx.send_raw(("actor_ready", {"actor_id": spec["actor_id"], "error": None}))
