@@ -25,7 +25,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ray_tpu.collective.coordinator import CollectiveCoordinator, poll
+from ray_tpu.collective.coordinator import CollectiveCoordinator
 from ray_tpu.collective.types import GroupInfo, ReduceOp
 
 # Process-level registry (one membership per process, like an NCCL
@@ -124,25 +124,101 @@ def _next_seq(g: dict) -> int:
 
 
 def _fanin(g, kind: str, tensor, op: Optional[str], timeout: float):
+    """One BLOCKING call on the async coordinator: the actor parks the call
+    on an asyncio.Event until every rank contributed — pushed wakeups, no
+    client-side polling anywhere (round 2 busy-polled try_* every 2ms)."""
     import ray_tpu
 
     seq = _next_seq(g)
     rank = g["info"].rank
-    coord = g["coord"]
-    ray_tpu.get(coord.put_part.remote(kind, seq, rank, tensor))
-    return poll(
-        lambda: ray_tpu.get(coord.try_collect.remote(kind, seq, rank, op)),
-        timeout=timeout,
+    return ray_tpu.get(
+        g["coord"].collect.remote(kind, seq, rank, tensor, op, timeout),
+        timeout=timeout + 10.0,
     )
+
+
+#: tensors at or above this many bytes allreduce via the chunked ring (bulk
+#: bytes peer-to-peer through the object plane; the coordinator shuttles
+#: only refs) instead of riding the coordinator call itself
+RING_THRESHOLD_BYTES = 1 << 22
+
+
+def _combine(a, b, opname):
+    return ReduceOp(opname or "sum").combine(a, b)
+
+
+def _ring_allreduce(g, arr: "np.ndarray", opname: Optional[str], timeout: float):
+    """Chunked ring allreduce (reduce-scatter + allgather), the gloo/NCCL
+    decomposition: each rank moves 2·(N−1)/N of the tensor, bytes flow
+    rank→rank through the object plane (shm locally, data plane across
+    hosts), and NO single process — coordinator included — handles O(world)
+    bytes. The coordinator only forwards ObjectRefs (mail_put/mail_take)."""
+    import ray_tpu
+
+    rank, world = g["info"].rank, g["info"].world_size
+    if world == 1:
+        return arr.copy()
+    seq = _next_seq(g)
+    coord = g["coord"]
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    # views, not copies: sends serialize them and _combine allocates fresh
+    # arrays, so nothing ever mutates a chunk in place
+    chunks = list(np.array_split(flat, world))
+    right = (rank + 1) % world
+    live_refs = []  # keep our outbound objects alive until the final barrier
+
+    def exchange(step: int, payload) -> "np.ndarray":
+        ref = ray_tpu.put(payload)
+        live_refs.append(ref)
+        # nest the ref in a tuple so it travels AS a ref (top-level task
+        # args materialize): the coordinator never touches the bytes.
+        # put and take are issued TOGETHER — the async coordinator services
+        # both concurrently, halving the per-step control latency.
+        p = coord.mail_put.remote(("ring", seq, step, right), (ref,))
+        t = coord.mail_take.remote(("ring", seq, step, rank), timeout)
+        got = ray_tpu.get(t, timeout=timeout + 10.0)
+        ray_tpu.get(p, timeout=timeout)
+        return ray_tpu.get(got[0], timeout=timeout)
+
+    # phase 1: reduce-scatter — after N-1 steps, rank owns the fully reduced
+    # chunk at index (rank+1) % world
+    send_idx = rank
+    for step in range(world - 1):
+        recv_idx = (rank - 1 - step) % world
+        part = exchange(step, chunks[send_idx])
+        chunks[recv_idx] = _combine(chunks[recv_idx], part, opname)
+        send_idx = recv_idx
+    # phase 2: allgather — circulate the reduced chunks
+    send_idx = (rank + 1) % world
+    for step in range(world - 1):
+        recv_idx = (rank - step) % world
+        chunks[recv_idx] = exchange(world - 1 + step, chunks[send_idx])
+        send_idx = recv_idx
+    # trailing barrier: our right neighbor may not have fetched our last
+    # chunk yet — don't let live_refs die under an in-flight fetch. Uses a
+    # subkey of THIS op's seq (not a fresh seq): the ring consumes exactly
+    # one sequence number like the direct path, so a per-rank path
+    # divergence can't desynchronize the group's counters forever.
+    ray_tpu.get(
+        coord.collect.remote("ring_done", seq, rank, None, None, timeout),
+        timeout=timeout + 10.0,
+    )
+    del live_refs
+    return np.concatenate(chunks).reshape(arr.shape).astype(arr.dtype, copy=False)
 
 
 def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM, timeout: float = 60.0):
     """All-reduce a host tensor across the group; returns the reduced array
     (and writes in place when ``tensor`` is a writable numpy array).
-    Reference semantics: ``collective.py:258``."""
+    Reference semantics: ``collective.py:258``; large tensors take the
+    chunked ring (``_ring_allreduce``)."""
     g = _group(group_name)
     opname = op.value if isinstance(op, ReduceOp) else str(op)
-    result = _fanin(g, "allreduce", np.asarray(tensor), opname, timeout)
+    arr = np.asarray(tensor)
+    if arr.nbytes >= RING_THRESHOLD_BYTES and g["info"].world_size > 1:
+        result = _ring_allreduce(g, arr, opname, timeout)
+    else:
+        result = _fanin(g, "allreduce", arr, opname, timeout)
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result)
         return tensor
@@ -172,19 +248,20 @@ def barrier(group_name: str = "default", timeout: float = 60.0) -> None:
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default", timeout: float = 60.0):
-    """Broadcast from ``src_rank`` to all (reference ``collective.py:373``)."""
+    """Broadcast from ``src_rank`` to all (reference ``collective.py:373``).
+    Receivers park on the coordinator's event — no polling."""
     import ray_tpu
 
     g = _group(group_name)
     seq = _next_seq(g)
-    coord = g["coord"]
     rank = g["info"].rank
-    if rank == src_rank:
-        ray_tpu.get(coord.bcast_put.remote(seq, np.asarray(tensor)))
-        return tensor
-    result = poll(
-        lambda: ray_tpu.get(coord.bcast_try_get.remote(seq, rank)), timeout=timeout
+    payload = np.asarray(tensor) if rank == src_rank else None
+    result = ray_tpu.get(
+        g["coord"].bcast.remote(seq, rank, src_rank, payload, timeout),
+        timeout=timeout + 10.0,
     )
+    if rank == src_rank:
+        return tensor
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result)
         return tensor
@@ -207,7 +284,8 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
 
 def recv(tensor, src_rank: int, group_name: str = "default", timeout: float = 60.0):
     """Point-to-point receive; fills ``tensor`` in place when possible and
-    returns the array (reference ``collective.py:594``)."""
+    returns the array (reference ``collective.py:594``). Blocks on the
+    coordinator's mailbox event — no polling."""
     import ray_tpu
 
     g = _group(group_name)
@@ -217,9 +295,9 @@ def recv(tensor, src_rank: int, group_name: str = "default", timeout: float = 60
     key = (src_rank, rank)
     seq = g["p2p_seq"].get(key, 0)
     g["p2p_seq"][key] = seq + 1
-    result = poll(
-        lambda: ray_tpu.get(g["coord"].p2p_try_get.remote(src_rank, rank, seq)),
-        timeout=timeout,
+    result = ray_tpu.get(
+        g["coord"].p2p_get.remote(src_rank, rank, seq, timeout),
+        timeout=timeout + 10.0,
     )
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result)

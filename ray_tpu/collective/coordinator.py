@@ -1,40 +1,52 @@
-"""Rendezvous + host-side collective coordinator actor.
+"""Rendezvous + host-side collective coordinator (EVENT-driven).
 
 The reference rendezvouses NCCL communicators through a named actor holding
 the unique id (``util/collective/collective_group/nccl_collective_group.py:
-28-77`` NCCLUniqueIDStore); data then flows over NCCL. On TPU the *device*
-tensor plane is compiled XLA collectives over ICI — host-side collectives
-(small CPU tensors, control data) flow through this named coordinator actor
-instead, riding the shared-memory object plane.
+28-77`` NCCLUniqueIDStore) and moves host-side payloads over gloo
+(``gloo_collective_group.py``). On TPU the *device* tensor plane is compiled
+XLA collectives over ICI; host-side collectives flow through this named
+ASYNC actor instead.
 
-One coordinator actor per group, named ``collective://<group>``. All methods
-are non-blocking (the actor single-threads them); members poll ``try_*``
-methods. Sequence numbers order successive collectives on the same group.
+Round 2 had members busy-polling ``try_*`` methods every 2ms and funneling
+every byte through the coordinator. Now:
+
+* every operation is one BLOCKING call on an asyncio actor — the awaiting
+  side parks on an ``asyncio.Event`` and is woken by the arriving peer
+  (pushed notification, zero polling anywhere);
+* small payloads ride the call itself; bulk payloads travel as ObjectRefs
+  whose bytes move peer-to-peer through the object plane (shm locally, the
+  data plane across hosts) — the coordinator shuttles only refs, so no
+  single process handles O(world) bytes (see collective._ring_allreduce).
 """
 
 from __future__ import annotations
 
-import time
+import asyncio
 from typing import Any, Optional
 
 
 class CollectiveCoordinator:
-    """State machine for one collective group's host-side ops."""
+    """Async state machine for one collective group's host-side ops.
+
+    Runs on the asyncio actor engine (single loop thread): state mutations
+    are loop-serialized, waits are real ``asyncio.Event`` parks."""
 
     def __init__(self, group_name: str, world_size: int):
         self.group_name = group_name
         self.world_size = world_size
         self.joined: set[int] = set()
-        # (kind, seq) -> {"parts": {rank: payload}, "result": Any, "taken": set}
+        # (kind, seq) -> {"parts": {rank: payload}, "result": Any,
+        #                 "taken": set, "event": asyncio.Event}
         self.slots: dict[tuple, dict] = {}
-        # point-to-point mailboxes: (src, dst, seq) -> payload
+        # arbitrary-key mailboxes: key -> payload, with a waker per key
         self.mail: dict[tuple, Any] = {}
+        self._mail_events: dict[tuple, asyncio.Event] = {}
 
-    def join(self, rank: int) -> int:
+    async def join(self, rank: int) -> int:
         self.joined.add(rank)
         return self.world_size
 
-    def ready(self) -> bool:
+    async def ready(self) -> bool:
         return len(self.joined) >= self.world_size
 
     # ------------------------------------------------------------- fan-in ops
@@ -42,27 +54,43 @@ class CollectiveCoordinator:
     def _slot(self, key: tuple) -> dict:
         s = self.slots.get(key)
         if s is None:
-            s = self.slots[key] = {"parts": {}, "result": None, "taken": set()}
+            s = self.slots[key] = {
+                "parts": {},
+                "result": None,
+                "taken": set(),
+                "event": asyncio.Event(),
+            }
         return s
 
-    def put_part(self, kind: str, seq: int, rank: int, payload) -> None:
-        self._slot((kind, seq))["parts"][rank] = payload
-
-    def try_collect(self, kind: str, seq: int, rank: int, op: Optional[str] = None):
-        """Returns ``(True, result)`` once all ranks contributed, else
-        ``(False, None)``. The result is computed once and cached; the slot is
-        freed when every rank has taken it."""
+    async def collect(
+        self, kind: str, seq: int, rank: int, payload, op: Optional[str] = None,
+        timeout: float = 60.0,
+    ):
+        """Contribute this rank's part and block until every rank has
+        contributed; returns the combined result. The last arriver computes
+        the result once and wakes the rest."""
         key = (kind, seq)
-        s = self.slots.get(key)
-        if s is None or len(s["parts"]) < self.world_size:
-            return False, None
-        if s["result"] is None:
+        s = self._slot(key)
+        s["parts"][rank] = payload
+        if len(s["parts"]) >= self.world_size:
             s["result"] = self._reduce(kind, s["parts"], op)
+            s["event"].set()
+        else:
+            try:
+                await asyncio.wait_for(s["event"].wait(), timeout=timeout)
+            except asyncio.TimeoutError:
+                # withdraw: this rank won't take the result, and a slot of
+                # orphaned payloads must not outlive the op on a DETACHED
+                # actor (it would leak for the life of the cluster)
+                s["parts"].pop(rank, None)
+                if not s["parts"]:
+                    self.slots.pop(key, None)
+                raise
         s["taken"].add(rank)
         result = s["result"]
         if len(s["taken"]) >= self.world_size:
             del self.slots[key]
-        return True, result
+        return result
 
     def _reduce(self, kind: str, parts: dict[int, Any], op: Optional[str]):
         from ray_tpu.collective.types import ReduceOp
@@ -70,7 +98,7 @@ class CollectiveCoordinator:
         ordered = [parts[r] for r in range(self.world_size)]
         if kind == "allgather":
             return ordered
-        if kind == "barrier":
+        if kind in ("barrier", "ring_done"):
             return True
         if kind in ("allreduce", "reducescatter"):
             rop = ReduceOp(op or "sum")
@@ -84,43 +112,61 @@ class CollectiveCoordinator:
             return acc
         raise ValueError(f"unknown collective kind {kind!r}")
 
+    # ------------------------------------------------------------- mailboxes
+
+    def _mail_event(self, key: tuple) -> asyncio.Event:
+        ev = self._mail_events.get(key)
+        if ev is None:
+            ev = self._mail_events[key] = asyncio.Event()
+        return ev
+
+    async def mail_put(self, key: tuple, payload) -> None:
+        self.mail[tuple(key)] = payload
+        self._mail_event(tuple(key)).set()
+
+    async def mail_take(self, key: tuple, timeout: float = 60.0):
+        key = tuple(key)
+        try:
+            await asyncio.wait_for(self._mail_event(key).wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            # nobody will ever take this mailbox: drop the event AND any
+            # payload that lands in the race, or it leaks on the detached actor
+            self._mail_events.pop(key, None)
+            self.mail.pop(key, None)
+            raise
+        self._mail_events.pop(key, None)
+        return self.mail.pop(key)
+
     # ----------------------------------------------------------- broadcast
 
-    def bcast_put(self, seq: int, payload) -> None:
-        self._slot(("broadcast", seq))["result"] = payload
-
-    def bcast_try_get(self, seq: int, rank: int):
+    async def bcast(self, seq: int, rank: int, src: int, payload=None,
+                    timeout: float = 60.0):
         key = ("broadcast", seq)
-        s = self.slots.get(key)
-        if s is None or s["result"] is None:
-            return False, None
+        s = self._slot(key)
+        if rank == src:
+            s["result"] = payload
+            s["event"].set()
+            taken_target = self.world_size - 1  # root doesn't fetch
+            if len(s["taken"]) >= taken_target:
+                del self.slots[key]
+            return None
+        try:
+            await asyncio.wait_for(s["event"].wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            s["taken"].add(rank)  # won't fetch; let the slot drain
+            if len(s["taken"]) >= self.world_size - 1:
+                self.slots.pop(key, None)
+            raise
         s["taken"].add(rank)
         result = s["result"]
-        if len(s["taken"]) >= self.world_size - 1:  # root doesn't fetch
-            del self.slots[key]
-        return True, result
+        if len(s["taken"]) >= self.world_size - 1:
+            self.slots.pop(key, None)
+        return result
 
     # -------------------------------------------------------- point-to-point
 
-    def p2p_put(self, src: int, dst: int, seq: int, payload) -> None:
-        self.mail[(src, dst, seq)] = payload
+    async def p2p_put(self, src: int, dst: int, seq: int, payload) -> None:
+        await self.mail_put(("p2p", src, dst, seq), payload)
 
-    def p2p_try_get(self, src: int, dst: int, seq: int):
-        key = (src, dst, seq)
-        if key in self.mail:
-            return True, self.mail.pop(key)
-        return False, None
-
-
-def poll(fn, timeout: float = 60.0, interval: float = 0.002):
-    """Client-side poll helper: call ``fn()`` (returning (done, value)) until
-    done or timeout."""
-    deadline = time.monotonic() + timeout
-    while True:
-        done, value = fn()
-        if done:
-            return value
-        if time.monotonic() > deadline:
-            raise TimeoutError("collective operation timed out")
-        time.sleep(interval)
-        interval = min(interval * 1.5, 0.05)
+    async def p2p_get(self, src: int, dst: int, seq: int, timeout: float = 60.0):
+        return await self.mail_take(("p2p", src, dst, seq), timeout=timeout)
