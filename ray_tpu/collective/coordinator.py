@@ -41,6 +41,8 @@ class CollectiveCoordinator:
         # arbitrary-key mailboxes: key -> payload, with a waker per key
         self.mail: dict[tuple, Any] = {}
         self._mail_events: dict[tuple, asyncio.Event] = {}
+        # keys whose taker timed out: a late put is dropped, not stored
+        self._mail_dead: set[tuple] = set()
 
     async def join(self, rank: int) -> int:
         self.joined.add(rank)
@@ -121,18 +123,29 @@ class CollectiveCoordinator:
         return ev
 
     async def mail_put(self, key: tuple, payload) -> None:
-        self.mail[tuple(key)] = payload
-        self._mail_event(tuple(key)).set()
+        key = tuple(key)
+        if key in self._mail_dead:
+            # the taker already timed out and tombstoned this key: drop the
+            # payload, or it (and any ObjectRef it pins) would leak on the
+            # detached actor forever
+            self._mail_dead.discard(key)
+            return
+        self.mail[key] = payload
+        self._mail_event(key).set()
 
     async def mail_take(self, key: tuple, timeout: float = 60.0):
         key = tuple(key)
         try:
             await asyncio.wait_for(self._mail_event(key).wait(), timeout=timeout)
         except asyncio.TimeoutError:
-            # nobody will ever take this mailbox: drop the event AND any
-            # payload that lands in the race, or it leaks on the detached actor
+            # nobody will ever take this mailbox: drop the event, drop any
+            # payload that landed in the race, and tombstone the key so a
+            # LATE put is discarded instead of recreating the entry
             self._mail_events.pop(key, None)
             self.mail.pop(key, None)
+            self._mail_dead.add(key)
+            while len(self._mail_dead) > 4096:
+                self._mail_dead.pop()
             raise
         self._mail_events.pop(key, None)
         return self.mail.pop(key)

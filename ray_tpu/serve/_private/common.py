@@ -48,6 +48,10 @@ class DeploymentSpec:
     init_kwargs: dict = dataclasses.field(default_factory=dict)
     config: DeploymentConfig = dataclasses.field(default_factory=DeploymentConfig)
     is_ingress: bool = False
+    #: the user callable (or its __call__) is a generator function: HTTP
+    #: responses stream chunk-by-chunk over the streaming-generator return
+    #: path (reference: serve StreamingResponse over ASGI)
+    streaming: bool = False
 
 
 @dataclasses.dataclass

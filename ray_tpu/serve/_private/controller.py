@@ -48,10 +48,26 @@ class ServeController:
         self._apps: dict[str, list[str]] = {}   # app -> deployment names
         self._ingress: dict[str, str] = {}      # app -> ingress deployment
         self._version = 0
+        # long-poll push (reference: _private/long_poll.py LongPollHost):
+        # routers park in poll_replicas on this condition and are woken by
+        # every version bump — zero steady-state pulls
+        self._version_cv = threading.Condition(self._lock)
+        self.replica_pulls = 0  # get_replicas calls (tests assert no polling)
         self._proxy = None
+        self._proxies: dict[str, tuple] = {}  # node_id hex -> (actor, port)
+        self._proxy_req_port: Optional[int] = None
+        # serializes _ensure_proxies: ensure_proxy (serve.run) racing the
+        # reconcile thread once created TWO proxies for one node — the dict
+        # overwrite dropped the first proxy's only handle, and the head
+        # reaps handle-less actors, killing it mid-request
+        self._proxy_mutex = threading.Lock()
         self._shutdown = False
         self._reconciler = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._reconciler.start()
+
+    def _bump_version_locked(self) -> None:
+        self._version += 1
+        self._version_cv.notify_all()
 
     # -- deploy API --------------------------------------------------------
 
@@ -77,7 +93,7 @@ class ServeController:
                     self._deployments[spec.name] = _DeploymentState(spec)
                 if spec.is_ingress:
                     self._ingress[app_name] = spec.name
-            self._version += 1
+            self._bump_version_locked()
         self._reconcile_once()
         return True
 
@@ -86,7 +102,7 @@ class ServeController:
             for name in self._apps.pop(app_name, []):
                 self._stop_deployment(name)
             self._ingress.pop(app_name, None)
-            self._version += 1
+            self._bump_version_locked()
         return True
 
     def _stop_deployment(self, name: str):
@@ -107,14 +123,38 @@ class ServeController:
         """(version, [actor handles], max_ongoing) — routers cache and
         re-pull on change; max_ongoing is the per-replica admission cap."""
         with self._lock:
-            state = self._deployments.get(deployment_name)
-            if state is None:
-                return self._version, [], 1
-            return (
-                self._version,
-                [r.actor for r in state.replicas if r.healthy],
-                max(state.spec.config.max_ongoing_requests, 1),
-            )
+            self.replica_pulls += 1
+            return self._replicas_locked(deployment_name)
+
+    def _replicas_locked(self, deployment_name: str) -> tuple[int, list, int]:
+        state = self._deployments.get(deployment_name)
+        if state is None:
+            return self._version, [], 1
+        return (
+            self._version,
+            [r.actor for r in state.replicas if r.healthy],
+            max(state.spec.config.max_ongoing_requests, 1),
+        )
+
+    def poll_replicas(
+        self, deployment_name: str, known_version: int, timeout: float = 25.0
+    ) -> tuple[int, list, int]:
+        """Long-poll push (reference: _private/long_poll.py): parks until
+        the config version moves past ``known_version`` (or the timeout
+        heartbeats), then returns the fresh replica set. Routers keep one
+        of these outstanding instead of polling get_replicas — requires the
+        controller actor's max_concurrency to cover the router count."""
+        deadline = time.time() + timeout
+        with self._lock:
+            while self._version == known_version and not self._shutdown:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._version_cv.wait(remaining)
+            return self._replicas_locked(deployment_name)
+
+    def get_pull_count(self) -> int:
+        return self.replica_pulls
 
     def get_version(self) -> int:
         return self._version
@@ -150,19 +190,76 @@ class ServeController:
     # -- HTTP proxy --------------------------------------------------------
 
     def ensure_proxy(self, port: int) -> int:
+        """One ProxyActor per ALIVE node (reference: serve runs an HTTP
+        proxy on every node; any proxy routes to any replica). The first
+        node's proxy takes the requested port; the rest bind ephemeral
+        ports (same-host test clusters can't share one). The reconcile loop
+        keeps the set in sync as nodes come and go."""
+        self._proxy_req_port = port
+        self._ensure_proxies()
         with self._lock:
-            if self._proxy is None:
-                import ray_tpu
-                from ray_tpu.serve._private.proxy import ProxyActor
+            ports = [p for _, p in self._proxies.values()]
+            return ports[0] if ports else -1
 
-                cls = ray_tpu.remote(ProxyActor)
-                self._proxy = cls.options(max_concurrency=128).remote(port)
-                self._proxy_port = ray_tpu.get(self._proxy.ready.remote())
-            return self._proxy_port
+    def _ensure_proxies(self) -> None:
+        with self._proxy_mutex:
+            self._ensure_proxies_serialized()
+
+    def _ensure_proxies_serialized(self) -> None:
+        import ray_tpu
+        from ray_tpu.serve._private.proxy import ProxyActor
+        from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+        if self._proxy_req_port is None:
+            return
+        try:
+            nodes = {n["NodeID"]: n for n in ray_tpu.nodes() if n.get("Alive", True)}
+        except Exception:
+            return
+        with self._lock:
+            current = dict(self._proxies)
+        # drop proxies on dead nodes
+        for nid in list(current):
+            if nid not in nodes:
+                with self._lock:
+                    self._proxies.pop(nid, None)
+        # add proxies on new nodes
+        for nid in nodes:
+            if nid in current:
+                continue
+            want = self._proxy_req_port if not current and not self._proxies else 0
+            cls = ray_tpu.remote(num_cpus=0)(ProxyActor)
+            try:
+                actor = cls.options(
+                    max_concurrency=128,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True),
+                ).remote(want)
+                p = ray_tpu.get(actor.ready.remote(), timeout=60)
+            except Exception:
+                continue
+            with self._lock:
+                self._proxies[nid] = (actor, p)
 
     def get_proxy_port(self) -> Optional[int]:
         with self._lock:
-            return getattr(self, "_proxy_port", None)
+            ports = [p for _, p in self._proxies.values()]
+            return ports[0] if ports else None
+
+    def get_proxy_ports(self) -> dict:
+        """node_id hex -> port, one per alive node."""
+        with self._lock:
+            return {nid: p for nid, (_, p) in self._proxies.items()}
+
+    def get_ingress_info(self, app_name: str) -> Optional[dict]:
+        with self._lock:
+            name = self._ingress.get(app_name)
+            if name is None:
+                return None
+            state = self._deployments.get(name)
+            return {
+                "deployment": name,
+                "streaming": bool(state and getattr(state.spec, "streaming", False)),
+            }
 
     # -- reconciliation ----------------------------------------------------
 
@@ -170,6 +267,10 @@ class ServeController:
         while not self._shutdown:
             try:
                 self._reconcile_once()
+            except Exception:
+                pass
+            try:
+                self._ensure_proxies()  # nodes come and go; proxies follow
             except Exception:
                 pass
             time.sleep(RECONCILE_PERIOD_S)
@@ -192,12 +293,12 @@ class ServeController:
                 dead = [r for r in state.replicas if not r.healthy]
                 if dead:
                     state.replicas = [r for r in state.replicas if r.healthy]
-                    self._version += 1
+                    self._bump_version_locked()
                 # start missing
                 missing = state.target_replicas - len(state.replicas)
                 for _ in range(max(0, missing)):
                     self._start_replica(state)
-                    self._version += 1
+                    self._bump_version_locked()
                 # stop excess (highest-index first)
                 excess = len(state.replicas) - state.target_replicas
                 for _ in range(max(0, excess)):
@@ -206,7 +307,7 @@ class ServeController:
                         ray_tpu.kill(victim.actor)
                     except Exception:
                         pass
-                    self._version += 1
+                    self._bump_version_locked()
 
     def _start_replica(self, state: _DeploymentState):
         import ray_tpu
@@ -284,13 +385,18 @@ class ServeController:
                 for name in self._apps[app]:
                     self._stop_deployment(name)
             self._apps.clear()
-            if self._proxy is not None:
-                try:
-                    ray_tpu.get(self._proxy.stop.remote(), timeout=5)
-                    ray_tpu.kill(self._proxy)
-                except Exception:
-                    pass
-                self._proxy = None
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._proxy_req_port = None
+        for actor, _port in proxies:
+            try:
+                ray_tpu.get(actor.stop.remote(), timeout=5)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
         return True
 
     def check_health(self) -> bool:

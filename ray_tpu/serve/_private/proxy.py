@@ -2,12 +2,16 @@
 
 Reference: ``serve/_private/proxy.py:1115`` (ProxyActor per node wrapping an
 HTTP server that resolves routes to app ingress deployments and awaits the
-handle response). stdlib ``ThreadingHTTPServer`` here — one thread per
-in-flight request, each blocking on its DeploymentResponse; JSON in/out.
+handle response; ``proxy.py:759`` streams ASGI responses). stdlib
+``ThreadingHTTPServer`` here — one thread per in-flight request, each
+blocking on its DeploymentResponse. The controller runs one ProxyActor per
+alive node; any proxy routes to any replica.
 
-Routes: ``POST/GET /<app_name>`` → the app's ingress deployment. Body (JSON)
-becomes the request payload: the ingress callable is invoked as
-``__call__(payload)``.
+Routes: ``POST/GET /<app_name>`` → the app's ingress deployment, invoked as
+``__call__(payload)``. Bodies: JSON stays JSON, ``text/*`` arrives as str,
+anything else as raw bytes; responses mirror (bytes → octet-stream, str →
+text/plain, else JSON). Generator ingress deployments stream chunked
+(one chunk per yielded item, via ``num_returns="streaming"``).
 """
 
 from __future__ import annotations
@@ -26,27 +30,88 @@ class ProxyActor:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # chunked responses need 1.1
+
             def log_message(self, *args):  # quiet
                 pass
+
+            def _read_payload(self):
+                """JSON stays JSON; anything else arrives as raw bytes
+                (reference: the ASGI proxy hands the body through; JSON is a
+                convenience, not a requirement)."""
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+                if not raw:
+                    return None
+                if ctype in ("", "application/json"):
+                    return json.loads(raw)
+                if ctype.startswith("text/"):
+                    return raw.decode()
+                return raw
+
+            def _send_body(self, code: int, body, ctype=None):
+                if isinstance(body, (bytes, bytearray, memoryview)):
+                    data = bytes(body)
+                    ctype = ctype or "application/octet-stream"
+                elif isinstance(body, str):
+                    data = body.encode()
+                    ctype = ctype or "text/plain; charset=utf-8"
+                else:
+                    data = json.dumps(body).encode()
+                    ctype = ctype or "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_stream(self, items):
+                """Chunked transfer: one chunk per generator item as it is
+                produced (bytes raw; anything else NDJSON). Errors after the
+                200 header cannot become a second response — log and drop
+                the connection so the client sees a clean truncation."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for item in items:
+                        if isinstance(item, (bytes, bytearray, memoryview)):
+                            chunk(bytes(item))
+                        else:
+                            chunk((json.dumps(item) + "\n").encode())
+                    self.wfile.write(b"0\r\n\r\n")
+                except BaseException:  # noqa: BLE001
+                    # swallow: a second HTTP response injected into an open
+                    # chunked stream would corrupt the framing — log and
+                    # drop the connection (clean truncation for the client)
+                    import traceback
+
+                    print("[serve-proxy] streaming response failed:", flush=True)
+                    traceback.print_exc()
+                    self.close_connection = True
 
             def _dispatch(self):
                 try:
                     app = self.path.strip("/").split("/")[0] or "default"
-                    length = int(self.headers.get("Content-Length") or 0)
-                    payload = json.loads(self.rfile.read(length)) if length else None
-                    result = proxy._route(app, payload)
-                    body = json.dumps(result).encode()
-                    self.send_response(200)
+                    payload = self._read_payload()
+                    handle, streaming = proxy._handle_for(app)
+                    if streaming:
+                        resp = handle.options(stream=True).remote(payload)
+                        self._send_stream(resp)
+                        return
+                    result = handle.remote(payload).result(timeout=60)
+                    self._send_body(200, result)
                 except KeyError as e:
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(404)
+                    self._send_body(404, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001
-                    body = json.dumps({"error": repr(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    self._send_body(500, {"error": repr(e)})
 
             do_GET = _dispatch
             do_POST = _dispatch
@@ -61,19 +126,19 @@ class ProxyActor:
         self._thread.start()
         self._handles: dict[str, object] = {}
 
-    def _route(self, app: str, payload):
+    def _handle_for(self, app: str):
         import ray_tpu
         from ray_tpu.serve.handle import DeploymentHandle
 
-        handle = self._handles.get(app)
-        if handle is None:
+        ent = self._handles.get(app)
+        if ent is None:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            ingress = ray_tpu.get(controller.get_ingress.remote(app), timeout=30)
-            if ingress is None:
+            info = ray_tpu.get(controller.get_ingress_info.remote(app), timeout=30)
+            if info is None:
                 raise KeyError(f"no app {app!r}")
-            handle = DeploymentHandle(ingress)
-            self._handles[app] = handle
-        return handle.remote(payload).result(timeout=60)
+            ent = (DeploymentHandle(info["deployment"]), bool(info["streaming"]))
+            self._handles[app] = ent
+        return ent
 
     def ready(self) -> int:
         return self.port

@@ -50,6 +50,44 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict, model_id=None):
+        """Generator variant: invoked with ``num_returns="streaming"`` so
+        every yielded item becomes its own object as it is produced
+        (reference: serve streaming responses over generator returns).
+        Ongoing-count spans the WHOLE stream (admission control sees a
+        streaming request as occupying its slot until exhausted)."""
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _set_request_model_id(model_id)
+        try:
+            target = self._callable if method == "__call__" else getattr(self._callable, method)
+            out = target(*args, **kwargs)
+            import inspect
+
+            if inspect.isasyncgen(out):
+                # async-generator deployments stream too: drive the agen on
+                # a private loop, yielding each item into the sync stream
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            yield loop.run_until_complete(out.__anext__())
+                        except StopAsyncIteration:
+                            break
+                finally:
+                    loop.close()
+            else:
+                yield from out
+        finally:
+            _set_request_model_id(None)
+            with self._lock:
+                self._ongoing -= 1
+
     # -- control plane -----------------------------------------------------
 
     def reconfigure(self, user_config) -> bool:

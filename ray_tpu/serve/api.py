@@ -26,7 +26,17 @@ DEFAULT_HTTP_PORT = 8000
 
 
 def _wrap_function(fn: Callable) -> type:
-    """Function deployments become single-method callables."""
+    """Function deployments become single-method callables. A generator
+    function keeps its generator-ness (the wrapper yields from it) so
+    streaming detection in _collect_specs sees through the wrapper."""
+    if inspect.isgeneratorfunction(fn):
+
+        class _GenFuncDeployment:
+            def __call__(self, *args, **kwargs):
+                yield from fn(*args, **kwargs)
+
+        _GenFuncDeployment.__name__ = getattr(fn, "__name__", "func")
+        return _GenFuncDeployment
 
     class _FuncDeployment:
         def __call__(self, *args, **kwargs):
@@ -123,7 +133,9 @@ def _get_or_start_controller():
         # serve's controller is a detached named actor)
         controller = cls.options(
             name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
-            max_concurrency=16,
+            # generous: every router parks ONE long-poll here (long_poll
+            # push, controller.poll_replicas) on top of regular control calls
+            max_concurrency=256,
         ).remote()
         ray_tpu.get(controller.check_health.remote(), timeout=60)
         return controller
@@ -148,13 +160,17 @@ def _collect_specs(app: Application, app_name: str) -> tuple[list[DeploymentSpec
 
         args = tuple(resolve(a) for a in node.args)
         kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        cls = node.deployment.callable_cls
+        call = getattr(cls, "__call__", None) if inspect.isclass(cls) else cls
+        streaming = inspect.isgeneratorfunction(call) or inspect.isasyncgenfunction(call)
         specs[key] = DeploymentSpec(
             name=dep_name,
             app_name=app_name,
-            callable_factory=node.deployment.callable_cls,
+            callable_factory=cls,
             init_args=args,
             init_kwargs=kwargs,
             config=node.deployment.config,
+            streaming=streaming,
         )
         return DeploymentHandle(dep_name)
 

@@ -86,7 +86,8 @@ class _Router:
         self._inflight: list[int] = []
         self._max_ongoing = 1
         self._version = -1
-        self._last_refresh = 0.0
+        self._poll_thread: Optional[threading.Thread] = None
+        self._closed = False
         # replicas observed dead by THIS router, excluded until the
         # controller publishes a new replica set — immediate failover
         # instead of waiting out the controller's health-check window
@@ -101,18 +102,8 @@ class _Router:
 
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
-    def _refresh(self, force: bool = False):
-        import ray_tpu
-
-        now = time.time()
+    def _apply(self, version, replicas, max_ongoing) -> None:
         with self._lock:
-            if not force and self._replicas and now - self._last_refresh < 0.5:
-                return
-        version, replicas, max_ongoing = ray_tpu.get(
-            self._controller().get_replicas.remote(self.deployment_name), timeout=30
-        )
-        with self._lock:
-            self._last_refresh = now
             self._max_ongoing = max_ongoing
             if version != self._version:
                 self._version = version
@@ -123,6 +114,50 @@ class _Router:
                 # the controller published a NEW replica set since the
                 # exclusions were recorded — they no longer apply
                 self._excluded.clear()
+
+    def _refresh(self, force: bool = False):
+        """One synchronous pull — used at router birth and after drop()
+        (observed replica death). Steady-state updates arrive PUSHED via
+        the long-poll thread; nothing here runs per request."""
+        import ray_tpu
+
+        with self._lock:
+            if not force and self._replicas:
+                return
+        version, replicas, max_ongoing = ray_tpu.get(
+            self._controller().get_replicas.remote(self.deployment_name), timeout=30
+        )
+        self._apply(version, replicas, max_ongoing)
+        with self._lock:
+            start = self._poll_thread is None
+            if start:  # under the lock: concurrent first requests must not
+                # each park a long-poll on the controller's thread budget
+                self._poll_thread = threading.Thread(
+                    target=self._poll_loop, name="serve-router-longpoll", daemon=True
+                )
+        if start:
+            self._poll_thread.start()
+
+    def _poll_loop(self):
+        """Long-poll push (reference: _private/long_poll.py client): one
+        outstanding poll_replicas call parks on the controller until the
+        config version moves — router updates arrive without any periodic
+        version polling."""
+        import ray_tpu
+
+        while not self._closed:
+            try:
+                version, replicas, max_ongoing = ray_tpu.get(
+                    self._controller().poll_replicas.remote(
+                        self.deployment_name, self._real_version, 25.0
+                    ),
+                    timeout=40,
+                )
+                self._apply(version, replicas, max_ongoing)
+            except Exception:
+                if self._closed:
+                    return
+                time.sleep(0.5)  # controller briefly unreachable: back off
 
     def _sticky_pick(self, model_id: str, live: list) -> int:
         """Highest-random-weight over STABLE replica identities: a model's
@@ -208,6 +243,45 @@ class _Router:
             self._replicas = []
 
 
+class StreamingDeploymentResponse:
+    """Iterates a streaming deployment call's items as they are produced
+    (reference: serve's streaming DeploymentResponse over ASGI). Wraps the
+    ObjectRefGenerator from ``num_returns="streaming"``; the router's
+    in-flight slot is held until the stream is exhausted or closed."""
+
+    def __init__(self, gen, router: "_Router", replica_idx: int, replica=None):
+        self._gen = gen
+        self._router = router
+        self._replica_idx = replica_idx
+        self._replica = replica
+        self._done = False
+
+    def __iter__(self):
+        import ray_tpu
+        from ray_tpu.exceptions import RayActorError
+
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref, timeout=60)
+        except RayActorError:
+            # replica died mid-stream: tell the router NOW so new requests
+            # fail over immediately (mirrors DeploymentResponse.result)
+            if self._replica is not None:
+                self._router.mark_failed(self._replica)
+            raise
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router._complete(self._replica_idx)
+            try:
+                self._gen.close()
+            except Exception:
+                pass
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
@@ -218,26 +292,49 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, _model_id: Optional[str] = None):
+    def __init__(
+        self,
+        deployment_name: str,
+        _model_id: Optional[str] = None,
+        _stream: bool = False,
+    ):
         self.deployment_name = deployment_name
         self._router: Optional[_Router] = None
         self._model_id = _model_id
+        self._stream = _stream
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        multiplexed_model_id: Optional[str] = None,
+        stream: Optional[bool] = None,
+    ) -> "DeploymentHandle":
         """A view of this handle with request options (reference:
-        ``handle.options(multiplexed_model_id=...)``). The view SHARES the
-        router (in-flight accounting stays coherent)."""
-        view = DeploymentHandle(self.deployment_name, _model_id=multiplexed_model_id)
+        ``handle.options(multiplexed_model_id=..., stream=...)``). The view
+        SHARES the router (in-flight accounting stays coherent).
+        ``stream=True`` makes ``.remote()`` return a
+        StreamingDeploymentResponse yielding items as the replica's
+        generator produces them."""
+        view = DeploymentHandle(
+            self.deployment_name,
+            _model_id=multiplexed_model_id if multiplexed_model_id is not None else self._model_id,
+            _stream=self._stream if stream is None else stream,
+        )
         view._router = self._get_router()
         return view
 
     # picklability: the router (with live actor handles) stays local
     def __getstate__(self):
-        return {"deployment_name": self.deployment_name, "_model_id": self._model_id}
+        return {
+            "deployment_name": self.deployment_name,
+            "_model_id": self._model_id,
+            "_stream": self._stream,
+        }
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
         self._model_id = state.get("_model_id")
+        self._stream = state.get("_stream", False)
         self._router = None
 
     def _get_router(self) -> _Router:
@@ -277,6 +374,11 @@ class DeploymentHandle:
         for attempt in range(3):
             replica, idx = router.pick(model_id=self._model_id)
             try:
+                if self._stream:
+                    gen = replica.handle_request_streaming.options(
+                        num_returns="streaming"
+                    ).remote(method, args, kwargs, self._model_id)
+                    return StreamingDeploymentResponse(gen, router, idx, replica=replica)
                 if self._model_id:
                     ref = replica.handle_request.remote(
                         method, args, kwargs, self._model_id
