@@ -53,6 +53,22 @@ class ConnectorPipeline(Connector):
             data = s.transform(data)
         return data
 
+    def observe_dones(self, done) -> None:
+        for s in self.stages:
+            fn = getattr(s, "observe_dones", None)
+            if fn is not None:
+                fn(done)
+
+    def transform_final(self, data):
+        """Transform a transition's true NEXT_OBS: stateless stages use
+        transform; stateful frame stages use their non-mutating ``peek``
+        (the stack the slot would have) — call before the post-step
+        __call__/observe_dones."""
+        for s in self.stages:
+            peek = getattr(s, "peek", None)
+            data = peek(data) if peek is not None else s.transform(data)
+        return data
+
     def get_state(self) -> dict:
         return {i: s.get_state() for i, s in enumerate(self.stages)}
 
@@ -127,6 +143,127 @@ class NormalizeObservations(Connector):
         self._count = state["count"]
         self._mean = state["mean"]
         self._m2 = state["m2"]
+
+
+# -- frame pipeline (Atari-style pixel preprocessing) ------------------------
+
+
+class GrayscaleObservations(Connector):
+    """(N, H, W, 3) RGB → (N, H, W) luma (reference: the Atari wrapper
+    stack's grayscale stage; ITU-R 601 weights)."""
+
+    _W = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, obs):
+        return self.transform(obs)
+
+    def transform(self, obs):
+        obs = np.asarray(obs, np.float32)
+        return obs @ self._W
+
+
+class ResizeObservations(Connector):
+    """Nearest-neighbor spatial resize of (N, H, W[, C]) frames — pure
+    numpy (no cv2 in the image), exact enough for RL preprocessing."""
+
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def __call__(self, obs):
+        return self.transform(obs)
+
+    def transform(self, obs):
+        obs = np.asarray(obs)
+        H, W = obs.shape[1], obs.shape[2]
+        rows = (np.arange(self.h) * H // self.h).clip(0, H - 1)
+        cols = (np.arange(self.w) * W // self.w).clip(0, W - 1)
+        return obs[:, rows][:, :, cols]
+
+
+class ScaleObservations(Connector):
+    """uint8 pixels → [0, 1] floats."""
+
+    def __init__(self, scale: float = 1.0 / 255.0):
+        self.scale = scale
+
+    def __call__(self, obs):
+        return self.transform(obs)
+
+    def transform(self, obs):
+        return np.asarray(obs, np.float32) * self.scale
+
+
+class FrameStack(Connector):
+    """Stack the last k frames per env slot along a trailing channel axis
+    (reference: the Atari frame-stack wrapper, done connector-side so the
+    module sees (N, H, W, k)).
+
+    Stateful: the env runner notifies episode ends via ``observe_dones`` so
+    a fresh episode's stack starts from its reset frame (replicated), never
+    mixing frames across episodes. ``transform`` (the stateless path, used
+    for shape probes and truncation-bootstrap observations) replicates the
+    single frame k times — exact at episode starts, an approximation
+    elsewhere (termination-style envs never consume it).
+    """
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._stacks: Optional[np.ndarray] = None  # (N, H, W, k)
+        self._pending_reset: Optional[np.ndarray] = None  # bool (N,)
+
+    def __call__(self, obs):
+        obs = np.asarray(obs, np.float32)
+        if obs.ndim == 3:  # (N, H, W) → explicit channel
+            frames = obs[..., None]
+        else:
+            frames = obs
+        n = frames.shape[0]
+        if self._stacks is None or len(self._stacks) != n:
+            self._stacks = np.repeat(frames, self.k, axis=-1)
+        else:
+            if self._pending_reset is not None and self._pending_reset.any():
+                idx = np.nonzero(self._pending_reset)[0]
+                self._stacks[idx] = np.repeat(frames[idx], self.k, axis=-1)
+                keep = ~self._pending_reset
+            else:
+                keep = np.ones(n, bool)
+            idx = np.nonzero(keep)[0]
+            if len(idx):
+                self._stacks[idx] = np.concatenate(
+                    [self._stacks[idx][..., 1:], frames[idx]], axis=-1
+                )
+        self._pending_reset = None
+        return self._stacks.copy()
+
+    def observe_dones(self, done: np.ndarray) -> None:
+        """Called by the env runner right after stepping: the NEXT observed
+        frame for these slots is a reset frame — restart their stacks."""
+        self._pending_reset = np.asarray(done, bool)
+
+    def peek(self, obs):
+        """The stack each slot WOULD have after observing ``obs``, without
+        mutating state — used for a transition's true NEXT_OBS (the
+        ``final`` buffer): current frames slid by one, new frame appended.
+        Must be called BEFORE the post-step __call__ updates the stacks."""
+        obs = np.asarray(obs, np.float32)
+        frames = obs[..., None] if obs.ndim == 3 else obs
+        if self._stacks is None or len(self._stacks) != frames.shape[0]:
+            return np.repeat(frames, self.k, axis=-1)
+        return np.concatenate([self._stacks[..., 1:], frames], axis=-1)
+
+    def transform(self, obs):
+        obs = np.asarray(obs, np.float32)
+        frames = obs[..., None] if obs.ndim == 3 else obs
+        return np.repeat(frames, self.k, axis=-1)
+
+    def get_state(self) -> dict:
+        # per-env stacks are RUNNER-LOCAL episode state: syncing them into
+        # a restarted runner would slide another runner's frames into its
+        # fresh episodes (cross-episode mixing). Nothing to share.
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
 
 
 # -- module -> env ----------------------------------------------------------

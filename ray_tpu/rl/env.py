@@ -134,10 +134,78 @@ class GridWorldEnv(Env):
         )
 
 
+class CatchPixelEnv(Env):
+    """Atari-class pixel control without ALE (not installable here): the
+    classic DeepMind "Catch" game rendered as 84x84x3 uint8 RGB frames —
+    the agent sees raw pixels and must drive the frame-connector pipeline
+    (grayscale → resize → scale → frame-stack) exactly like a Pong setup.
+
+    A ball falls from a random top column; a 3-pixel paddle at the bottom
+    moves {left, stay, right}; reward +1 on catch, -1 on miss; an episode is
+    ``balls`` consecutive drops (score range [-balls, +balls]). Random play
+    averages ≈ -0.6·balls; a solved policy ≈ +balls.
+    """
+
+    SIZE = 21  # logical grid; rendered 4x → 84x84
+    SCALE = 4
+
+    def __init__(self, balls: int = 3):
+        px = self.SIZE * self.SCALE
+        self.observation_space = Box(0, 255, shape=(px, px, 3))
+        self.action_space = Discrete(3)
+        self.spec_max_episode_steps = balls * self.SIZE + 1
+        self.balls = balls
+        self._rng = np.random.default_rng()
+        self._t = 0
+
+    def _render(self) -> np.ndarray:
+        g = np.zeros((self.SIZE, self.SIZE, 3), np.uint8)
+        g[self._ball_r, self._ball_c] = (255, 255, 255)
+        lo = max(self._paddle - 1, 0)
+        hi = min(self._paddle + 1, self.SIZE - 1)
+        g[self.SIZE - 1, lo : hi + 1] = (0, 255, 0)
+        return np.repeat(np.repeat(g, self.SCALE, 0), self.SCALE, 1)
+
+    def _drop(self):
+        self._ball_r = 0
+        self._ball_c = int(self._rng.integers(0, self.SIZE))
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._paddle = self.SIZE // 2
+        self._caught = 0
+        self._t = 0
+        self._drop()
+        return self._render(), {}
+
+    def step(self, action):
+        self._paddle = int(np.clip(self._paddle + (int(action) - 1), 1, self.SIZE - 2))
+        self._ball_r += 1
+        self._t += 1
+        reward = 0.0
+        done = False
+        if self._ball_r >= self.SIZE - 1:
+            reward = 1.0 if abs(self._ball_c - self._paddle) <= 1 else -1.0
+            self._caught += 1
+            if self._caught >= self.balls:
+                done = True
+            else:
+                self._drop()
+        return (
+            self._render(),
+            reward,
+            done,
+            self._t >= self.spec_max_episode_steps,
+            {},
+        )
+
+
 _REGISTRY: dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
     "GridWorld-v0": GridWorldEnv,
+    "CatchPixel-v0": CatchPixelEnv,
 }
 
 

@@ -165,12 +165,26 @@ class EnvRunner:
             env_action = self._act_transform(action)
             buf["env_act"][t] = env_action
             self._obs, rew, term, trunc, final = self.vec.step(env_action)
+            # `final` (each transition's TRUE next obs) transforms FIRST,
+            # against the PRE-step connector state: frame stacks peek the
+            # stack the slot would have — correct NEXT_OBS for off-policy
+            # targets even at episode ends
+            if self._c_obs is not None and hasattr(self._c_obs, "transform_final"):
+                buf["final"][t] = self._c_obs.transform_final(final)
+            else:
+                buf["final"][t] = self._obs_transform(final, update=False)
+            # stateful frame connectors (FrameStack) must learn about
+            # episode ends BEFORE transforming the post-step obs: done
+            # slots' next frame is a reset frame and starts a fresh stack
+            if self._c_obs is not None:
+                fn = getattr(self._c_obs, "observe_dones", None)
+                if fn is not None:
+                    fn(term | trunc)
             # stats-updating transform runs ONCE per step (on the stepped
             # obs); `final` — the same raw data for non-done slots — applies
             # the transform without re-updating running statistics
             self._obs = self._obs_transform(self._obs)
             buf["rew"][t], buf["term"][t], buf["trunc"][t] = rew, term, trunc
-            buf["final"][t] = self._obs_transform(final, update=False)
             self._ep_ret += rew
             self._ep_len += 1
             for i in np.nonzero(term | trunc)[0]:

@@ -63,6 +63,51 @@ class Learner:
         # alias the same cached zero constant, and donating an aliased buffer
         # twice is an XLA error. RL nets are small; donation buys nothing.
         self._update = jax.jit(self._update_impl)
+        self._grads = jax.jit(self._grads_impl)
+        self._apply_tx = jax.jit(self._apply_impl)
+
+    def _apply_impl(self, params, opt_state, grads):
+        import optax
+
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def _grads_impl(self, params, batch):
+        import jax
+
+        def loss_wrap(p):
+            return self._loss_fn(self.module, p, batch)
+
+        (loss, metrics), grads = jax.value_and_grad(loss_wrap, has_aux=True)(params)
+        return grads, {"loss": loss, **metrics}
+
+    def compute_grads(self, batch: SampleBatch):
+        """Gradients of the loss on this learner's batch shard, WITHOUT
+        applying them — the data-parallel LearnerGroup averages shard
+        grads across learners before anyone applies (reference:
+        learner_group.py DDP semantics)."""
+        import jax
+
+        rows = batch.count
+        dev_batch = self._device_batch(batch)
+        grads, metrics = self._grads(self.params, dev_batch)
+        out = {}
+        for k, v in metrics.items():
+            a = np.asarray(v)
+            # same contract as update(): per-sample aux arrays (e.g. DQN
+            # |td| for prioritized replay) pass through, padding trimmed
+            out[k] = float(a) if a.ndim == 0 else a[:rows]
+        return jax.device_get(grads), out
+
+    def apply_grads(self, grads) -> bool:
+        import jax
+
+        if self._sharding is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.device_put(g, self._replicated), grads
+            )
+        self.params, self.opt_state = self._apply_tx(self.params, self.opt_state, grads)
+        return True
 
     def _update_impl(self, params, opt_state, batch):
         import jax
@@ -128,13 +173,37 @@ class LearnerGroup:
     env runners and the driver never contend with the update stream.
     """
 
-    def __init__(self, learner_kwargs: dict, remote: bool = False, num_cpus: float = 1):
-        self._remote = remote
-        if remote:
+    def __init__(
+        self,
+        learner_kwargs: dict,
+        remote: bool = False,
+        num_cpus: float = 1,
+        num_learners: int = 1,
+    ):
+        self._remote = remote or num_learners > 1
+        self._actors: list = []
+        if num_learners > 1:
+            # data-parallel learners (reference: learner_group.py:71 N
+            # DDP-wrapped learners): every learner initializes IDENTICAL
+            # params from the shared seed, each update computes gradients
+            # on its batch shard, the group averages (sample-weighted) and
+            # every learner applies the SAME averaged update — weights stay
+            # bit-identical across learners, exactly like DDP.
+            import ray_tpu
+
+            cls = ray_tpu.remote(Learner)
+            self._actors = [
+                cls.options(num_cpus=num_cpus).remote(**learner_kwargs)
+                for _ in range(num_learners)
+            ]
+            self._actor = self._actors[0]
+            self._local = None
+        elif remote:
             import ray_tpu
 
             cls = ray_tpu.remote(Learner)
             self._actor = cls.options(num_cpus=num_cpus).remote(**learner_kwargs)
+            self._actors = [self._actor]
             self._local = None
         else:
             self._actor = None
@@ -145,7 +214,48 @@ class LearnerGroup:
             return self._local.update(batch)
         import ray_tpu
 
+        if len(self._actors) > 1:
+            return self._update_data_parallel(batch)
         return ray_tpu.get(self._actor.update.remote(batch))
+
+    def _update_data_parallel(self, batch: SampleBatch) -> dict:
+        import jax
+        import ray_tpu
+
+        k = len(self._actors)
+        n = batch.count
+        bounds = [round(i * n / k) for i in range(k + 1)]
+        # a 0-row shard would mean a loss over zero elements → NaN grads
+        # that no zero weight can neutralize (0·NaN = NaN): only learners
+        # with actual rows compute this round; EVERY learner still applies
+        # the same averaged update (lockstep invariant)
+        work = [
+            (a, batch.slice(lo, hi), (hi - lo) / max(n, 1))
+            for a, lo, hi in zip(self._actors, bounds, bounds[1:])
+            if hi > lo
+        ]
+        grad_refs = [a.compute_grads.remote(s) for a, s, _w in work]
+        results = ray_tpu.get(grad_refs)
+        weights = [w for _a, _s, w in work]
+        # sample-weighted average == the full-batch gradient of a mean loss
+        avg = jax.tree_util.tree_map(
+            lambda *gs: sum(w * g for w, g in zip(weights, gs)),
+            *[g for g, _m in results],
+        )
+        ray_tpu.get([a.apply_grads.remote(avg) for a in self._actors])
+        metrics: dict = {}
+        arrays: dict = {}
+        for w, (_g, m) in zip(weights, results):
+            for key, v in m.items():
+                if np.ndim(v) == 0:
+                    metrics[key] = metrics.get(key, 0.0) + w * float(v)
+                else:
+                    arrays.setdefault(key, []).append(np.asarray(v))
+        for key, parts in arrays.items():
+            # per-sample aux (e.g. DQN |td|) re-assembles in shard order so
+            # prioritized-replay priority updates keep working under DP
+            metrics[key] = np.concatenate(parts)
+        return metrics
 
     def get_weights(self):
         if self._local is not None:
@@ -159,20 +269,22 @@ class LearnerGroup:
             return self._local.set_weights(params)
         import ray_tpu
 
-        return ray_tpu.get(self._actor.set_weights.remote(params))
+        # all learners must stay in lockstep (DDP invariant)
+        return ray_tpu.get([a.set_weights.remote(params) for a in self._actors])[0]
 
     def apply(self, fn: Callable, *args):
         if self._local is not None:
             return self._local.apply(fn, *args)
         import ray_tpu
 
-        return ray_tpu.get(self._actor.apply.remote(fn, *args))
+        # e.g. target-net sync: runs on EVERY learner; rank0's result returns
+        return ray_tpu.get([a.apply.remote(fn, *args) for a in self._actors])[0]
 
     def shutdown(self):
-        if self._actor is not None:
-            import ray_tpu
+        import ray_tpu
 
+        for a in self._actors:
             try:
-                ray_tpu.kill(self._actor)
+                ray_tpu.kill(a)
             except Exception:
                 pass

@@ -48,35 +48,90 @@ class RLModuleSpec:
     action_space: Space
     hidden: tuple = (64, 64)
     free_log_std: bool = True  # continuous: state-independent log-std
+    #: (out_channels, kernel, stride) per conv layer — used automatically
+    #: when the observation space is rank-3 (H, W, C) pixels. Convs are the
+    #: MXU-native encoder for Atari-class inputs (reference: rllib's
+    #: Atari CNN defaults, scaled for small frames).
+    conv_filters: tuple = ((16, 4, 2), (32, 4, 2))
+
+
+def _cnn_init(rng, in_ch: int, filters) -> list:
+    params = []
+    keys = jax.random.split(rng, len(filters))
+    ch = in_ch
+    for k, (out_ch, ksz, _stride) in zip(keys, filters):
+        fan_in = ksz * ksz * ch
+        params.append(
+            {
+                "w": jax.random.normal(k, (ksz, ksz, ch, out_ch), jnp.float32)
+                * np.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((out_ch,), jnp.float32),
+            }
+        )
+        ch = out_ch
+    return params
+
+
+def _cnn_apply(params: list, x: jax.Array, filters) -> jax.Array:
+    """NHWC conv stack → flat features (SAME padding, ReLU)."""
+    for p, (_out, _k, stride) in zip(params, filters):
+        x = jax.lax.conv_general_dilated(
+            x, p["w"], (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + p["b"]
+        x = jax.nn.relu(x)
+    return x.reshape(x.shape[0], -1)
 
 
 class ActorCriticModule:
-    """Shared-nothing actor + critic MLPs; discrete (categorical) or
-    continuous (diagonal gaussian) heads."""
+    """Actor + critic heads; discrete (categorical) or continuous (diagonal
+    gaussian). Rank-3 (pixel) observation spaces get a shared CNN encoder
+    (conv on the MXU — the Atari-class path); flat spaces use
+    shared-nothing MLPs as before."""
 
     def __init__(self, spec: RLModuleSpec):
         self.spec = spec
-        self.obs_dim = int(np.prod(spec.observation_space.shape))
+        shape = tuple(spec.observation_space.shape)
+        self._conv = len(shape) == 3
         self.discrete = isinstance(spec.action_space, Discrete)
         self.act_dim = (
             spec.action_space.n if self.discrete else int(np.prod(spec.action_space.shape))
         )
+        if self._conv:
+            h, w = shape[0], shape[1]
+            for _out, _k, s in spec.conv_filters:
+                h = -(-h // s)
+                w = -(-w // s)
+            self.obs_dim = h * w * spec.conv_filters[-1][0]  # encoder features
+        else:
+            self.obs_dim = int(np.prod(shape))
 
     def init(self, rng: jax.Array) -> dict:
-        k_pi, k_v = jax.random.split(rng)
+        k_pi, k_v, k_enc = jax.random.split(rng, 3)
         h = list(self.spec.hidden)
         params = {
             "pi": _mlp_init(k_pi, [self.obs_dim] + h + [self.act_dim]),
             "v": _mlp_init(k_v, [self.obs_dim] + h + [1], final_scale=1.0),
         }
+        if self._conv:
+            params["enc"] = _cnn_init(
+                k_enc, self.spec.observation_space.shape[2], self.spec.conv_filters
+            )
         if not self.discrete:
             params["log_std"] = jnp.zeros((self.act_dim,), jnp.float32)
         return params
 
+    def _features(self, params: dict, obs: jax.Array) -> jax.Array:
+        if self._conv:
+            return _cnn_apply(params["enc"], obs, self.spec.conv_filters)
+        return obs
+
     def apply(self, params: dict, obs: jax.Array) -> dict:
-        """obs (B, obs_dim) → {'logits'|'mean'+'log_std', 'value' (B,)}."""
-        pi_out = _mlp_apply(params["pi"], obs)
-        value = _mlp_apply(params["v"], obs)[..., 0]
+        """obs (B, obs_dim) or (B, H, W, C) → {'logits'|'mean'+'log_std',
+        'value' (B,)}."""
+        feats = self._features(params, obs)
+        pi_out = _mlp_apply(params["pi"], feats)
+        value = _mlp_apply(params["v"], feats)[..., 0]
         if self.discrete:
             return {"logits": pi_out, "value": value}
         return {"mean": pi_out, "log_std": params["log_std"], "value": value}

@@ -63,3 +63,7 @@ def ray_start_cluster():
     yield factory
     for c in cluster_holder:
         c.shutdown()
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running learning tests")

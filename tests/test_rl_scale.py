@@ -1,0 +1,194 @@
+"""RL at scale (VERDICT r2 #9): Atari-style pixel learning through the
+frame-connector pipeline, and multi-learner data-parallel LearnerGroups.
+
+ALE is not installable in this image, so the Atari-class workload is
+CatchPixelEnv — raw 84x84x3 RGB frames through the same
+grayscale→resize→scale→frame-stack pipeline an ALE Pong setup uses
+(reference: rllib/tuned_examples/impala pong family + the Atari wrapper
+stack), with a CNN-encoder ActorCriticModule. The learning test is marked
+slow.  Reference for the learner group: rllib/core/learner/learner_group.py:71
+(N DDP learners; grads averaged, weights in lockstep)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.connectors import (
+    ConnectorPipeline,
+    FrameStack,
+    GrayscaleObservations,
+    ResizeObservations,
+    ScaleObservations,
+)
+
+
+def _frame_pipeline():
+    return ConnectorPipeline(
+        [
+            GrayscaleObservations(),
+            ResizeObservations(21, 21),
+            ScaleObservations(),
+            FrameStack(2),
+        ]
+    )
+
+
+class TestFrameConnectors:
+    def test_grayscale(self):
+        rgb = np.zeros((2, 4, 4, 3), np.uint8)
+        rgb[0, ..., 0] = 255  # pure red
+        out = GrayscaleObservations()(rgb)
+        assert out.shape == (2, 4, 4)
+        assert abs(out[0, 0, 0] - 255 * 0.299) < 1e-3
+        assert out[1].max() == 0
+
+    def test_resize_nearest(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4)
+        out = ResizeObservations(2, 2)(x)
+        assert out.shape == (1, 2, 2)
+        assert out[0, 0, 0] == x[0, 0, 0]
+
+    def test_scale(self):
+        assert ScaleObservations()(np.array([[255]], np.uint8))[0, 0] == pytest.approx(1.0)
+
+    def test_frame_stack_and_episode_reset(self):
+        fs = FrameStack(3)
+        f = lambda v: np.full((2, 2, 2), v, np.float32)  # noqa: E731
+        s1 = fs(f(1.0))
+        assert s1.shape == (2, 2, 2, 3)
+        assert (s1 == 1.0).all()  # first frame replicated
+        s2 = fs(f(2.0))
+        assert list(s2[0, 0, 0]) == [1.0, 1.0, 2.0]
+        # env 0 ends an episode; its NEXT frame starts a fresh stack
+        fs.observe_dones(np.array([True, False]))
+        s3 = fs(f(3.0))
+        assert list(s3[0, 0, 0]) == [3.0, 3.0, 3.0]
+        assert list(s3[1, 0, 0]) == [1.0, 2.0, 3.0]
+
+    def test_peek_gives_true_next_stack(self):
+        fs = FrameStack(2)
+        fs(np.full((1, 2, 2), 1.0, np.float32))
+        nxt = fs.peek(np.full((1, 2, 2), 5.0, np.float32))
+        assert list(nxt[0, 0, 0]) == [1.0, 5.0]  # slid, not replicated
+        s = fs(np.full((1, 2, 2), 2.0, np.float32))  # state was untouched
+        assert list(s[0, 0, 0]) == [1.0, 2.0]
+
+    def test_pipeline_shapes_end_to_end(self):
+        pipe = _frame_pipeline()
+        frames = np.random.randint(0, 255, (4, 84, 84, 3), np.uint8)
+        out = pipe(frames)
+        assert out.shape == (4, 21, 21, 2)
+        assert out.dtype == np.float32
+        assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+def test_cnn_module_on_pixels():
+    import jax
+
+    from ray_tpu.rl.rl_module import ActorCriticModule, RLModuleSpec
+    from ray_tpu.rl.spaces import Box, Discrete
+
+    spec = RLModuleSpec(Box(0, 1, shape=(21, 21, 2)), Discrete(3))
+    mod = ActorCriticModule(spec)
+    params = mod.init(jax.random.PRNGKey(0))
+    assert "enc" in params
+    obs = np.random.rand(5, 21, 21, 2).astype(np.float32)
+    out = mod.apply(params, obs)
+    assert out["logits"].shape == (5, 3)
+    assert out["value"].shape == (5,)
+
+
+@pytest.mark.slow
+def test_pixel_catch_learns_with_frame_pipeline(ray_start_regular):
+    """The Atari-class bar scaled to CI: IMPALA-family learning on raw
+    pixels through the full frame pipeline, to a reward threshold within a
+    bounded budget. Random play averages ~-1.8 on 3-ball Catch; solved is
+    +3; the bar of >= +1.0 demonstrates genuine pixel learning."""
+    from ray_tpu.rl.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CatchPixel-v0")
+        .env_runners(
+            num_env_runners=0,
+            num_envs_per_env_runner=16,
+            rollout_fragment_length=64,
+            env_to_module_connector=_frame_pipeline,
+        )
+        .training(train_batch_size=1024, lr=1e-3, gamma=0.97)
+        .build()
+    )
+    try:
+        best = -3.0
+        for it in range(40):
+            result = algo.train()
+            mean = result.get("episode_reward_mean")
+            if mean is not None:
+                best = max(best, mean)
+            if best >= 1.0:
+                break
+        assert best >= 1.0, f"best episode_reward_mean {best}"
+    finally:
+        algo.stop()
+
+
+def test_learner_group_two_learners_match_single(ray_start_regular):
+    """2 data-parallel learners must evolve weights IDENTICALLY to one
+    learner on the full batch (grads averaged sample-weighted; every
+    learner applies the same update — the DDP invariant)."""
+    import jax
+
+    from ray_tpu.rl.learner import Learner, LearnerGroup
+    from ray_tpu.rl.rl_module import ActorCriticModule, RLModuleSpec
+    from ray_tpu.rl.sample_batch import SampleBatch
+    from ray_tpu.rl.spaces import Box, Discrete
+
+    def module_factory():
+        return ActorCriticModule(RLModuleSpec(Box(-1, 1, shape=(4,)), Discrete(2)))
+
+    def loss_fn(module, params, batch):
+        logp, entropy, value = module.logp_entropy_value(
+            params, batch["obs"], batch["act"]
+        )
+        loss = -(logp * batch["adv"]).mean() + ((value - batch["ret"]) ** 2).mean()
+        return loss, {"policy_loss": loss}
+
+    kwargs = dict(module_factory=module_factory, loss_fn=loss_fn, lr=1e-2, seed=7)
+    rng = np.random.default_rng(0)
+
+    def make_batch(n=64):
+        return SampleBatch(
+            {
+                "obs": rng.standard_normal((n, 4)).astype(np.float32),
+                "act": rng.integers(0, 2, n),
+                "adv": rng.standard_normal(n).astype(np.float32),
+                "ret": rng.standard_normal(n).astype(np.float32),
+            }
+        )
+
+    batches = [make_batch() for _ in range(4)]
+
+    single = Learner(**kwargs)
+    for b in batches:
+        single.update(b)
+
+    group = LearnerGroup(dict(kwargs), num_learners=2)
+    try:
+        for b in batches:
+            metrics = group.update(b)
+            assert "policy_loss" in metrics
+        w_group = group.get_weights()
+        w_single = single.get_weights()
+        for leaf_g, leaf_s in zip(
+            jax.tree_util.tree_leaves(w_group), jax.tree_util.tree_leaves(w_single)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(leaf_g), np.asarray(leaf_s), rtol=1e-4, atol=1e-5
+            )
+        # and BOTH learners hold identical weights (lockstep invariant)
+        w0 = ray_tpu.get(group._actors[0].get_weights.remote())
+        w1 = ray_tpu.get(group._actors[1].get_weights.remote())
+        for a, b in zip(jax.tree_util.tree_leaves(w0), jax.tree_util.tree_leaves(w1)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        group.shutdown()
