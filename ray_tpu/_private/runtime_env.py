@@ -1,17 +1,30 @@
-"""Runtime environments: per-task/actor env_vars + working_dir.
+"""Runtime environments: env_vars, working_dir, py_modules, pip + plugins.
 
-Reference: ``python/ray/_private/runtime_env/`` — the env system whose two
-workhorse features are ``env_vars`` and ``working_dir`` (zipped through the
-GCS KV, ``packaging.py``; extracted per node by the runtime-env agent).
-TPU-first simplification: no per-node agent daemon — the submitting process
-zips the directory into the head KV once (content-addressed), and workers
-extract it lazily into a per-key cache directory. ``env_vars`` apply for the
-duration of a task (and for an actor's whole life, since actors own their
-worker process).
+Reference: ``python/ray/_private/runtime_env/`` — ``packaging.py`` (zipped
+URIs through the GCS KV, extracted per node with a URI cache), ``pip.py``
+(per-env-hash virtualenv built once per node), ``plugin.py`` (the plugin
+API third-party env features hang off). TPU-first simplifications:
 
-Supported keys: ``env_vars`` (dict str->str), ``working_dir`` (local path).
-Unknown keys raise at submission (fail fast, like the reference's
-validation).
+* no per-node agent daemon — the submitting process zips/uploads
+  content-addressed blobs into the head KV once; workers materialize them
+  lazily into per-hash cache directories shared by every worker on the
+  node (concurrent builders serialize on an fcntl lock);
+* ``pip`` environments install into a per-hash PREFIX
+  (``pip install --target``) activated by sys.path injection rather than
+  exec'ing a venv interpreter: this image's base interpreter is itself a
+  venv, so a child venv cannot chain ``--system-site-packages`` to reach
+  jax/ray_tpu. The activation point (marked "pip ACTIVATION SEAM" inside
+  :func:`applied`) is where an exec-based implementation would slot in.
+  Requirements that name LOCAL files (wheels) are shipped through the KV,
+  so air-gapped clusters install with ``--no-index``;
+* plugins: :func:`register_plugin` adds a key handled by a
+  :class:`RuntimeEnvPlugin` — ``package_value`` runs at submission (upload
+  side-channel data through ``ctx``), ``apply`` is a worker-side context
+  manager.
+
+``env_vars`` apply for the duration of a task (and for an actor's whole
+life, since actors own their worker process). Unknown non-plugin keys
+raise at submission (fail fast, like the reference's validation).
 """
 
 from __future__ import annotations
@@ -20,14 +33,42 @@ import contextlib
 import hashlib
 import io
 import os
+import subprocess
 import sys
 import tempfile
 import zipfile
 from typing import Any, Optional
 
-_ALLOWED = {"env_vars", "working_dir"}
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "pip"}
 _KV_PREFIX = "__runtime_env_pkg__/"
 _EXTRACT_CACHE: dict[str, str] = {}  # kv key -> extracted dir (per process)
+
+
+class RuntimeEnvPlugin:
+    """Third-party runtime_env feature (reference: runtime_env/plugin.py).
+
+    Subclass, then ``register_plugin("mykey", MyPlugin())`` — tasks/actors
+    may then pass ``runtime_env={"mykey": value}``.
+    """
+
+    def package_value(self, value, ctx):
+        """Submission-side: validate/normalize; may upload blobs via
+        ``ctx.call("kv_put", ...)``. The return value ships in the spec."""
+        return value
+
+    @contextlib.contextmanager
+    def apply(self, value, ctx):
+        """Worker-side: set up around the task (or actor lifetime)."""
+        yield
+
+
+_PLUGINS: dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(key: str, plugin: RuntimeEnvPlugin) -> None:
+    if key in _ALLOWED:
+        raise ValueError(f"{key!r} is a built-in runtime_env key")
+    _PLUGINS[key] = plugin
 
 
 def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
@@ -35,11 +76,11 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
     head KV (content-addressed, uploaded once)."""
     if not runtime_env:
         return None
-    unknown = set(runtime_env) - _ALLOWED
+    unknown = set(runtime_env) - _ALLOWED - set(_PLUGINS)
     if unknown:
         raise ValueError(
             f"Unsupported runtime_env key(s) {sorted(unknown)}; "
-            f"supported: {sorted(_ALLOWED)}"
+            f"supported: {sorted(_ALLOWED | set(_PLUGINS))}"
         )
     out: dict[str, Any] = {}
     env_vars = runtime_env.get("env_vars")
@@ -62,7 +103,64 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
         if ctx.call("kv_get", key=key) is None:
             ctx.call("kv_put", key=key, value=blob)
         out["working_dir_key"] = key
+    mods = runtime_env.get("py_modules")
+    if mods:
+        keys = []
+        for mod in mods:
+            if not os.path.exists(mod):
+                raise ValueError(f"runtime_env['py_modules'] entry {mod!r} not found")
+            keys.append(_upload_module(mod, ctx))
+        out["py_modules_keys"] = keys
+    reqs = runtime_env.get("pip")
+    if reqs:
+        if isinstance(reqs, str):
+            reqs = [reqs]
+        shipped = []
+        for r in reqs:
+            looks_local = "/" in r or r.endswith((".whl", ".tar.gz", ".zip"))
+            if looks_local and not os.path.isfile(r):
+                # fail at SUBMISSION like working_dir/py_modules do, not
+                # minutes later on every worker (or worse, let a connected
+                # pip try to resolve the path against an index)
+                raise ValueError(f"runtime_env['pip'] local distribution {r!r} not found")
+            if os.path.isfile(r):
+                # a LOCAL distribution (wheel/sdist): ship its bytes so
+                # every node can install it without an index (air-gapped)
+                blob = open(r, "rb").read()
+                key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
+                if ctx.call("kv_get", key=key) is None:
+                    ctx.call("kv_put", key=key, value=blob)
+                shipped.append({"file_key": key, "name": os.path.basename(r)})
+            else:
+                shipped.append({"req": r})
+        out["pip"] = shipped
+    for key, plugin in _PLUGINS.items():
+        if key in runtime_env:
+            out.setdefault("plugins", {})[key] = plugin.package_value(
+                runtime_env[key], ctx
+            )
     return out or None
+
+
+def _upload_module(path: str, ctx) -> dict:
+    """Zip one py_modules entry so its TOP-LEVEL name lands importable
+    (reference: py_modules upload in packaging.py)."""
+    path = os.path.abspath(path)
+    base = os.path.basename(path.rstrip("/"))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in files:
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.join(base, os.path.relpath(full, path)))
+        else:
+            zf.write(path, base)
+    blob = buf.getvalue()
+    key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
+    if ctx.call("kv_get", key=key) is None:
+        ctx.call("kv_put", key=key, value=blob)
+    return {"key": key, "name": base}
 
 
 def _extract(key: str, ctx) -> str:
@@ -89,6 +187,87 @@ def _extract(key: str, ctx) -> str:
     return path
 
 
+def _cache_root() -> str:
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@contextlib.contextmanager
+def _build_lock(name: str):
+    """Cross-process build serialization (several workers on a node may
+    need the same env at once — exactly one builds, the rest wait)."""
+    import fcntl
+
+    lock_path = os.path.join(_cache_root(), name + ".lock")
+    with open(lock_path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def ensure_pip_prefix(shipped: list, ctx) -> str:
+    """Materialize the pip environment for this node (reference: pip.py —
+    per-env-hash virtualenv built once, cached by hash). Returns the
+    installed prefix directory; built exactly once per node per hash (the
+    ``.done`` marker is the cache hit)."""
+    env_hash = hashlib.sha1(
+        repr(sorted(e.get("req") or e["file_key"] for e in shipped)).encode()
+    ).hexdigest()[:16]
+    prefix = os.path.join(_cache_root(), f"pip-{env_hash}")
+    done = os.path.join(prefix, ".done")
+    if os.path.exists(done):
+        return prefix
+    with _build_lock(f"pip-{env_hash}"):
+        if os.path.exists(done):
+            return prefix  # another worker built it while we waited
+        import shutil
+
+        # build into a scratch dir, promote atomically: a failed/timed-out
+        # install must never leave a half-written prefix that a retry's
+        # pip (which does NOT replace existing --target dirs) then seals
+        # behind a .done marker
+        scratch = prefix + ".building"
+        shutil.rmtree(scratch, ignore_errors=True)
+        shutil.rmtree(prefix, ignore_errors=True)
+        os.makedirs(scratch)
+        args = []
+        all_local = True
+        for e in shipped:
+            if "file_key" in e:
+                blob = ctx.call("kv_get", key=e["file_key"])
+                if blob is None:
+                    raise RuntimeError(f"pip distribution {e['name']} missing from KV")
+                dist = os.path.join(scratch, e["name"])
+                with open(dist, "wb") as f:
+                    f.write(blob)
+                args.append(dist)
+            else:
+                args.append(e["req"])
+                all_local = False
+        cmd = [sys.executable, "-m", "pip", "install", "--target", scratch,
+               "--no-warn-script-location", "--quiet"]
+        if all_local:
+            cmd.append("--no-index")  # air-gapped: everything shipped via KV
+        try:
+            proc = subprocess.run(cmd + args, capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            shutil.rmtree(scratch, ignore_errors=True)
+            raise RuntimeError(f"runtime_env pip install timed out: {e}") from None
+        if proc.returncode != 0:
+            shutil.rmtree(scratch, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env pip install failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        with open(os.path.join(scratch, ".done"), "w") as f:
+            f.write("ok")
+        os.rename(scratch, prefix)
+    return prefix
+
+
 @contextlib.contextmanager
 def applied(runtime_env: Optional[dict], ctx, permanent: bool = False):
     """Worker-side application. ``permanent=True`` (actors) leaves the env
@@ -99,26 +278,51 @@ def applied(runtime_env: Optional[dict], ctx, permanent: bool = False):
     saved_env: dict[str, Optional[str]] = {}
     saved_cwd = os.getcwd()
     saved_path = list(sys.path)
-    try:
+
+    def _restore():
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        try:
+            os.chdir(saved_cwd)
+        except OSError:
+            pass
+        sys.path[:] = saved_path
+
+    with contextlib.ExitStack() as stack:
+        # registered FIRST so it unwinds LAST: plugin teardown must run in
+        # the environment the plugin was set up in (env vars, working_dir,
+        # sys.path still applied)
+        stack.callback(_restore)
         for k, v in (runtime_env.get("env_vars") or {}).items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = v
+        reqs = runtime_env.get("pip")
+        if reqs:
+            # pip ACTIVATION SEAM (see module docstring): swap this
+            # sys.path injection for an exec-based per-env interpreter to
+            # get full process isolation
+            sys.path.insert(0, ensure_pip_prefix(reqs, ctx))
+        for ent in runtime_env.get("py_modules_keys") or []:
+            root = _extract(ent["key"], ctx)
+            if root not in sys.path:
+                sys.path.insert(0, root)
         key = runtime_env.get("working_dir_key")
         if key:
             wd = _extract(key, ctx)
             os.chdir(wd)
             if wd not in sys.path:
                 sys.path.insert(0, wd)  # reference: working_dir is importable
+        for pkey, value in (runtime_env.get("plugins") or {}).items():
+            plugin = _PLUGINS.get(pkey)
+            if plugin is None:
+                raise RuntimeError(
+                    f"runtime_env plugin {pkey!r} is not registered in the "
+                    f"worker process (register it in the task/actor module)"
+                )
+            stack.enter_context(plugin.apply(value, ctx))
+        if permanent:
+            stack.pop_all()  # actor lifetime: nothing is ever undone
         yield
-    finally:
-        if not permanent:
-            for k, old in saved_env.items():
-                if old is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = old
-            try:
-                os.chdir(saved_cwd)
-            except OSError:
-                pass
-            sys.path[:] = saved_path

@@ -208,29 +208,36 @@ class FrameStack(Connector):
 
     def __init__(self, k: int = 4):
         self.k = k
-        self._stacks: Optional[np.ndarray] = None  # (N, H, W, k)
+        self._stacks: Optional[np.ndarray] = None  # (N, H, W, k*C)
+        self._c = 1  # channels per FRAME: the slide drops/appends C at a time
         self._pending_reset: Optional[np.ndarray] = None  # bool (N,)
 
-    def __call__(self, obs):
+    @staticmethod
+    def _frames_of(obs):
         obs = np.asarray(obs, np.float32)
-        if obs.ndim == 3:  # (N, H, W) → explicit channel
-            frames = obs[..., None]
-        else:
-            frames = obs
+        return obs[..., None] if obs.ndim == 3 else obs  # (N, H, W) → (N,H,W,1)
+
+    def _replicate(self, frames):
+        # per-FRAME blocks, not interleaved channels: [f, f, ..., f]
+        return np.concatenate([frames] * self.k, axis=-1)
+
+    def __call__(self, obs):
+        frames = self._frames_of(obs)
         n = frames.shape[0]
+        self._c = frames.shape[-1]
         if self._stacks is None or len(self._stacks) != n:
-            self._stacks = np.repeat(frames, self.k, axis=-1)
+            self._stacks = self._replicate(frames)
         else:
             if self._pending_reset is not None and self._pending_reset.any():
                 idx = np.nonzero(self._pending_reset)[0]
-                self._stacks[idx] = np.repeat(frames[idx], self.k, axis=-1)
+                self._stacks[idx] = self._replicate(frames[idx])
                 keep = ~self._pending_reset
             else:
                 keep = np.ones(n, bool)
             idx = np.nonzero(keep)[0]
             if len(idx):
                 self._stacks[idx] = np.concatenate(
-                    [self._stacks[idx][..., 1:], frames[idx]], axis=-1
+                    [self._stacks[idx][..., self._c :], frames[idx]], axis=-1
                 )
         self._pending_reset = None
         return self._stacks.copy()
@@ -245,16 +252,13 @@ class FrameStack(Connector):
         mutating state — used for a transition's true NEXT_OBS (the
         ``final`` buffer): current frames slid by one, new frame appended.
         Must be called BEFORE the post-step __call__ updates the stacks."""
-        obs = np.asarray(obs, np.float32)
-        frames = obs[..., None] if obs.ndim == 3 else obs
+        frames = self._frames_of(obs)
         if self._stacks is None or len(self._stacks) != frames.shape[0]:
-            return np.repeat(frames, self.k, axis=-1)
-        return np.concatenate([self._stacks[..., 1:], frames], axis=-1)
+            return self._replicate(frames)
+        return np.concatenate([self._stacks[..., frames.shape[-1] :], frames], axis=-1)
 
     def transform(self, obs):
-        obs = np.asarray(obs, np.float32)
-        frames = obs[..., None] if obs.ndim == 3 else obs
-        return np.repeat(frames, self.k, axis=-1)
+        return self._replicate(self._frames_of(obs))
 
     def get_state(self) -> dict:
         # per-env stacks are RUNNER-LOCAL episode state: syncing them into

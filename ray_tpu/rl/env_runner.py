@@ -169,8 +169,11 @@ class EnvRunner:
             # against the PRE-step connector state: frame stacks peek the
             # stack the slot would have — correct NEXT_OBS for off-policy
             # targets even at episode ends
-            if self._c_obs is not None and hasattr(self._c_obs, "transform_final"):
-                buf["final"][t] = self._c_obs.transform_final(final)
+            tf = getattr(self._c_obs, "transform_final", None) or getattr(
+                self._c_obs, "peek", None  # a bare FrameStack connector
+            )
+            if tf is not None:
+                buf["final"][t] = tf(final)
             else:
                 buf["final"][t] = self._obs_transform(final, update=False)
             # stateful frame connectors (FrameStack) must learn about

@@ -219,6 +219,10 @@ class LearnerGroup:
         return ray_tpu.get(self._actor.update.remote(batch))
 
     def _update_data_parallel(self, batch: SampleBatch) -> dict:
+        """Exact full-batch equivalence holds when each shard's row count
+        divides the learner's local device count (otherwise
+        _device_batch's cycle-padding double-weights a few rows — the same
+        bounded bias DDP accepts for uneven final batches)."""
         import jax
         import ray_tpu
 
