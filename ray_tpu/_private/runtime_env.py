@@ -98,11 +98,7 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
                 for name in files:
                     full = os.path.join(root, name)
                     zf.write(full, os.path.relpath(full, wd))
-        blob = buf.getvalue()
-        key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
-        if ctx.call("kv_get", key=key) is None:
-            ctx.call("kv_put", key=key, value=blob)
-        out["working_dir_key"] = key
+        out["working_dir_key"] = _kv_put_blob(buf.getvalue(), ctx)
     mods = runtime_env.get("py_modules")
     if mods:
         keys = []
@@ -114,23 +110,33 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
     reqs = runtime_env.get("pip")
     if reqs:
         if isinstance(reqs, str):
-            reqs = [reqs]
+            # the string form names a requirements FILE (reference pip.py
+            # semantics), expanded at submission
+            if not os.path.isfile(reqs):
+                raise ValueError(f"runtime_env['pip'] requirements file {reqs!r} not found")
+            reqs = [
+                line.strip()
+                for line in open(reqs).read().splitlines()
+                if line.strip() and not line.strip().startswith("#")
+            ]
         shipped = []
         for r in reqs:
-            looks_local = "/" in r or r.endswith((".whl", ".tar.gz", ".zip"))
+            remote_form = "://" in r or r.startswith("git+") or " @ " in r
+            looks_local = not remote_form and (
+                "/" in r or r.endswith((".whl", ".tar.gz", ".zip"))
+            )
             if looks_local and not os.path.isfile(r):
                 # fail at SUBMISSION like working_dir/py_modules do, not
                 # minutes later on every worker (or worse, let a connected
                 # pip try to resolve the path against an index)
                 raise ValueError(f"runtime_env['pip'] local distribution {r!r} not found")
-            if os.path.isfile(r):
+            if looks_local:
                 # a LOCAL distribution (wheel/sdist): ship its bytes so
                 # every node can install it without an index (air-gapped)
-                blob = open(r, "rb").read()
-                key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
-                if ctx.call("kv_get", key=key) is None:
-                    ctx.call("kv_put", key=key, value=blob)
-                shipped.append({"file_key": key, "name": os.path.basename(r)})
+                shipped.append({
+                    "file_key": _kv_put_blob(open(r, "rb").read(), ctx),
+                    "name": os.path.basename(r),
+                })
             else:
                 shipped.append({"req": r})
         out["pip"] = shipped
@@ -140,6 +146,14 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
                 runtime_env[key], ctx
             )
     return out or None
+
+
+def _kv_put_blob(blob: bytes, ctx) -> str:
+    """Content-addressed upload-once into the cluster KV."""
+    key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
+    if ctx.call("kv_get", key=key) is None:
+        ctx.call("kv_put", key=key, value=blob)
+    return key
 
 
 def _upload_module(path: str, ctx) -> dict:
@@ -156,11 +170,7 @@ def _upload_module(path: str, ctx) -> dict:
                     zf.write(full, os.path.join(base, os.path.relpath(full, path)))
         else:
             zf.write(path, base)
-    blob = buf.getvalue()
-    key = _KV_PREFIX + hashlib.sha1(blob).hexdigest()
-    if ctx.call("kv_get", key=key) is None:
-        ctx.call("kv_put", key=key, value=blob)
-    return {"key": key, "name": base}
+    return {"key": _kv_put_blob(buf.getvalue(), ctx), "name": base}
 
 
 def _extract(key: str, ctx) -> str:
@@ -262,6 +272,12 @@ def ensure_pip_prefix(shipped: list, ctx) -> str:
                 f"runtime_env pip install failed (rc={proc.returncode}):\n"
                 f"{proc.stderr[-2000:]}"
             )
+        for e in shipped:  # the wheels' CONTENTS are installed; drop the
+            if "file_key" in e:  # shipped copies from the sys.path prefix
+                try:
+                    os.unlink(os.path.join(scratch, e["name"]))
+                except OSError:
+                    pass
         with open(os.path.join(scratch, ".done"), "w") as f:
             f.write("ok")
         os.rename(scratch, prefix)

@@ -8,7 +8,33 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from ray_tpu.data import aggregate  # noqa: F401
+
+def _warm_arrow_compute() -> None:
+    """Initialize pyarrow's compute-kernel registry NOW, on the importing
+    thread, before any arrow garbage exists.
+
+    pyarrow 25's lazy kernel init is not safe against a cyclic-GC pass
+    landing mid-init on the same thread: when the first ``take`` runs on a
+    background iterator thread of a process that has accumulated arrow
+    objects in collectable cycles (exactly what repeated dataset iteration
+    produces), the GC's arrow destructors re-enter the half-built registry
+    and libarrow NULL-derefs (observed: deterministic ``segfault at 18``
+    inside libarrow.so.2500 in ``pc.take`` from ``iter_batches``'s shuffle
+    path). Warming once at import, when no cycles exist yet, removes the
+    window everywhere — driver and workers alike.
+    """
+    try:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        pc.take(pa.table({"x": [0]}), pa.array([0]))
+    except Exception:  # pyarrow optional at runtime; data then degrades
+        pass
+
+
+_warm_arrow_compute()
+
+from ray_tpu.data import aggregate  # noqa: F401,E402
 from ray_tpu.data.aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum  # noqa: F401
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
