@@ -208,6 +208,8 @@ class NodeState:
         # (host, port) of the node's data-plane server (agent nodes only;
         # head-host nodes are served by the head's own DataServer)
         self.data_address: Optional[tuple] = None
+        # latest /proc sample for this node's host (reporter.node_stats)
+        self.stats: dict = {}
         self.idle_workers: list[WorkerHandle] = []
         self.all_workers: set[WorkerHandle] = set()
         self.spawning = 0
@@ -545,6 +547,9 @@ class Head:
         # registration bearing one is told to exit instead of joining the
         # pool (bounded; pruned oldest-first in _respawn_timed_out)
         self._revoked_tokens: dict[str, bool] = {}
+        # agent worker-stack-dump rendezvous: req_id -> {pid: stacks}
+        self._stacks_replies: dict[str, dict] = {}
+        self._stacks_cv = threading.Condition()
         self.task_events: list[dict] = []  # observability feed (state API)
         self._infeasible_warned: dict[bytes, float] = {}
         # streaming-generator returns: task_id -> {"items": {index: obj_id},
@@ -657,6 +662,20 @@ class Head:
                     agent_node = self._on_register_agent(conn, msg[1])
                 elif kind == "register_driver":
                     conn.send(("driver_ack", {"node_id": self._any_node_id()}))
+                elif kind == "agent_stats":
+                    if agent_node is not None:
+                        with self.lock:
+                            n = self.nodes.get(agent_node.binary())
+                            if n is not None:
+                                n.stats = msg[1]
+                elif kind == "worker_stacks":
+                    with self._stacks_cv:
+                        self._stacks_replies[msg[1]["req_id"]] = msg[1]["stacks"]
+                        # bound: replies landing after their caller timed
+                        # out are never consumed — don't accumulate blobs
+                        while len(self._stacks_replies) > 64:
+                            self._stacks_replies.pop(next(iter(self._stacks_replies)))
+                        self._stacks_cv.notify_all()
                 elif kind == "req":
                     _, seq, method, payload = msg
                     self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
@@ -784,7 +803,9 @@ class Head:
             handler = getattr(self, "rpc_" + method)
         if remote and method == "get":
             handler = self._rpc_get_remote
-        blocking = method in ("get", "wait", "pg_ready", "get_actor_named", "stream_next")
+        blocking = method in (
+            "get", "wait", "pg_ready", "get_actor_named", "stream_next", "worker_stacks"
+        )
         if blocking:
             # blocking RPCs park until objects/actors materialize; run them
             # on a cached high-cap pool so the hot path reuses threads
@@ -1722,6 +1743,17 @@ class Head:
                 self._on_worker_dead(wh)
             for wh in timed_out:
                 self._respawn_timed_out(wh)
+            # refresh this host's /proc stats onto its (non-agent) nodes
+            try:
+                from ray_tpu._private.reporter import node_stats
+
+                stats = node_stats()
+                with self.lock:
+                    for n in self.nodes.values():
+                        if n.agent is None:
+                            n.stats = stats
+            except Exception:
+                pass
             # restored detached actors whose old workers never reconnected:
             # past the grace window, re-create them fresh (reference:
             # gcs_actor_manager restart of registered actors on failover)
@@ -1898,6 +1930,10 @@ class Head:
         node.all_workers.discard(wh)
         if wh in node.idle_workers:
             node.idle_workers.remove(wh)
+        if wh.proc is not None:
+            from ray_tpu._private.reporter import reap_stack_file
+
+            reap_stack_file(wh.proc.pid)
         # the whole dispatch FIFO dies with the worker. Only the HEAD of the
         # queue was executing — it is charged a retry (or failed). Pipelined
         # followers never ran an instruction: they requeue to the scheduler
@@ -3072,6 +3108,67 @@ class Head:
                 {"object_id": ObjectID(oid).hex(), "size": e.size, "ready": e.ready, "refcount": e.refcount, "pins": e.pins}
                 for oid, e in self.objects.items()
             ]
+
+    def rpc_node_stats(self):
+        """Per-node /proc stats (reporter.node_stats samples — the head's
+        health loop covers its host; agents push theirs)."""
+        with self.lock:
+            return {
+                n.node_id.hex(): dict(n.stats) for n in self.nodes.values() if n.alive
+            }
+
+    def rpc_worker_stacks(self, timeout: float = 5.0):
+        """All-thread stack dumps of every worker in the cluster (SIGUSR1 →
+        faulthandler; reference: the dashboard's py-spy stack dumps). Works
+        on wedged workers — the handler is C-level and needs no GIL."""
+        import uuid as _uuid
+
+        from ray_tpu._private.reporter import dump_pids
+
+        deadline = time.monotonic() + timeout
+        local_pids: list[int] = []
+        agents = []
+        with self.lock:
+            for node in self.nodes.values():
+                if not node.alive:
+                    continue
+                if node.agent is not None:
+                    agents.append((node.node_id.hex(), node.agent))
+                else:
+                    local_pids.extend(
+                        wh.proc.pid
+                        for wh in node.all_workers
+                        # registered only: pre-registration processes may not
+                        # have armed the handler yet (dump_pids also refuses
+                        # to signal unarmed pids as a second guard)
+                        if wh.proc is not None and wh.proc.is_alive() and wh.conn is not None
+                    )
+        out: dict[str, dict] = {}
+        req_ids = {}
+        for node_hex, agent in agents:
+            rid = _uuid.uuid4().hex
+            if agent.send(("dump_workers", {"req_id": rid})):
+                req_ids[rid] = node_hex
+            else:
+                out[node_hex] = {"error": "agent unreachable"}
+        local = dump_pids(
+            sorted(set(local_pids)),
+            timeout=max(min(3.0, deadline - time.monotonic()), 0.1),
+        )
+        out["local"] = {str(pid): text for pid, text in local.items()}
+        with self._stacks_cv:
+            while req_ids and time.monotonic() < deadline:
+                done = [r for r in req_ids if r in self._stacks_replies]
+                for rid in done:
+                    node_hex = req_ids.pop(rid)
+                    out[node_hex] = {
+                        str(p): t for p, t in self._stacks_replies.pop(rid).items()
+                    }
+                if req_ids:
+                    self._stacks_cv.wait(timeout=0.2)
+        for rid, node_hex in req_ids.items():
+            out[node_hex] = {"error": "no reply within timeout"}
+        return out
 
     def rpc_task_events(self):
         with self.lock:

@@ -103,6 +103,8 @@ class NodeAgent:
         re-registers under its ORIGINAL node id so restored object
         locators stay routable — reference: raylet reconnect window,
         ray_config_def.h:56-60)."""
+        self._send_lock = threading.Lock()
+        threading.Thread(target=self._stats_loop, daemon=True).start()
         try:
             while not self._stop.is_set():
                 try:
@@ -122,6 +124,17 @@ class NodeAgent:
                         free_location(msg[1])
                     except Exception:  # noqa: BLE001 - frees are best-effort
                         pass
+                elif msg[0] == "dump_workers":
+                    # on-demand stack dumps of THIS host's workers
+                    # (reporter.py SIGUSR1 machinery)
+                    from ray_tpu._private.reporter import dump_pids
+
+                    pids = [p.pid for p in self._procs if p.poll() is None]
+                    stacks = dump_pids(pids)
+                    with self._send_lock:
+                        self.conn.send(
+                            ("worker_stacks", {"req_id": msg[1]["req_id"], "stacks": stacks})
+                        )
                 elif msg[0] == "kill_worker":
                     # registration-timeout path: the head gave up on this
                     # spawn; kill it here so a wedged interpreter doesn't
@@ -170,8 +183,29 @@ class NodeAgent:
         token = info.get("token", "")
         if token:
             self._by_token[token] = popen
+        from ray_tpu._private.reporter import reap_stack_file
+
+        for p in self._procs:
+            if p.poll() is not None:
+                reap_stack_file(p.pid)
         self._procs = [p for p in self._procs if p.poll() is None]
         self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
+
+    def _stats_loop(self) -> None:
+        """Ship /proc node stats to the head every few seconds (reference:
+        reporter_agent.py's periodic psutil report)."""
+        import time as _time
+
+        from ray_tpu._private.reporter import node_stats
+
+        while not self._stop.is_set():
+            _time.sleep(5.0)
+            try:
+                stats = node_stats()
+                with self._send_lock:
+                    self.conn.send(("agent_stats", stats))
+            except Exception:
+                pass  # conn mid-reconnect: next tick retries
 
     def _reconnect(self) -> bool:
         import time

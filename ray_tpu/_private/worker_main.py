@@ -147,6 +147,13 @@ def main(
     state = WorkerState(ctx)
     state.head_address = socket_path  # for detached-actor reconnect
     state.detached = False
+    # SIGUSR1 → all-thread stack dump (C-level handler: fires even when the
+    # GIL is held or the process is wedged mid-syscall) — the profiling
+    # story for stuck workers (reporter.py; reference: py-spy dumps via
+    # dashboard profile_manager)
+    from ray_tpu._private.reporter import arm_stack_dumps
+
+    arm_stack_dumps()
     ctx.send_raw(
         ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
     )

@@ -105,6 +105,30 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_stacks(args) -> int:
+    """Dump every worker's thread stacks (debugging stuck workers —
+    reference: `ray stack` / dashboard py-spy dumps)."""
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    stacks = state.get_worker_stacks()
+    for node, per_pid in stacks.items():
+        for pid, text in per_pid.items():
+            print(f"==== node {node} worker {pid} ====")
+            print(text)
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_nodestats(args) -> int:
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    print(json.dumps(state.get_node_stats(), indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_timeline(args) -> int:
     from ray_tpu.util import state
 
@@ -191,6 +215,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="nodes + resource totals")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("stacks", help="dump every worker's thread stacks (stuck-worker debugging)")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_stacks)
+
+    p = sub.add_parser("node-stats", help="per-node cpu/mem/disk stats")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_nodestats)
 
     p = sub.add_parser("job", help="submit/inspect jobs on a running cluster")
     p.add_argument("action", choices=["submit", "status", "logs", "stop", "list"])

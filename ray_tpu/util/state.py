@@ -59,6 +59,19 @@ def get_task_events() -> list[dict]:
     return _ctx().call("task_events")
 
 
+def get_node_stats() -> dict:
+    """Per-node /proc samples: cpu/mem/disk/load (reference: the
+    dashboard reporter agent's psutil stats)."""
+    return _ctx().call("node_stats")
+
+
+def get_worker_stacks(timeout: float = 5.0) -> dict:
+    """All-thread stack dumps of every worker (SIGUSR1 → faulthandler;
+    works on wedged workers — reference: dashboard py-spy dumps).
+    Returns {node: {pid: stacks_text}} with 'local' for the head host."""
+    return _ctx().call("worker_stacks", timeout=timeout)
+
+
 # ---------------------------------------------------------------------------
 # summaries (reference: `ray summary tasks/actors/objects`)
 # ---------------------------------------------------------------------------

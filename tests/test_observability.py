@@ -147,3 +147,87 @@ def test_metric_tag_validation(ray_start_regular):
         c.inc(1, tags={"bogus": "x"})
     with pytest.raises(ValueError):
         Counter("bad name")
+
+
+# -- per-node reporter + stuck-worker stack dumps (reporter.py) --------------
+
+
+def test_worker_stack_dumps_show_running_function(ray_start_regular):
+    """SIGUSR1 stack dumps reach INSIDE a busy worker: the dump must show
+    the user function currently executing (the py-spy property — works
+    without worker cooperation). Reference: dashboard profile_manager."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def spin_here_marker_fn():
+        t0 = time.time()
+        while time.time() - t0 < 15:
+            time.sleep(0.01)
+        return True
+
+    ref = spin_here_marker_fn.remote()
+    time.sleep(1.0)  # let it start spinning
+    stacks = state.get_worker_stacks()
+    text = "\n".join(t for per in stacks.values() for t in per.values())
+    assert "spin_here_marker_fn" in text, text[-2000:]
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_node_stats_reported(ray_start_regular):
+    import time
+
+    from ray_tpu.util import state
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        stats = state.get_node_stats()
+        if stats and any("mem_percent" in s for s in stats.values()):
+            break
+        time.sleep(0.5)
+    assert stats
+    s = next(iter(stats.values()))
+    assert 0 < s["mem_percent"] <= 100
+    assert s["disk_total_bytes"] > 0
+
+
+def test_agent_node_stats_and_stacks(ray_start_regular):
+    """Agent-hosted workers are covered too: stats pushed by the agent,
+    dumps collected through it."""
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.node_agent import NodeAgent
+    from ray_tpu._private.runtime import get_ctx
+    from ray_tpu.util import state
+
+    head = get_ctx().head
+    host, port = head.listen_tcp("127.0.0.1", 0)
+    agent = NodeAgent(f"{host}:{port}", head.authkey, resources={"CPU": 2.0, "agentland": 5.0}).start()
+    try:
+        @ray_tpu.remote(resources={"agentland": 1.0})
+        def agent_spin_marker():
+            t0 = time.time()
+            while time.time() - t0 < 15:
+                time.sleep(0.01)
+            return True
+
+        ref = agent_spin_marker.remote()
+        time.sleep(2.0)
+        stacks = state.get_worker_stacks()
+        agent_hex = agent.node_id_bin.hex()
+        assert agent_hex in stacks, list(stacks)
+        text = "\n".join(stacks[agent_hex].values())
+        assert "agent_spin_marker" in text, text[-1500:]
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            stats = state.get_node_stats()
+            if stats.get(agent_hex):
+                break
+            time.sleep(0.5)
+        assert stats.get(agent_hex), "agent never pushed stats"
+        ray_tpu.cancel(ref, force=True)
+    finally:
+        agent.shutdown()
