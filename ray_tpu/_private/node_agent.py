@@ -126,15 +126,11 @@ class NodeAgent:
                         pass
                 elif msg[0] == "dump_workers":
                     # on-demand stack dumps of THIS host's workers
-                    # (reporter.py SIGUSR1 machinery)
-                    from ray_tpu._private.reporter import dump_pids
-
-                    pids = [p.pid for p in self._procs if p.poll() is None]
-                    stacks = dump_pids(pids)
-                    with self._send_lock:
-                        self.conn.send(
-                            ("worker_stacks", {"req_id": msg[1]["req_id"], "stacks": stacks})
-                        )
+                    # (reporter.py SIGUSR1 machinery) — off-thread, or the
+                    # ~2s dump poll would stall spawn/kill/free handling
+                    threading.Thread(
+                        target=self._dump_workers, args=(msg[1]["req_id"],), daemon=True
+                    ).start()
                 elif msg[0] == "kill_worker":
                     # registration-timeout path: the head gave up on this
                     # spawn; kill it here so a wedged interpreter doesn't
@@ -190,6 +186,17 @@ class NodeAgent:
                 reap_stack_file(p.pid)
         self._procs = [p for p in self._procs if p.poll() is None]
         self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
+
+    def _dump_workers(self, req_id: str) -> None:
+        from ray_tpu._private.reporter import dump_pids
+
+        pids = [p.pid for p in self._procs if p.poll() is None]
+        try:
+            stacks = dump_pids(pids)
+            with self._send_lock:
+                self.conn.send(("worker_stacks", {"req_id": req_id, "stacks": stacks}))
+        except Exception:
+            pass  # conn died: the head's dump call times out gracefully
 
     def _stats_loop(self) -> None:
         """Ship /proc node stats to the head every few seconds (reference:

@@ -51,6 +51,10 @@ def _payload(path: str):
             "total": ray_tpu.cluster_resources(),
             "available": ray_tpu.available_resources(),
         }
+    if path == "/api/node_stats":
+        return st.get_node_stats()
+    if path == "/api/worker_stacks":
+        return st.get_worker_stacks()
     if path == "/api/timeline":
         return st.timeline()
     if path == "/api/jobs":

@@ -91,6 +91,29 @@ class _Store:
         with open(os.path.join(self.dir, "graph.pkl"), "rb") as f:
             return pickle.load(f)
 
+    def append_event(self, event: dict) -> None:
+        """Durable event log (reference: workflow event system /
+        workflow_executor status callbacks) — one JSON line per event.
+        Callers pass events already carrying their ``time``."""
+        self._ensure()
+        with open(os.path.join(self.dir, "events.jsonl"), "a") as f:
+            f.write(json.dumps(event) + "\n")
+
+    def read_events(self) -> list[dict]:
+        out = []
+        try:
+            with open(os.path.join(self.dir, "events.jsonl")) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break  # torn tail (crash mid-append): intact prefix wins
+        except OSError:
+            pass
+        return out
+
 
 def _step_ids(dag: DAGNode) -> dict[int, str]:
     """Deterministic id per node: function name + topological index +
@@ -120,14 +143,54 @@ def _step_ids(dag: DAGNode) -> dict[int, str]:
     return ids
 
 
-def _execute_durable(dag: DAGNode, input_args: tuple, store: _Store) -> Any:
+def _execute_durable(
+    dag: DAGNode, input_args: tuple, store: _Store, on_event=None
+) -> Any:
+    """Durable, CONCURRENT DAG execution.
+
+    Steps are submitted eagerly with ObjectRef arguments, so independent
+    branches run in parallel across the cluster (reference:
+    workflow_executor's in-flight task set) — the scheduler, not this
+    loop, decides concurrency. The driver persists each step's result as
+    it completes (ray_tpu.wait harvest): crash anywhere and resume()
+    re-submits only steps without a checkpoint. Per-step retries are the
+    underlying TASK's ``max_retries`` (set via ``.options`` on the remote
+    function when binding the DAG). Events go to the durable per-workflow
+    log and to ``on_event`` as they happen."""
     ids = _step_ids(dag)
     memo: dict = {}
     inputs = list(input_args)
     for node in dag._collect_inputs():
         memo[id(node)] = inputs.pop(0) if inputs else None
 
-    def run_node(node: DAGNode):
+    def emit(event_type: str, step_id: str) -> None:
+        event = {"type": event_type, "step_id": step_id, "time": time.time()}
+        store.append_event(event)
+        if on_event is not None:
+            try:
+                on_event(dict(event))
+            except Exception:
+                pass  # a broken listener must not kill the workflow
+
+    pending: dict[Any, str] = {}  # ref -> step_id (awaiting checkpoint)
+
+    def _deref_lists(v):
+        """A MultiOutputNode upstream produces a LIST of in-flight refs:
+        nested refs would pickle by value with no dependency edge, so a
+        consumer could run before its producers. Materialize list-shaped
+        inputs here (only that branch blocks)."""
+        from ray_tpu._private.runtime import ObjectRef
+
+        if isinstance(v, list):
+            return [
+                ray_tpu.get(x) if isinstance(x, ObjectRef) else _deref_lists(x)
+                for x in v
+            ]
+        return v
+
+    def build(node: DAGNode):
+        """Returns the node's value (checkpointed) or an ObjectRef
+        (submitted, in flight) — WITHOUT blocking, so siblings overlap."""
         key = id(node)
         if key in memo:
             return memo[key]
@@ -135,31 +198,87 @@ def _execute_durable(dag: DAGNode, input_args: tuple, store: _Store) -> Any:
         if store.has_step(step_id):
             memo[key] = store.load_step(step_id)  # checkpointed — skip
             return memo[key]
-        args = [run_node(a) if isinstance(a, DAGNode) else a for a in node._bound_args]
+        args = [_deref_lists(build(a)) if isinstance(a, DAGNode) else a for a in node._bound_args]
         kwargs = {
-            k: (run_node(v) if isinstance(v, DAGNode) else v)
+            k: (_deref_lists(build(v)) if isinstance(v, DAGNode) else v)
             for k, v in node._bound_kwargs.items()
         }
-        checkpoint = True
         if isinstance(node, MultiOutputNode):
-            value = list(args)
+            value = list(args)  # refs/values; materialized at harvest
         elif isinstance(node, FunctionNode):
-            # each step runs as a task; its materialized result is the
-            # durability unit (reference: one checkpoint per workflow task)
-            value = ray_tpu.get(node._fn.remote(*args, **kwargs))
+            # submit, don't wait: ref args chain dependencies through the
+            # scheduler; task max_retries = the step's retry budget
+            value = node._fn.remote(*args, **kwargs)
+            pending[value] = step_id
+            emit("step_started", step_id)
         elif hasattr(node, "_cls"):  # ClassNode — uses the DURABLY computed
             # args, but actor handles themselves aren't durable: not
             # checkpointed (reference: virtual actors are a separate system)
             value = node._cls.remote(*args, **kwargs)
-            checkpoint = False
         else:
             raise TypeError(f"workflows cannot execute {type(node).__name__}")
-        if checkpoint:
-            store.save_step(step_id, value)
         memo[key] = value
         return value
 
-    return run_node(dag)
+    root = build(dag)
+
+    # harvest: checkpoint step results AS THEY COMPLETE, whatever order the
+    # branches finish in; a failed step saves its siblings first, then
+    # raises (resume re-runs only the failure and its dependents)
+    failure: Optional[BaseException] = None
+    while pending:
+        ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=None)
+        for ref in ready:
+            step_id = pending.pop(ref)
+            try:
+                value = ray_tpu.get(ref)
+            except Exception as e:  # STEP failure (KeyboardInterrupt etc.
+                # propagate immediately — they are driver-level, not steps)
+                emit("step_failed", step_id)
+                if failure is None:
+                    failure = e
+                continue
+            # a save failure is a DRIVER/storage problem, not a step
+            # failure: surface it now rather than re-running a step that
+            # already succeeded on the cluster
+            store.save_step(step_id, value)
+            emit("step_completed", step_id)
+    if failure is not None:
+        raise failure
+
+    def materialize(v):
+        if isinstance(v, list):
+            return [materialize(x) for x in v]
+        from ray_tpu._private.runtime import ObjectRef
+
+        return ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+
+    return materialize(root)
+
+
+def _execute_with_retries(
+    dag, input_args, store, on_event, max_step_retries: int
+) -> Any:
+    """Step retries, resume-style (reference: workflow max_retries): a
+    failed round re-drives the DAG — checkpointed steps load instantly, so
+    each extra round re-runs ONLY the failed step and its dependents.
+    (Task-level ``max_retries`` still covers worker-death retries
+    underneath; this layer covers application exceptions.)"""
+    attempts = 0
+    while True:
+        try:
+            return _execute_durable(dag, input_args, store, on_event=on_event)
+        except Exception:
+            attempts += 1
+            if attempts > max_step_retries:
+                raise
+            event = {"type": "retry_round", "round": attempts, "time": time.time()}
+            store.append_event(event)
+            if on_event is not None:
+                try:
+                    on_event(dict(event))
+                except Exception:
+                    pass
 
 
 def run(
@@ -167,15 +286,21 @@ def run(
     *input_args,
     workflow_id: Optional[str] = None,
     storage: Optional[str] = None,
+    on_event=None,
+    max_step_retries: int = 0,
 ) -> Any:
     """Execute a DAG durably; returns the final result (reference:
-    ``workflow.run``)."""
+    ``workflow.run``). Independent branches run CONCURRENTLY; ``on_event``
+    receives {type, step_id, time} dicts live (also persisted — see
+    ``get_events``). ``max_step_retries`` re-drives failed rounds
+    (checkpointed steps are skipped) — opt-in, since retrying
+    non-idempotent steps repeats their side effects."""
     workflow_id = workflow_id or f"wf_{int(time.time() * 1000):x}"
     store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
     store.save_graph(dag, input_args)
     store.write_meta(status=STATUS_RUNNING, workflow_id=workflow_id)
     try:
-        out = _execute_durable(dag, input_args, store)
+        out = _execute_with_retries(dag, input_args, store, on_event, max_step_retries)
     except BaseException:
         store.write_meta(status=STATUS_FAILED)
         raise
@@ -184,7 +309,12 @@ def run(
     return out
 
 
-def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+def resume(
+    workflow_id: str,
+    storage: Optional[str] = None,
+    on_event=None,
+    max_step_retries: int = 0,
+) -> Any:
     """Re-drive an interrupted workflow; completed steps are loaded from
     storage, remaining steps execute (reference: ``workflow.resume``)."""
     store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
@@ -193,7 +323,7 @@ def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
     dag, input_args = store.load_graph()
     store.write_meta(status=STATUS_RUNNING)
     try:
-        out = _execute_durable(dag, input_args, store)
+        out = _execute_with_retries(dag, input_args, store, on_event, max_step_retries)
     except BaseException:
         store.write_meta(status=STATUS_FAILED)
         raise
@@ -214,6 +344,13 @@ def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
     if not store.has_step("__output__"):
         raise ValueError(f"workflow {workflow_id!r} has no output (not finished?)")
     return store.load_step("__output__")
+
+
+def get_events(workflow_id: str, storage: Optional[str] = None) -> list[dict]:
+    """The workflow's durable event log: step_started / step_completed /
+    step_failed lines with timestamps (reference: the workflow event
+    system's observable execution feed)."""
+    return _Store(storage or _DEFAULT_STORAGE, workflow_id).read_events()
 
 
 def list_all(storage: Optional[str] = None) -> list[tuple[str, Optional[str]]]:

@@ -96,3 +96,69 @@ def test_multi_output_workflow(wf_storage):
 
     dag = MultiOutputNode([sq.bind(2), sq.bind(3)])
     assert workflow.run(dag, workflow_id="w5", storage=wf_storage) == [4, 9]
+
+
+def test_branches_run_concurrently(ray_start_regular, tmp_path):
+    """Independent branches must overlap (reference: the workflow
+    executor's in-flight task set, not a sequential topological walk)."""
+    import time
+
+    @ray_tpu.remote
+    def slow(tag):
+        time.sleep(1.2)
+        return tag
+
+    @ray_tpu.remote
+    def join(a, b, c):
+        return [a, b, c]
+
+    dag = join.bind(slow.bind("a"), slow.bind("b"), slow.bind("c"))
+    t0 = time.monotonic()
+    out = workflow.run(dag, workflow_id="wf_conc", storage=str(tmp_path))
+    dt = time.monotonic() - t0
+    assert out == ["a", "b", "c"]
+    assert dt < 3.0, f"branches ran sequentially ({dt:.1f}s for 3x1.2s steps)"
+
+
+def test_step_retries_via_task_options(ray_start_regular, tmp_path):
+    """A step's retry budget is its task max_retries: a step that fails
+    twice then succeeds completes the workflow without a resume."""
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=3)
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"boom {n}")
+        return "recovered"
+
+    out = workflow.run(flaky.bind(), workflow_id="wf_retry", storage=str(tmp_path), max_step_retries=3)
+    assert out == "recovered"
+    assert int(marker.read_text()) == 3
+
+
+def test_events_logged_and_pushed(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def stepa():
+        return 1
+
+    @ray_tpu.remote
+    def stepb(x):
+        return x + 1
+
+    live = []
+    out = workflow.run(
+        stepb.bind(stepa.bind()),
+        workflow_id="wf_events",
+        storage=str(tmp_path),
+        on_event=live.append,
+    )
+    assert out == 2
+    events = workflow.get_events("wf_events", storage=str(tmp_path))
+    types = [(e["type"], e["step_id"].split("_")[1]) for e in events]
+    assert ("step_started", "stepa") in types
+    assert ("step_completed", "stepa") in types
+    assert ("step_completed", "stepb") in types
+    assert [e["type"] for e in live] == [e["type"] for e in events]
+    assert all("time" in e for e in events)
