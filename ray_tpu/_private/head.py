@@ -1375,6 +1375,24 @@ class Head:
         feasible = [n for n in alive if n.can_fit(res)]
         if not feasible:
             return None
+        if strategy and strategy[0] == "labels":
+            # node-label policy (reference: scheduling/policy node-label):
+            # hard labels filter; soft labels prefer best-matching nodes
+            _, hard, soft = strategy
+            feasible = [
+                n for n in feasible
+                if all(n.labels.get(k) == v for k, v in hard)
+            ]
+            if not feasible:
+                return None
+            if soft:
+                best = max(
+                    sum(1 for k, v in soft if n.labels.get(k) == v) for n in feasible
+                )
+                feasible = [
+                    n for n in feasible
+                    if sum(1 for k, v in soft if n.labels.get(k) == v) == best
+                ]
         if strategy and strategy[0] == "spread":
             return min(feasible, key=lambda n: (n.utilization(res), self.node_order.index(n.node_id.binary())))
         # hybrid: first node (stable order) under threshold, else least utilized
@@ -3186,11 +3204,21 @@ class Head:
         bin-packs pending shapes against node types).
         """
         with self.lock:
-            demand = [dict(rec["spec"].get("resources") or {}) for rec in self.pending_sched]
+            demand = []
+            demand_labels = []
+
+            def _labels_of(spec):
+                st = spec.get("strategy")
+                return dict(st[1]) if st and st[0] == "labels" else {}
+
+            for rec in self.pending_sched:
+                demand.append(dict(rec["spec"].get("resources") or {}))
+                demand_labels.append(_labels_of(rec["spec"]))
             # actor creations waiting for resources count too
             for a in self.actors.values():
                 if a.state == ACTOR_PENDING and a.worker is None:
                     demand.append(dict(a.create_spec.get("resources") or {}))
+                    demand_labels.append(_labels_of(a.create_spec))
             nodes = []
             now = time.monotonic()
             for n in self.nodes.values():
@@ -3218,7 +3246,11 @@ class Head:
                         "labels": dict(n.labels),
                     }
                 )
-            return {"pending_demand": demand, "nodes": nodes}
+            return {
+                "pending_demand": demand,
+                "pending_demand_labels": demand_labels,
+                "nodes": nodes,
+            }
 
     def rpc_list_placement_groups(self):
         with self.lock:

@@ -30,6 +30,14 @@ def validate(options: dict[str, Any], is_actor: bool) -> None:
     for k in options:
         if k not in allowed:
             raise ValueError(f"Invalid option {k!r} for {'actor' if is_actor else 'task'}")
+    st = options.get("scheduling_strategy")
+    if options.get("label_selector") and st not in (None, "DEFAULT"):
+        # fail fast: to_strategy can honor only one placement policy, and
+        # silently dropping the label constraint would mis-place the task
+        raise ValueError(
+            "label_selector cannot be combined with scheduling_strategy="
+            f"{st!r}; use NodeLabelSchedulingStrategy(hard=...) instead"
+        )
 
 
 def to_resources(options: dict[str, Any], is_actor: bool) -> dict[str, float]:
@@ -60,6 +68,11 @@ def to_strategy(options: dict[str, Any]) -> Optional[tuple]:
         )
     strategy = options.get("scheduling_strategy")
     if strategy is None or strategy == "DEFAULT":
+        sel = options.get("label_selector")
+        if sel:
+            # label_selector = hard label requirements without a full
+            # strategy object (reference: label_selector task option)
+            return ("labels", tuple(sorted(sel.items())), ())
         return None
     if strategy == "SPREAD":
         return ("spread",)
@@ -68,4 +81,12 @@ def to_strategy(options: dict[str, Any]) -> Optional[tuple]:
         return ("pg", pg.id, strategy.placement_group_bundle_index if strategy.placement_group_bundle_index is not None else -1, strategy.placement_group_capture_child_tasks)
     if isinstance(strategy, NodeAffinitySchedulingStrategy):
         return ("node", strategy.node_id, strategy.soft)
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return (
+            "labels",
+            tuple(sorted(strategy.hard.items())),
+            tuple(sorted(strategy.soft.items())),
+        )
     raise ValueError(f"Unknown scheduling strategy: {strategy!r}")

@@ -13,3 +13,9 @@ from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     GKETPUNodeProvider,
     NodeProvider,
 )
+from ray_tpu.autoscaler.v2 import (  # noqa: F401
+    AsyncNodeProvider,
+    AutoscalerV2,
+    FakeAsyncProvider,
+    InstanceManager,
+)

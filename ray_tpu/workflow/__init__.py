@@ -220,29 +220,39 @@ def _execute_durable(
         memo[key] = value
         return value
 
-    root = build(dag)
+    def harvest() -> Optional[BaseException]:
+        """Checkpoint step results AS THEY COMPLETE, whatever order the
+        branches finish in; returns the first step failure (siblings are
+        saved before it surfaces — resume then re-runs only the failure
+        and its dependents)."""
+        failure: Optional[BaseException] = None
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=None)
+            for ref in ready:
+                step_id = pending.pop(ref)
+                try:
+                    value = ray_tpu.get(ref)
+                except Exception as e:  # STEP failure (KeyboardInterrupt etc.
+                    # propagate immediately — they are driver-level, not steps)
+                    emit("step_failed", step_id)
+                    if failure is None:
+                        failure = e
+                    continue
+                # a save failure is a DRIVER/storage problem, not a step
+                # failure: surface it now rather than re-running a step that
+                # already succeeded on the cluster
+                store.save_step(step_id, value)
+                emit("step_completed", step_id)
+        return failure
 
-    # harvest: checkpoint step results AS THEY COMPLETE, whatever order the
-    # branches finish in; a failed step saves its siblings first, then
-    # raises (resume re-runs only the failure and its dependents)
-    failure: Optional[BaseException] = None
-    while pending:
-        ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=None)
-        for ref in ready:
-            step_id = pending.pop(ref)
-            try:
-                value = ray_tpu.get(ref)
-            except Exception as e:  # STEP failure (KeyboardInterrupt etc.
-                # propagate immediately — they are driver-level, not steps)
-                emit("step_failed", step_id)
-                if failure is None:
-                    failure = e
-                continue
-            # a save failure is a DRIVER/storage problem, not a step
-            # failure: surface it now rather than re-running a step that
-            # already succeeded on the cluster
-            store.save_step(step_id, value)
-            emit("step_completed", step_id)
+    try:
+        root = build(dag)
+    except Exception:
+        # a build-phase failure (e.g. materializing a failed MultiOutput
+        # branch) must still checkpoint completed siblings before raising
+        harvest()
+        raise
+    failure = harvest()
     if failure is not None:
         raise failure
 

@@ -1,0 +1,117 @@
+"""Scheduling-policy residue (reference: raylet/scheduling/policy/): node
+labels (hard + soft), label_selector, and the deep-queue envelope the
+signature-bucketed scheduler is built for."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime import get_ctx
+from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+
+def test_hard_label_selection(ray_start_regular):
+    head = get_ctx().head
+    gpuish = head.add_node({"CPU": 2.0}, labels={"accel": "v5e", "zone": "a"})
+    head.add_node({"CPU": 2.0}, labels={"accel": "cpu", "zone": "b"})
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    strat = NodeLabelSchedulingStrategy(hard={"accel": "v5e"})
+    nodes = set(
+        ray_tpu.get(
+            [where.options(scheduling_strategy=strat).remote() for _ in range(4)],
+            timeout=60,
+        )
+    )
+    assert nodes == {gpuish.hex()}
+
+
+def test_label_selector_option(ray_start_regular):
+    head = get_ctx().head
+    target = head.add_node({"CPU": 2.0}, labels={"pool": "inference"})
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    got = ray_tpu.get(
+        where.options(label_selector={"pool": "inference"}).remote(), timeout=60
+    )
+    assert got == target.hex()
+
+
+def test_soft_labels_prefer_but_fall_back(ray_start_regular):
+    head = get_ctx().head
+    preferred = head.add_node({"CPU": 1.0}, labels={"tier": "fast"})
+    head.add_node({"CPU": 8.0}, labels={"tier": "slow"})
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    strat = NodeLabelSchedulingStrategy(soft={"tier": "fast"})
+    # first task lands on the preferred node...
+    assert ray_tpu.get(
+        where.options(scheduling_strategy=strat).remote(), timeout=60
+    ) == preferred.hex()
+    # ...and an infeasible-preference task still runs somewhere (soft)
+    strat2 = NodeLabelSchedulingStrategy(soft={"tier": "nonexistent"})
+    assert ray_tpu.get(
+        where.options(scheduling_strategy=strat2).remote(), timeout=60
+    )
+
+
+def test_unsatisfiable_hard_labels_stay_pending(ray_start_regular):
+    @ray_tpu.remote
+    def nope():
+        return 1
+
+    strat = NodeLabelSchedulingStrategy(hard={"planet": "mars"})
+    ref = nope.options(scheduling_strategy=strat).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=1.5)  # GetTimeoutError: pending forever
+    ray_tpu.cancel(ref)
+
+
+@pytest.mark.slow
+def test_deep_queue_envelope(ray_start_regular):
+    """The queued-tasks envelope (SURVEY §3.2 family): a deep backlog of
+    infeasible tasks must not degrade scheduling of runnable work — the
+    signature-bucketed queue makes the backlog O(1) per scheduling event."""
+
+    @ray_tpu.remote(resources={"never": 1.0})
+    def blocked():
+        return None
+
+    @ray_tpu.remote
+    def runnable(x):
+        return x * 2
+
+    t0 = time.perf_counter()
+    backlog = [blocked.remote() for _ in range(50_000)]
+    submit_rate = 50_000 / (time.perf_counter() - t0)
+    assert submit_rate > 5_000, f"submit rate collapsed: {submit_rate:.0f}/s"
+
+    # runnable work schedules promptly THROUGH the backlog
+    t0 = time.perf_counter()
+    assert ray_tpu.get([runnable.remote(i) for i in range(50)], timeout=60) == [
+        2 * i for i in range(50)
+    ]
+    dt = time.perf_counter() - t0
+    assert dt < 10.0, f"runnable tasks starved behind the backlog ({dt:.1f}s)"
+
+    t0 = time.perf_counter()
+    for ref in backlog[:1000]:
+        ray_tpu.cancel(ref)
+    assert time.perf_counter() - t0 < 10.0
+    del backlog
