@@ -31,12 +31,16 @@ def validate(options: dict[str, Any], is_actor: bool) -> None:
         if k not in allowed:
             raise ValueError(f"Invalid option {k!r} for {'actor' if is_actor else 'task'}")
     st = options.get("scheduling_strategy")
-    if options.get("label_selector") and st not in (None, "DEFAULT"):
+    pg = options.get("placement_group")
+    if options.get("label_selector") and (
+        st not in (None, "DEFAULT") or (pg is not None and pg != "default")
+    ):
         # fail fast: to_strategy can honor only one placement policy, and
         # silently dropping the label constraint would mis-place the task
         raise ValueError(
-            "label_selector cannot be combined with scheduling_strategy="
-            f"{st!r}; use NodeLabelSchedulingStrategy(hard=...) instead"
+            "label_selector cannot be combined with another placement policy "
+            f"(scheduling_strategy={st!r}, placement_group={pg!r}); use "
+            "NodeLabelSchedulingStrategy(hard=...) instead"
         )
 
 

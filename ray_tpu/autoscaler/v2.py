@@ -90,7 +90,10 @@ class AsyncNodeProvider:
     """Cooperative cloud interface: requests return immediately; progress
     is observed by polling (reference: v2 node provider abstraction)."""
 
-    def request_create(self, instance: Instance, resources: dict) -> None:
+    def request_create(self, instance: Instance, resources: dict, labels: dict) -> None:
+        """``labels`` are the node type's labels: the provider must stamp
+        them on the launched node (plus ``instance_id``) or label-gated
+        demand would never match the machine bought for it."""
         raise NotImplementedError
 
     def poll(self, instance: Instance) -> str:
@@ -164,9 +167,10 @@ class AutoscalerV2:
                 self.im.add(t)
                 active_by_type[t] = active_by_type.get(t, 0) + 1
         # then demand: each unplaceable shape gets the first type that fits,
-        # packing multiple shapes onto one pending instance's capacity
-        pending_caps: list[dict] = [
-            self._capacity_of(i.node_type)
+        # packing multiple shapes onto one pending instance's capacity —
+        # but a hard-labeled shape only onto a type whose labels satisfy it
+        pending_caps: list[tuple[dict, dict]] = [
+            (self._capacity_of(i.node_type), self.node_types[i.node_type].get("labels", {}))
             for i in self.im.with_status(QUEUED, REQUESTED, ALLOCATED)
         ]
         label_reqs = feed.get("pending_demand_labels") or []
@@ -182,7 +186,9 @@ class AutoscalerV2:
                 continue  # no node type can ever satisfy these labels:
                 # launching would ratchet useless instances to max_workers
             placed = False
-            for cap in pending_caps:
+            for cap, cap_labels in pending_caps:
+                if any(cap_labels.get(k) != v for k, v in hard_labels.items()):
+                    continue
                 if all(cap.get(k, 0.0) >= v for k, v in shape.items()):
                     for k, v in shape.items():
                         cap[k] = cap.get(k, 0.0) - v
@@ -203,14 +209,17 @@ class AutoscalerV2:
                 active_by_type[t] = active_by_type.get(t, 0) + 1
                 for k, v in shape.items():
                     cap[k] -= v
-                pending_caps.append(cap)
+                pending_caps.append((cap, type_labels))
                 break
 
     def _drive_lifecycle(self, now: float) -> None:
         for inst in list(self.im.instances.values()):
             if inst.status == QUEUED:
                 inst.set_status(REQUESTED)
-                self.provider.request_create(inst, self._capacity_of(inst.node_type))
+                spec = self.node_types[inst.node_type]
+                self.provider.request_create(
+                    inst, self._capacity_of(inst.node_type), dict(spec.get("labels", {}))
+                )
             elif inst.status == REQUESTED:
                 seen = self.provider.poll(inst)
                 if seen == ALLOCATED:
@@ -278,14 +287,16 @@ class FakeAsyncProvider(AsyncNodeProvider):
         self.delay_polls = delay_polls
         self.fail_first = fail_first
         self._polls: dict[str, int] = {}
+        self._resources_by_id: dict[str, dict] = {}
+        self._labels_by_id: dict[str, dict] = {}
         self.created: list[str] = []
         self.terminated: list[str] = []
 
-    def request_create(self, instance: Instance, resources: dict) -> None:
+    def request_create(self, instance: Instance, resources: dict, labels: dict) -> None:
         self._polls[instance.instance_id] = 0
         instance.provider_id = f"cloud-{instance.instance_id}"
-        self._resources_by_id = getattr(self, "_resources_by_id", {})
         self._resources_by_id[instance.instance_id] = dict(resources)
+        self._labels_by_id[instance.instance_id] = dict(labels)
 
     def poll(self, instance: Instance) -> str:
         self._polls[instance.instance_id] += 1
@@ -298,7 +309,8 @@ class FakeAsyncProvider(AsyncNodeProvider):
         if self.cluster is not None:
             node_id = self.cluster.add_node(
                 dict(self._resources_by_id[instance.instance_id]),
-                labels={"instance_id": instance.instance_id},
+                labels={**self._labels_by_id[instance.instance_id],
+                        "instance_id": instance.instance_id},
             )
             instance.ray_node_id = node_id.hex()
         return ALLOCATED

@@ -220,7 +220,7 @@ def _execute_durable(
         memo[key] = value
         return value
 
-    def harvest() -> Optional[BaseException]:
+    def harvest(best_effort: bool = False) -> Optional[BaseException]:
         """Checkpoint step results AS THEY COMPLETE, whatever order the
         branches finish in; returns the first step failure (siblings are
         saved before it surfaces — resume then re-runs only the failure
@@ -238,10 +238,15 @@ def _execute_durable(
                     if failure is None:
                         failure = e
                     continue
-                # a save failure is a DRIVER/storage problem, not a step
-                # failure: surface it now rather than re-running a step that
-                # already succeeded on the cluster
-                store.save_step(step_id, value)
+                try:
+                    # a save failure is a DRIVER/storage problem, not a step
+                    # failure: surface it now rather than re-running a step
+                    # that already succeeded on the cluster
+                    store.save_step(step_id, value)
+                except Exception:
+                    if not best_effort:
+                        raise
+                    continue  # cleanup path: keep draining the other refs
                 emit("step_completed", step_id)
         return failure
 
@@ -249,8 +254,12 @@ def _execute_durable(
         root = build(dag)
     except Exception:
         # a build-phase failure (e.g. materializing a failed MultiOutput
-        # branch) must still checkpoint completed siblings before raising
-        harvest()
+        # branch) must still checkpoint completed siblings before raising —
+        # best-effort, so a secondary storage error can't mask the root cause
+        try:
+            harvest(best_effort=True)
+        except Exception:
+            pass
         raise
     failure = harvest()
     if failure is not None:

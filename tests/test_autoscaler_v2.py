@@ -145,3 +145,41 @@ def test_idle_scale_down_respects_min_workers(ray_start_regular):
         time.sleep(0.05)
     running = asv2.im.with_status(RAY_RUNNING)
     assert len(running) == 2, counts  # floor held, nothing below min
+
+
+def test_labeled_demand_launches_labeled_node(ray_start_regular):
+    """A hard-label task must (a) pick the node type whose labels satisfy it
+    and (b) actually run — i.e. the provider stamps the type's labels onto
+    the launched node, not just instance_id."""
+    from ray_tpu._private.runtime import get_ctx
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    head = get_ctx().head
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"accel": "v5e"}), resources={"labnode": 1.0})
+    def on_v5e():
+        return "labeled"
+
+    ref = on_v5e.remote()  # no node carries accel=v5e yet
+    provider = FakeAsyncProvider(cluster=head, delay_polls=1)
+    asv2 = AutoscalerV2(
+        provider,
+        {
+            "plain": {"resources": {"CPU": 4.0, "labnode": 4.0}, "max_workers": 2},
+            "lab": {"resources": {"CPU": 4.0, "labnode": 4.0},
+                    "labels": {"accel": "v5e"}, "max_workers": 2},
+        },
+        head=head,
+    )
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        counts = asv2.update()
+        if counts.get(RAY_RUNNING):
+            break
+        time.sleep(0.05)
+    # the plain type also fits the resource shape, but only 'lab' satisfies
+    # the hard label — exactly one instance, of the labeled type
+    types = [i.node_type for i in asv2.im.active()]
+    assert types == ["lab"], types
+    assert ray_tpu.get(ref, timeout=60) == "labeled"

@@ -186,9 +186,10 @@ def test_cli_head_node_driver_roundtrip(tmp_path):
             env=CLI_ENV,
         )
         assert out.returncode == 0, out.stderr
-        # stray runtime prints (e.g. a slow worker's registration notice)
-        # can precede the JSON document: parse from the first '{'
-        summ = json.loads(out.stdout[out.stdout.index("{"):])
+        # stray runtime prints (warnings may even CONTAIN braces) can
+        # precede the document: the JSON starts at the first bare '{' line
+        lines = out.stdout.splitlines()
+        summ = json.loads("\n".join(lines[lines.index("{"):]))
         assert summ["tasks"]["by_state"].get("FINISHED", 0) >= 4
         assert len(summ["nodes"]) == 2
     finally:
