@@ -127,10 +127,50 @@ class Config:
     #: them fresh.
     head_reconnect_grace_s: float = 30.0
 
+    # -- object data plane -------------------------------------------------
+    #: Chunk size for node-to-node object transfers on the peer-to-peer
+    #: data plane (reference: object_manager.h ``object_chunk_size``, 64MB
+    #: there; smaller here because chunks also bound the sender's pin hold).
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: A node that answered "I don't hold that object" is not re-asked for
+    #: this long (reference: pull manager retry backoff) — bounds directory
+    #: chatter while a producer is still writing.
+    object_location_negative_cache_s: float = 5.0
+
+    # -- collective --------------------------------------------------------
+    #: Host-mediated allreduce switches from flat fan-in to the chunked
+    #: ring algorithm at this tensor size (reference: collective group
+    #: picks ring for large payloads).
+    collective_ring_threshold_bytes: int = 1 << 22
+
     # -- health ------------------------------------------------------------
     #: Interval of the head's liveness sweep over worker processes
     #: (reference: GcsHealthCheckManager probing raylets).
     health_check_interval_s: float = 1.0
+    #: Interval at which node agents push /proc-derived CPU/memory/disk
+    #: stats to the head (reference: the per-node reporter agent's
+    #: ``metrics_report_interval_ms``).
+    node_stats_report_interval_s: float = 5.0
+
+    # -- control-plane internals ------------------------------------------
+    #: Backstop flush period of the head's outbound-message queue; normal
+    #: sends flush immediately after the head lock releases — this only
+    #: bounds the tail when a flusher thread loses a race.
+    outbox_flush_backstop_s: float = 0.5
+    #: Task-event feed retention: when the in-memory feed exceeds this many
+    #: records, the oldest half is dropped (reference:
+    #: ``task_events_max_num_task_in_gcs``).
+    task_events_max_entries: int = 100_000
+
+    # -- serving / dashboards ---------------------------------------------
+    #: Default port of ``serve.start`` HTTP ingress proxies (reference:
+    #: serve's ``http_options.port``).
+    serve_http_port: int = 8000
+    #: Attempts per Serve handle call across replica failures before the
+    #: error surfaces to the caller (reference: router retry policy).
+    serve_handle_max_retries: int = 4
+    #: Default port of ``ray_tpu.dashboard.start`` (reference: 8265).
+    dashboard_port: int = 8265
 
     # -- logging -----------------------------------------------------------
     log_to_driver: bool = True
@@ -159,6 +199,28 @@ def _coerce(raw: str, typ: Any) -> Any:
 
 GLOBAL_CONFIG = Config()
 GLOBAL_CONFIG.apply_overrides()
+
+_DEFAULTS = Config()
+
+
+def config_overrides() -> dict[str, Any]:
+    """The non-default fields of the live config — what a head ships to a
+    joining node agent so the ``_system_config`` tier reaches remote
+    agent/worker processes (reference: GCS serving system_config to
+    raylets at registration)."""
+    return {
+        f.name: getattr(GLOBAL_CONFIG, f.name)
+        for f in dataclasses.fields(GLOBAL_CONFIG)
+        if getattr(GLOBAL_CONFIG, f.name) != getattr(_DEFAULTS, f.name)
+    }
+
+
+def apply_shipped(overrides: dict[str, Any]) -> None:
+    """Apply head-shipped overrides in an agent process, LOSING to any
+    explicit local env var (the operator set it on that host on purpose)."""
+    for k, v in overrides.items():
+        if hasattr(GLOBAL_CONFIG, k) and f"RAY_TPU_{k.upper()}" not in os.environ:
+            setattr(GLOBAL_CONFIG, k, v)
 
 
 # ---------------------------------------------------------------------------

@@ -37,7 +37,12 @@ import time
 from multiprocessing.connection import Client, Listener
 from typing import Optional
 
-_CHUNK = 8 * 1024 * 1024
+def _chunk_bytes() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    # floor, not validation error: a zero/negative override would make the
+    # sender's while loop emit empty messages forever
+    return max(4096, GLOBAL_CONFIG.object_transfer_chunk_bytes)
 
 
 class DataServer:
@@ -99,8 +104,9 @@ class DataServer:
                     total = loc.total_size
                     conn.send(("ok", total))
                     off = 0
+                    chunk = _chunk_bytes()
                     while off < total:
-                        n = min(_CHUNK, total - off)
+                        n = min(chunk, total - off)
                         conn.send_bytes(mv[off : off + n])
                         off += n
                     self.bytes_served += total

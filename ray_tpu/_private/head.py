@@ -42,6 +42,7 @@ from typing import Any, Optional
 
 from ray_tpu import exceptions as rex
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import config as _cfg
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu._private.shm_store import ShmLocation, ShmOwner
@@ -789,7 +790,13 @@ class Head:
             node.agent = AgentHandle(conn)
             if info.get("data_address"):
                 node.data_address = tuple(info["data_address"])
-        conn.send(("agent_ack", {"node_id": node_id.binary()}))
+        conn.send(("agent_ack", {
+            "node_id": node_id.binary(),
+            # ship the head's non-default config so the _system_config tier
+            # reaches remote agent/worker processes too (reference: GCS
+            # serves system_config to joining raylets), not just this host
+            "config": _cfg.config_overrides(),
+        }))
         with self.lock:
             self._schedule()  # queued-infeasible work may now fit
         return node_id
@@ -1096,7 +1103,7 @@ class Head:
 
     def _flush_backstop_loop(self) -> None:
         while not self._shutdown:
-            self._flush_event.wait(timeout=0.5)
+            self._flush_event.wait(timeout=GLOBAL_CONFIG.outbox_flush_backstop_s)
             self._flush_event.clear()
             self.flush_outbox()
 
@@ -3321,8 +3328,9 @@ class Head:
             (rec["task_id"], rec["spec"].get("name"), state, time.time(),
              rec["spec"].get("kind"))
         )
-        if len(self.task_events) > 100_000:
-            del self.task_events[:50_000]
+        if len(self.task_events) > GLOBAL_CONFIG.task_events_max_entries:
+            # floor of 1 so tiny settings still trim instead of growing forever
+            del self.task_events[: max(1, GLOBAL_CONFIG.task_events_max_entries // 2)]
 
 
 def _iter_arg_refs(spec: dict):

@@ -94,6 +94,21 @@ class NodeAgent:
         kind, info = self.conn.recv()
         assert kind == "agent_ack", kind
         self.node_id_bin: bytes = info["node_id"]
+        self._apply_shipped_config(info)
+
+    def _apply_shipped_config(self, ack_info: dict) -> None:
+        """Head-shipped ``_system_config`` overrides apply to THIS agent
+        process and (via env) to every worker it spawns — a local
+        ``RAY_TPU_*`` env var set by the operator still wins on this host."""
+        from ray_tpu._private import config as _cfg
+
+        shipped = ack_info.get("config") or {}
+        _cfg.apply_shipped(shipped)
+        self._config_env = {
+            f"RAY_TPU_{k.upper()}": str(getattr(_cfg.GLOBAL_CONFIG, k))
+            for k in shipped
+            if hasattr(_cfg.GLOBAL_CONFIG, k)
+        }
 
     # -- serve loop --------------------------------------------------------
 
@@ -153,6 +168,8 @@ class NodeAgent:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        for k, v in getattr(self, "_config_env", {}).items():
+            env.setdefault(k, v)  # operator's explicit env still wins
         if self.arena_name:
             # workers write their objects into THIS host's arena; the head
             # receives only the locator (see WorkerContext.put_serialized)
@@ -205,8 +222,10 @@ class NodeAgent:
 
         from ray_tpu._private.reporter import node_stats
 
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         while not self._stop.is_set():
-            _time.sleep(5.0)
+            _time.sleep(GLOBAL_CONFIG.node_stats_report_interval_s)
             try:
                 stats = node_stats()
                 with self._send_lock:
@@ -242,6 +261,7 @@ class NodeAgent:
                     raise OSError(f"unexpected reattach reply {kind!r}")
                 self.conn = conn
                 self.node_id_bin = info["node_id"]
+                self._apply_shipped_config(info)  # restarted head may differ
                 return True
             except Exception:
                 time.sleep(0.5)

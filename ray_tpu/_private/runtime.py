@@ -319,9 +319,11 @@ class BaseContext:
             except Exception:
                 addr = None
             # a negative result is transient (control hiccup, node still
-            # registering): cache it for 5s only, or one bad lookup would
+            # registering): cache it briefly only, or one bad lookup would
             # disable the data plane for this node forever
-            self._data_addrs[node_bin] = (addr, now + 5.0)
+            self._data_addrs[node_bin] = (
+                addr, now + GLOBAL_CONFIG.object_location_negative_cache_s
+            )
         if addr is None:
             return None
         host, port = addr

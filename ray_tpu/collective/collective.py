@@ -137,10 +137,14 @@ def _fanin(g, kind: str, tensor, op: Optional[str], timeout: float):
     )
 
 
-#: tensors at or above this many bytes allreduce via the chunked ring (bulk
-#: bytes peer-to-peer through the object plane; the coordinator shuttles
-#: only refs) instead of riding the coordinator call itself
-RING_THRESHOLD_BYTES = 1 << 22
+def _ring_threshold() -> int:
+    """Tensors at or above this many bytes allreduce via the chunked ring
+    (bulk bytes peer-to-peer through the object plane; the coordinator
+    shuttles only refs) instead of riding the coordinator call itself.
+    Tunable: ``collective_ring_threshold_bytes``."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.collective_ring_threshold_bytes
 
 
 def _combine(a, b, opname):
@@ -215,7 +219,7 @@ def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM, timeout: flo
     g = _group(group_name)
     opname = op.value if isinstance(op, ReduceOp) else str(op)
     arr = np.asarray(tensor)
-    if arr.nbytes >= RING_THRESHOLD_BYTES and g["info"].world_size > 1:
+    if arr.nbytes >= _ring_threshold() and g["info"].world_size > 1:
         result = _ring_allreduce(g, arr, opname, timeout)
     else:
         result = _fanin(g, "allreduce", arr, opname, timeout)

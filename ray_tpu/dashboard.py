@@ -136,10 +136,15 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
 
-def start(host: str = "127.0.0.1", port: int = 8265) -> str:
+def start(host: str = "127.0.0.1", port: Optional[int] = None) -> str:
     """Start the dashboard server (idempotent). Returns its URL.
 
-    ``port=0`` picks a free port (the URL reports the real one)."""
+    Default port comes from the ``dashboard_port`` config flag (8265, like
+    the reference); ``port=0`` picks a free port (the URL reports it)."""
+    if port is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        port = GLOBAL_CONFIG.dashboard_port
     global _server, _thread
     if _server is not None:
         h, p = _server.server_address[:2]

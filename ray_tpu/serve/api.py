@@ -22,7 +22,10 @@ from ray_tpu.serve._private.common import (
 )
 from ray_tpu.serve.handle import DeploymentHandle
 
-DEFAULT_HTTP_PORT = 8000
+def _default_http_port() -> int:  # tunable: serve_http_port
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.serve_http_port
 
 
 def _wrap_function(fn: Callable) -> type:
@@ -187,7 +190,7 @@ def run(
     name: str = "default",
     route_prefix: Optional[str] = None,
     http: bool = False,
-    http_port: int = DEFAULT_HTTP_PORT,
+    http_port: Optional[int] = None,
     _blocking: bool = True,
 ) -> DeploymentHandle:
     """Deploy an application; returns the ingress DeploymentHandle.
@@ -201,6 +204,8 @@ def run(
     specs, ingress = _collect_specs(app, name)
     ray_tpu.get(controller.deploy_application.remote(name, specs), timeout=120)
     if http:
+        if http_port is None:
+            http_port = _default_http_port()
         ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
     if _blocking:
         deadline = time.time() + 120

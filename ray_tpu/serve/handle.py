@@ -40,7 +40,11 @@ class DeploymentResponse:
         self._retry = retry  # zero-arg callable re-submitting the request
         self._done = False
 
-    MAX_RETRIES = 4
+    @staticmethod
+    def max_retries() -> int:  # tunable: serve_handle_max_retries
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        return GLOBAL_CONFIG.serve_handle_max_retries
 
     def result(self, timeout: Optional[float] = None) -> Any:
         import ray_tpu
@@ -356,7 +360,7 @@ class DeploymentHandle:
         from ray_tpu.exceptions import RayActorError
 
         if _retries is None:
-            _retries = DeploymentResponse.MAX_RETRIES
+            _retries = DeploymentResponse.max_retries()
         router = self._get_router()
         # unwrap nested responses so composition chains pass values not refs
         args = tuple(a.result() if isinstance(a, DeploymentResponse) else a for a in args)

@@ -211,3 +211,23 @@ def test_dag_bind_execute(ray_start_regular):
 
     node = add.bind(add.bind(1, 2), 4)
     assert ray_tpu.get(node.execute()) == 7
+
+
+def test_config_flag_tiers(monkeypatch):
+    """The three override tiers (default < RAY_TPU_ env < _system_config)
+    apply to every dataclass field, including the round-3 knobs that were
+    previously hardcoded (reference: RAY_CONFIG flag system)."""
+    from ray_tpu._private.config import Config
+
+    cfg = Config()
+    assert cfg.object_transfer_chunk_bytes == 8 * 1024 * 1024
+    assert cfg.collective_ring_threshold_bytes == 1 << 22
+    assert cfg.serve_handle_max_retries == 4
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", "1048576")
+    monkeypatch.setenv("RAY_TPU_DASHBOARD_PORT", "9999")
+    cfg.apply_overrides({"serve_handle_max_retries": 7})
+    assert cfg.object_transfer_chunk_bytes == 1048576  # env tier
+    assert cfg.dashboard_port == 9999
+    assert cfg.serve_handle_max_retries == 7  # _system_config tier wins
+    with pytest.raises(ValueError, match="Unknown _system_config"):
+        cfg.apply_overrides({"not_a_flag": 1})

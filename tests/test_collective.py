@@ -142,11 +142,11 @@ def test_ring_allreduce_correct_and_fast(ray_start_regular):
     printed for the record."""
     import os
 
-    from ray_tpu.collective.collective import RING_THRESHOLD_BYTES
+    from ray_tpu.collective.collective import _ring_threshold
 
     world = 8
     n = (64 * 1024 * 1024) // 8  # 64 MB of float64 per rank
-    assert n * 8 >= RING_THRESHOLD_BYTES  # actually exercises the ring
+    assert n * 8 >= _ring_threshold()  # actually exercises the ring
     members = [RingMember.remote(r, world) for r in range(world)]
     results = ray_tpu.get([m.big_allreduce.remote(n) for m in members], timeout=240)
     expect = float(sum(range(1, world + 1)))
@@ -171,7 +171,7 @@ def test_ring_just_over_threshold(ray_start_regular):
 
     world = 4
     members = [RingMember.options(name=f"rm{r}").remote(r, world, "ring2") for r in range(world)]
-    n = cc.RING_THRESHOLD_BYTES // 8 + 1024  # just over the ring threshold
+    n = cc._ring_threshold() // 8 + 1024  # just over the ring threshold
     results = ray_tpu.get([m.big_allreduce.remote(n) for m in members], timeout=120)
     expect = float(sum(range(1, world + 1)))
     assert all(first == expect and last == expect for first, last, _ in results)

@@ -200,3 +200,51 @@ def test_cli_head_node_driver_roundtrip(tmp_path):
                     p.wait(timeout=10)
                 except Exception:
                     p.kill()
+
+
+def test_system_config_ships_to_agents(monkeypatch):
+    """The head sends its non-default config with agent_ack so the
+    ``_system_config`` tier reaches remote agent/worker processes (the
+    reference's GCS serves system_config to joining raylets). A local
+    RAY_TPU_* env var on the agent's host still wins."""
+    from ray_tpu._private import config as cfg
+
+    monkeypatch.setattr(cfg.GLOBAL_CONFIG, "node_stats_report_interval_s", 1.25)
+    monkeypatch.setattr(cfg.GLOBAL_CONFIG, "object_transfer_chunk_bytes", 65536)
+    shipped = cfg.config_overrides()
+    assert shipped["node_stats_report_interval_s"] == 1.25
+    assert shipped["object_transfer_chunk_bytes"] == 65536
+
+    # receiving side: shipped values apply, except where the operator set env
+    monkeypatch.setattr(cfg.GLOBAL_CONFIG, "node_stats_report_interval_s", 5.0)
+    monkeypatch.setattr(cfg.GLOBAL_CONFIG, "object_transfer_chunk_bytes", 8 << 20)
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", "1048576")
+    cfg.apply_shipped(shipped)
+    assert cfg.GLOBAL_CONFIG.node_stats_report_interval_s == 1.25
+    assert cfg.GLOBAL_CONFIG.object_transfer_chunk_bytes == 8 << 20  # env wins
+
+
+def test_shipped_config_reaches_spawned_workers(tcp_cluster, monkeypatch):
+    """End to end: an agent forwards head-shipped overrides to the workers
+    it spawns, so worker-side knobs follow the driver's _system_config."""
+    from ray_tpu._private import config as cfg
+
+    monkeypatch.setattr(cfg.GLOBAL_CONFIG, "streaming_backpressure_items", 5)
+    # the fixture's agent registered BEFORE the override: late-joining agents
+    # get the current value (registration-time snapshot semantics)
+    agent2 = NodeAgent(
+        tcp_cluster["address"], resolve_authkey(), resources={"CPU": 1.0, "late": 1.0}
+    ).start()
+    try:
+        assert agent2._config_env.get("RAY_TPU_STREAMING_BACKPRESSURE_ITEMS") == "5"
+        ray_tpu.init(address=tcp_cluster["address"])
+
+        @ray_tpu.remote(resources={"late": 1.0})
+        def worker_sees():
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            return GLOBAL_CONFIG.streaming_backpressure_items
+
+        assert ray_tpu.get(worker_sees.remote(), timeout=60) == 5
+    finally:
+        agent2.shutdown()
