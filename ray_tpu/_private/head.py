@@ -670,13 +670,7 @@ class Head:
                             if n is not None:
                                 n.stats = msg[1]
                 elif kind == "worker_stacks":
-                    with self._stacks_cv:
-                        self._stacks_replies[msg[1]["req_id"]] = msg[1]["stacks"]
-                        # bound: replies landing after their caller timed
-                        # out are never consumed — don't accumulate blobs
-                        while len(self._stacks_replies) > 64:
-                            self._stacks_replies.pop(next(iter(self._stacks_replies)))
-                        self._stacks_cv.notify_all()
+                    self._mailbox_post(msg[1]["req_id"], msg[1]["stacks"])
                 elif kind == "req":
                     _, seq, method, payload = msg
                     self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
@@ -761,6 +755,21 @@ class Head:
             self._on_stream_item(wh, msg[1])
         elif kind == "actor_ready":
             self._on_actor_ready(wh, msg[1])
+        elif kind == "profile_result":
+            # shared reply mailbox with stack dumps; workers of one node
+            # merge under their node's req_id
+            self._mailbox_post(msg[1]["req_id"], {msg[1]["pid"]: msg[1]["profile"]})
+
+    def _mailbox_post(self, req_id: str, update: dict) -> None:
+        """Merge a reply into the stacks/profile rendezvous mailbox. Bounded:
+        replies landing after their caller timed out are never consumed —
+        don't accumulate blobs (64 req_ids, not 64 workers: multiple workers
+        of one node merge under one id)."""
+        with self._stacks_cv:
+            self._stacks_replies.setdefault(req_id, {}).update(update)
+            while len(self._stacks_replies) > 64:
+                self._stacks_replies.pop(next(iter(self._stacks_replies)))
+            self._stacks_cv.notify_all()
 
     def _any_node_id(self) -> bytes:
         with self.lock:
@@ -811,7 +820,8 @@ class Head:
         if remote and method == "get":
             handler = self._rpc_get_remote
         blocking = method in (
-            "get", "wait", "pg_ready", "get_actor_named", "stream_next", "worker_stacks"
+            "get", "wait", "pg_ready", "get_actor_named", "stream_next",
+            "worker_stacks", "worker_profile",
         )
         if blocking:
             # blocking RPCs park until objects/actors materialize; run them
@@ -3193,6 +3203,60 @@ class Head:
                     self._stacks_cv.wait(timeout=0.2)
         for rid, node_hex in req_ids.items():
             out[node_hex] = {"error": "no reply within timeout"}
+        return out
+
+    def rpc_worker_profile(self, duration_s: float = 2.0, interval_ms: float = 10.0,
+                           timeout: float = 0.0):
+        """Sampling CPU profile of every live worker (reference: the
+        dashboard's py-spy ``cpu_profile`` endpoint). Each worker samples
+        itself (``reporter.sample_profile``) and posts collapsed stacks
+        back; returns ``{node_hex: {pid: collapsed_text}}`` — feed a value
+        straight to flamegraph.pl or speedscope."""
+        import uuid as _uuid
+
+        duration_s = min(max(float(duration_s), 0.05), 60.0)  # bound GIL cost
+        timeout = timeout or duration_s + 5.0
+        deadline = time.monotonic() + timeout
+        req = {"duration_s": duration_s, "interval_s": interval_ms / 1000.0}
+        # one req_id per NODE (its workers merge into one mailbox entry):
+        # keeps the 64-entry mailbox bound a per-node bound, not per-worker
+        req_ids: dict[str, tuple[str, int]] = {}  # rid -> (node_hex, expected)
+        with self.lock:
+            for node in self.nodes.values():
+                if not node.alive:
+                    continue
+                whs = [wh for wh in node.all_workers if wh.conn is not None]
+                if not whs:
+                    continue
+                rid = _uuid.uuid4().hex
+                for wh in whs:
+                    self._enqueue_send(wh, ("profile", dict(req, req_id=rid)))
+                req_ids[rid] = (node.node_id.hex(), len(whs))
+        self.flush_outbox()
+        out: dict[str, dict] = {}
+
+        def _take(rid: str, node_hex: str, expected: int) -> None:
+            got = self._stacks_replies.pop(rid, None) or {}
+            dest = out.setdefault(node_hex, {})
+            dest.update({str(p): t for p, t in got.items()})
+            if len(got) < expected:
+                # distinct key shape from pids (cf. rpc_worker_stacks' node-
+                # level error): callers iterate pids without tripping on it
+                dest["_errors"] = [
+                    f"{expected - len(got)} worker(s) did not reply within timeout"
+                ]
+
+        with self._stacks_cv:
+            while req_ids and time.monotonic() < deadline:
+                for rid in list(req_ids):
+                    node_hex, expected = req_ids[rid]
+                    if len(self._stacks_replies.get(rid) or {}) >= expected:
+                        _take(rid, node_hex, expected)
+                        req_ids.pop(rid)
+                if req_ids:
+                    self._stacks_cv.wait(timeout=0.2)
+            for rid, (node_hex, expected) in req_ids.items():
+                _take(rid, node_hex, expected)  # deadline: keep partials
         return out
 
     def rpc_task_events(self):

@@ -152,3 +152,44 @@ def node_stats() -> dict:
     except OSError:
         pass
     return stats
+
+
+# ---------------------------------------------------------------------------
+# on-demand sampling CPU profiler (reference: the dashboard's py-spy
+# ``/worker/cpu_profile`` endpoint — dashboard/modules/reporter spawns
+# ``py-spy record`` against a worker pid). TPU-native take: no subprocess
+# and no ptrace needed — the worker samples ITSELF from a daemon thread via
+# sys._current_frames(), emitting Brendan-Gregg collapsed-stack lines that
+# flamegraph.pl / speedscope ingest directly. ptrace-free matters in
+# containers (CAP_SYS_PTRACE is usually dropped); the trade-off is that a
+# fully wedged interpreter can't self-sample — that case is covered by the
+# SIGUSR1 faulthandler dumps above, which are C-level.
+# ---------------------------------------------------------------------------
+
+
+def sample_profile(duration_s: float = 2.0, interval_s: float = 0.01) -> str:
+    """Sample every thread's Python stack for ``duration_s``; returns
+    collapsed-stack text (``frame;frame;frame count`` per line, hottest
+    first). Frames render as ``file.py:function``."""
+    import sys
+    import threading
+
+    counts: dict[str, int] = {}
+    me = threading.get_ident()
+    end = time.monotonic() + max(0.05, duration_s)
+    interval_s = max(0.001, interval_s)
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # never profile the profiler
+            parts: list[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{os.path.basename(code.co_filename)}:{code.co_name}")
+                f = f.f_back
+            key = ";".join(reversed(parts))
+            counts[key] = counts.get(key, 0) + 1
+        time.sleep(interval_s)
+    lines = [f"{k} {v}" for k, v in sorted(counts.items(), key=lambda kv: -kv[1])]
+    return "\n".join(lines)

@@ -233,10 +233,48 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
                     state.stream_acked.get(tid, 0), msg[1]["consumed"]
                 )
                 state.stream_cv.notify_all()
+        elif kind == "profile":
+            _start_profile(ctx, msg[1])
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
             os._exit(0)
+
+
+_profile_gate = threading.Lock()
+
+
+def _start_profile(ctx, req: dict) -> None:
+    """On-demand sampling CPU profile (reference: the dashboard's py-spy
+    endpoint): sample this worker's threads off the recv loop, then post
+    the collapsed stacks back to the head's reply mailbox. Single-flight
+    with a bounded duration: samplers burn GIL time, so overlapping
+    requests (a dashboard poller in a retry loop) must not stack."""
+
+    def _run():
+        from ray_tpu._private.reporter import sample_profile
+
+        if not _profile_gate.acquire(blocking=False):
+            text = "<profile already in progress>"
+        else:
+            try:
+                text = sample_profile(
+                    min(float(req.get("duration_s", 2.0)), 60.0),
+                    float(req.get("interval_s", 0.01)),
+                )
+            except Exception as e:
+                text = f"<profile failed: {e!r}>"
+            finally:
+                _profile_gate.release()
+        try:
+            ctx.send_raw(
+                ("profile_result",
+                 {"req_id": req["req_id"], "pid": os.getpid(), "profile": text})
+            )
+        except Exception:
+            pass  # head gone: nothing to report to
+
+    threading.Thread(target=_run, daemon=True, name="rt-profiler").start()
 
 
 def _handle_cancel(state: WorkerState, task_id: bytes):

@@ -32,6 +32,11 @@ def _payload(path: str):
     from ray_tpu.util import metrics as um
     from ray_tpu.util import state as st
 
+    from urllib.parse import parse_qs, urlsplit
+
+    parts = urlsplit(path)
+    path, query = parts.path.rstrip("/"), parse_qs(parts.query)
+
     if path == "/api/version":
         return {"ray_tpu": getattr(ray_tpu, "__version__", "dev"), "dashboard": 1}
     if path == "/api/nodes":
@@ -55,6 +60,9 @@ def _payload(path: str):
         return st.get_node_stats()
     if path == "/api/worker_stacks":
         return st.get_worker_stacks()
+    if path == "/api/profile":
+        seconds = min(max(float(query.get("seconds", ["2"])[0]), 0.05), 60.0)
+        return st.profile_workers(duration_s=seconds)
     if path == "/api/timeline":
         return st.timeline()
     if path == "/api/jobs":
@@ -116,7 +124,7 @@ class _Handler(BaseHTTPRequestHandler):
                 body = um.prometheus_text().encode()
                 ctype = "text/plain; version=0.0.4"
             else:
-                data = _payload(self.path.rstrip("/"))
+                data = _payload(self.path)
                 if data is None:
                     self.send_error(404)
                     return

@@ -120,6 +120,26 @@ def cmd_stacks(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Sampling CPU profile of every worker; prints collapsed stacks
+    (pipe a section into flamegraph.pl — reference: `ray timeline`-era
+    dashboard py-spy cpu_profile)."""
+    from ray_tpu.util import state
+
+    ray_tpu = _attached(args.address)
+    prof = state.profile_workers(
+        duration_s=args.seconds, interval_ms=1000.0 / max(args.rate, 1.0)
+    )
+    for node, per_pid in prof.items():
+        for err in per_pid.pop("_errors", []):
+            print(f"==== node {node}: {err} ====", file=sys.stderr)
+        for pid, text in per_pid.items():
+            print(f"==== node {node} worker {pid} ====")
+            print(text)
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_nodestats(args) -> int:
     from ray_tpu.util import state
 
@@ -219,6 +239,15 @@ def main(argv=None) -> int:
     p = sub.add_parser("stacks", help="dump every worker's thread stacks (stuck-worker debugging)")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_stacks)
+
+    p = sub.add_parser(
+        "profile",
+        help="sampling CPU profile of every worker (collapsed stacks for flamegraph.pl)",
+    )
+    p.add_argument("--address", required=True)
+    p.add_argument("--seconds", type=float, default=2.0)
+    p.add_argument("--rate", type=float, default=100.0, help="samples per second")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("node-stats", help="per-node cpu/mem/disk stats")
     p.add_argument("--address", required=True)

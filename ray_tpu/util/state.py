@@ -72,6 +72,16 @@ def get_worker_stacks(timeout: float = 5.0) -> dict:
     return _ctx().call("worker_stacks", timeout=timeout)
 
 
+def profile_workers(duration_s: float = 2.0, interval_ms: float = 10.0) -> dict:
+    """Sampling CPU profile of every live worker for ``duration_s``
+    (reference: the dashboard's py-spy ``cpu_profile`` endpoint). Returns
+    ``{node: {pid: collapsed_stacks}}`` — each value is flamegraph.pl /
+    speedscope-ready collapsed-stack text, hottest stack first."""
+    return _ctx().call(
+        "worker_profile", duration_s=duration_s, interval_ms=interval_ms
+    )
+
+
 # ---------------------------------------------------------------------------
 # summaries (reference: `ray summary tasks/actors/objects`)
 # ---------------------------------------------------------------------------

@@ -231,3 +231,36 @@ def test_agent_node_stats_and_stacks(ray_start_regular):
         ray_tpu.cancel(ref, force=True)
     finally:
         agent.shutdown()
+
+
+def test_worker_cpu_profile_shows_hot_function(ray_start_regular):
+    """On-demand sampling profiler (reference: dashboard py-spy
+    cpu_profile): collapsed stacks of a busy worker must attribute samples
+    to the user function that is burning the CPU, leaf-most frame last."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def burn_cpu_marker_fn():
+        t0 = time.time()
+        while time.time() - t0 < 15:
+            sum(range(256))
+        return True
+
+    ref = burn_cpu_marker_fn.remote()
+    try:
+        time.sleep(1.0)  # ensure the worker is inside the burn loop
+        prof = state.profile_workers(duration_s=0.6, interval_ms=5.0)
+        blobs = [t for per in prof.values() for t in per.values()
+                 if isinstance(t, str)]
+        assert blobs, prof
+        text = "\n".join(blobs)
+        assert "burn_cpu_marker_fn" in text, text[:2000]
+        # collapsed format: every line is "frame;frame;... count"
+        hot = [l for l in text.splitlines() if "burn_cpu_marker_fn" in l][0]
+        stack, count = hot.rsplit(" ", 1)
+        assert int(count) >= 1 and ";" in stack
+    finally:
+        ray_tpu.cancel(ref, force=True)
