@@ -38,6 +38,7 @@ class TD3Config(AlgorithmConfig):
         self.target_noise = 0.2           # target policy smoothing
         self.target_noise_clip = 0.5
         self.policy_delay = 2             # actor updates every d critic steps
+        self.twin_q = True                # False = classic DDPG single critic
 
     algo_class = None  # set below
 
@@ -47,7 +48,7 @@ class TD3Module:
 
     discrete = False
 
-    def __init__(self, spec, exploration_noise: float = 0.1):
+    def __init__(self, spec, exploration_noise: float = 0.1, twin_q: bool = True):
         assert isinstance(spec.action_space, Box), "TD3 needs a Box action space"
         self.spec = spec
         self.obs_dim = int(np.prod(spec.observation_space.shape))
@@ -55,6 +56,7 @@ class TD3Module:
         self.act_low = np.asarray(spec.action_space.low, np.float32).reshape(-1)
         self.act_high = np.asarray(spec.action_space.high, np.float32).reshape(-1)
         self.exploration_noise = exploration_noise
+        self.twin_q = twin_q  # False = classic DDPG's single critic
 
     def init(self, rng):
         kp, k1, k2 = jax.random.split(rng, 3)
@@ -62,16 +64,18 @@ class TD3Module:
         q_sizes = [self.obs_dim + self.act_dim] + h + [1]
         pi = _mlp_init(kp, [self.obs_dim] + h + [self.act_dim])
         q1 = _mlp_init(k1, q_sizes, final_scale=1.0)
-        q2 = _mlp_init(k2, q_sizes, final_scale=1.0)
         copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
-        return {
+        params = {
             "pi": pi,
             "q1": q1,
-            "q2": q2,
             "target_pi": copy(pi),
             "target_q1": copy(q1),
-            "target_q2": copy(q2),
         }
+        if self.twin_q:
+            q2 = _mlp_init(k2, q_sizes, final_scale=1.0)
+            params["q2"] = q2
+            params["target_q2"] = copy(q2)
+        return params
 
     def _squash(self, u):
         scale = (self.act_high - self.act_low) / 2.0
@@ -94,6 +98,8 @@ class TD3Module:
         x = jnp.concatenate([obs, act], axis=-1)
         k1, k2 = ("target_q1", "target_q2") if target else ("q1", "q2")
         q1 = _mlp_apply(params[k1], x, activation=jax.nn.relu)[..., 0]
+        if not self.twin_q:
+            return q1, q1  # single critic: min() and the twin loss collapse
         q2 = _mlp_apply(params[k2], x, activation=jax.nn.relu)[..., 0]
         return q1, q2
 
@@ -122,7 +128,9 @@ def td3_loss(gamma: float, target_noise: float, noise_clip: float, policy_delay:
             rew + gamma * (1.0 - done) * jnp.minimum(tq1, tq2)
         )
         q1, q2 = module.q_values(params, obs, act)
-        q_loss = jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+        q_loss = jnp.mean((q1 - target) ** 2)
+        if module.twin_q:
+            q_loss = q_loss + jnp.mean((q2 - target) ** 2)
 
         # -- actor, gated by the policy delay (Q frozen) -------------------
         pi_a = module.policy(params, obs)
@@ -142,7 +150,8 @@ def td3_loss(gamma: float, target_noise: float, noise_clip: float, policy_delay:
 def _polyak_all(tau: float):
     def update(learner):
         p = dict(learner.params)
-        for src, dst in (("pi", "target_pi"), ("q1", "target_q1"), ("q2", "target_q2")):
+        pairs = (("pi", "target_pi"), ("q1", "target_q1"), ("q2", "target_q2"))
+        for src, dst in ((s, d) for s, d in pairs if d in p):
             p[dst] = jax.tree_util.tree_map(
                 lambda t, s: (1.0 - tau) * t + tau * s, p[dst], p[src]
             )
@@ -161,7 +170,9 @@ class TD3(Algorithm):
         cfg = self.config
 
         def make(spec):
-            return TD3Module(spec, exploration_noise=cfg.exploration_noise)
+            return TD3Module(
+                spec, exploration_noise=cfg.exploration_noise, twin_q=cfg.twin_q
+            )
 
         return make
 
@@ -173,7 +184,9 @@ class TD3(Algorithm):
         spec = RLModuleSpec(obs_space, act_space, hidden=tuple(cfg.hidden))
         self.learner_group = LearnerGroup(
             dict(
-                module_factory=lambda: TD3Module(spec, cfg.exploration_noise),
+                module_factory=lambda: TD3Module(
+                    spec, cfg.exploration_noise, twin_q=cfg.twin_q
+                ),
                 loss_fn=td3_loss(
                     cfg.gamma, cfg.target_noise, cfg.target_noise_clip, cfg.policy_delay
                 ),

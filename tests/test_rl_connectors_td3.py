@@ -120,3 +120,51 @@ class TestTD3:
         from ray_tpu.rl import get_algorithm_class
 
         assert get_algorithm_class("TD3") is not None
+
+
+class TestDDPG:
+    def test_ddpg_single_critic_trains(self):
+        """DDPG = TD3 minus the three tricks: the param tree must carry ONE
+        critic (no q2/target_q2) and still train to finite losses."""
+        from ray_tpu.rl.algorithms.ddpg import DDPGConfig
+
+        algo = (
+            DDPGConfig()
+            .environment("Pendulum-v1")
+            .training(
+                learning_starts=200,
+                sample_steps_per_iter=250,
+                updates_per_iter=40,
+                train_batch_size=64,
+            )
+            .debugging(seed=0)
+            .build()
+        )
+        r = algo.train()
+        r = algo.train()
+        assert "learner/q_loss" in r and np.isfinite(r["learner/q_loss"])
+        p = algo.get_weights()
+        assert "q1" in p and "target_q1" in p
+        assert "q2" not in p and "target_q2" not in p
+
+    def test_ddpg_actor_updates_every_step(self):
+        """policy_delay=1: pi_loss must be non-zero on (virtually) every
+        update, unlike TD3 where alternate steps gate it to 0."""
+        from ray_tpu.rl.algorithms.ddpg import DDPGConfig
+
+        cfg = DDPGConfig()
+        assert cfg.policy_delay == 1 and cfg.target_noise == 0.0
+        algo = (
+            DDPGConfig()
+            .environment("Pendulum-v1")
+            .training(
+                learning_starts=100,
+                sample_steps_per_iter=150,
+                updates_per_iter=10,
+                train_batch_size=32,
+            )
+            .debugging(seed=1)
+            .build()
+        )
+        r = algo.train()
+        assert r["learner/pi_loss"] != 0.0
