@@ -489,6 +489,15 @@ class Head:
         # hold a thread each, so the cap must stay generous (a too-small
         # pool would queue NEW gets behind parked ones)
         self._blocking_pool = _DaemonPool(4096, "head-rpc")
+        # worker-spawn dispatch: Thread.start() must NEVER run under the head
+        # lock — start() blocks until the child's bootstrap sets _started, and
+        # a GC tick in that bootstrap window used to re-enter the head lock
+        # via ObjectRef.__del__, wedging the whole head. Spawn requests are
+        # queued here and started by a dedicated dispatcher thread instead.
+        self._spawn_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        threading.Thread(
+            target=self._spawn_dispatch_loop, name="spawn-dispatch", daemon=True
+        ).start()
         self._snapshot_due = 0.0
         # detached actors restored from a snapshot, waiting for their old
         # worker to reconnect; past the grace window they re-create fresh
@@ -1449,6 +1458,28 @@ class Head:
             node.release(res)
             self._retry_pending_pgs()
 
+    def _spawn_dispatch_loop(self):
+        """Runs spawn thunks on fresh threads from OUTSIDE any lock (see
+        _spawn_q comment in __init__). Must never die: if the OS refuses a
+        new thread, degrade to running the spawn inline (serialized but
+        alive) rather than silently disabling all future spawning."""
+        import traceback as _tb
+
+        while True:
+            item = self._spawn_q.get()
+            if item is None:
+                return
+            fn, args, kwargs = item
+            try:
+                threading.Thread(
+                    target=fn, args=args, kwargs=kwargs, daemon=True
+                ).start()
+            except RuntimeError:  # can't start new thread
+                try:
+                    fn(*args, **kwargs)
+                except Exception:  # noqa: BLE001 - keep the dispatcher alive
+                    _tb.print_exc()
+
     def _maybe_spawn(self, node: NodeState):
         cap = max(int(node.resources_total.get("CPU", 1)), 1)
         pool = (
@@ -1457,7 +1488,7 @@ class Head:
         )
         if node.assigned and pool < cap:
             node.spawning += 1
-            threading.Thread(target=self._spawn_worker, args=(node,), daemon=True).start()
+            self._spawn_q.put((self._spawn_worker, (node,), {}))
 
     # ------------------------------------------------------------ completion
 
@@ -2053,9 +2084,7 @@ class Head:
         # Keyed by actor id, NOT queued on node.assigned: only the dedicated
         # worker spawned for this actor may pick it up.
         self._actor_create_recs[spec["actor_id"]] = rec
-        threading.Thread(
-            target=self._spawn_actor_worker, args=(node, spec["actor_id"]), daemon=True
-        ).start()
+        self._spawn_q.put((self._spawn_actor_worker, (node, spec["actor_id"]), {}))
 
     def _spawn_actor_worker(self, node: NodeState, actor_id: bytes):
         self._spawn_worker(node, actor_id=actor_id)
@@ -3371,6 +3400,7 @@ class Head:
         if self.data_server is not None:
             self.data_server.shutdown()
         self._pub_queue.put(None)
+        self._spawn_q.put(None)
         self._blocking_pool.shutdown()
         self._snapshot()
         self.shm_owner.shutdown()

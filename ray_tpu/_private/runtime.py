@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import os
+import queue
 import threading
 import time
 from typing import Any, Optional
@@ -83,9 +84,15 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __del__(self):
+        # GC-safety: __del__ can fire at ANY allocation point, including in a
+        # thread that holds (or is awaited by a holder of) the head lock or a
+        # connection send lock. The only safe operation here is a reentrant
+        # SimpleQueue.put; a dedicated drain thread performs the real
+        # decrement (reference: reference_count.h posts decrements to the
+        # io_context for the same reason — never block in a destructor).
         if self._owned and _ctx is not None and not _ctx.closed:
             try:
-                _ctx.call("free_ref_async", obj_id=self._id)
+                _ctx.enqueue_gc("call", ("free_ref_async", {"obj_id": self._id}))
             except Exception:
                 pass
 
@@ -188,10 +195,17 @@ class ObjectRefGenerator:
                 pass
 
     def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+        # GC-safe dispose: close() issues a blocking RPC, which must never
+        # run from a GC tick (see ObjectRef.__del__); enqueue it instead.
+        if not self._disposed:
+            self._disposed = True
+            try:
+                if not self._ctx.closed:
+                    self._ctx.enqueue_gc(
+                        "call", ("stream_dispose", {"task_id": self._task_id})
+                    )
+            except Exception:
+                pass
 
     def __repr__(self):
         return f"ObjectRefGenerator({self._task_id.hex()[:8]}, next={self._i})"
@@ -217,6 +231,45 @@ class BaseContext:
         # (reference: src/ray/pubsub subscriber channels)
         self._pub_sinks: dict[str, list] = {}
         self._pub_lock = threading.Lock()
+        # GC drain: __del__ methods (ObjectRef, generators, actor handles,
+        # compiled DAGs) may ONLY touch this queue — SimpleQueue.put is
+        # C-implemented and reentrant-safe, so a GC tick inside a lock-held
+        # critical section can never re-enter head/connection locks. The
+        # drain thread performs the real (possibly blocking) calls.
+        self._gc_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._gc_thread = threading.Thread(
+            target=self._gc_drain_loop, name="gc-drain", daemon=True
+        )
+        self._gc_thread.start()
+
+    def enqueue_gc(self, kind: str, payload) -> None:
+        """The ONLY operation a __del__ may perform against the runtime.
+        kind: "call" -> (method, kwargs) executed via self.call;
+        "thunk" -> zero-arg callable run on the drain thread."""
+        self._gc_q.put((kind, payload))
+
+    def _gc_drain_loop(self) -> None:
+        while True:
+            item = self._gc_q.get()
+            if item is None:
+                return
+            if self.closed:
+                continue  # keep draining so shutdown's sentinel is reached
+            kind, payload = item
+            try:
+                if kind == "call":
+                    method, kwargs = payload
+                    self.call(method, **kwargs)
+                elif kind == "thunk":
+                    # thunks may block for seconds (e.g. CompiledDAG teardown
+                    # joins its exec loops): run off-thread so queued ref
+                    # frees aren't stalled behind them
+                    try:
+                        threading.Thread(target=payload, daemon=True).start()
+                    except RuntimeError:
+                        payload()
+            except Exception:
+                pass  # best-effort: the process may be tearing down
 
     # -- transport: subclasses implement call() --------------------------------
     def call(self, method: str, **payload) -> Any:
@@ -473,6 +526,13 @@ class BaseContext:
         ]
 
     def shutdown(self):
+        # drain already-queued GC work (ref frees, stream disposes, DAG
+        # teardowns) while the control plane is still up, THEN mark closed —
+        # the reverse order would silently discard them. Bounded join: a
+        # drain item wedged on a dying head must not hang shutdown.
+        self._gc_q.put(None)
+        if threading.current_thread() is not self._gc_thread:
+            self._gc_thread.join(timeout=5.0)
         self.closed = True
         with self._readers_lock:
             for reader in self._readers.values():
@@ -495,7 +555,13 @@ class DriverContext(BaseContext):
         if method == "unsubscribe":
             return self.head.unsubscribe_local(payload["channel"], self.on_pub)
         if method == "free_ref_async":
-            return self.head.remove_ref(payload["obj_id"])
+            # runs on the gc-drain thread (never from __del__ directly):
+            # blocking on the head lock here is safe, and eviction may queue
+            # agent sends that need flushing like any other in-process call
+            try:
+                return self.head.remove_ref(payload["obj_id"])
+            finally:
+                self.head.flush_outbox()
         if method == "add_ref":
             return self.head.add_ref(payload["obj_id"])
         if method == "get":

@@ -133,11 +133,16 @@ class ActorHandle:
         return (_deserialize_handle, (self._actor_id, self._methods, self._class_name))
 
     def __del__(self):
+        # GC-safe: a blocking RPC from a GC tick can deadlock against a
+        # thread that holds the head lock (see ObjectRef.__del__); only a
+        # reentrant queue put is allowed here.
         if self._owned:
             try:
                 ctx = get_ctx()
                 if not ctx.closed:
-                    ctx.call("actor_dec_handle", actor_id=self._actor_id)
+                    ctx.enqueue_gc(
+                        "call", ("actor_dec_handle", {"actor_id": self._actor_id})
+                    )
             except Exception:
                 pass
 

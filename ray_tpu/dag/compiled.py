@@ -185,7 +185,27 @@ class CompiledDAG:
             ch.destroy()
 
     def __del__(self):
+        # GC-safe: teardown blocks in ray_tpu.get — never allowed from a GC
+        # tick (could fire in a thread holding the head lock). Hand the whole
+        # teardown to the context's gc-drain thread; resurrecting self via
+        # the bound method is fine (PEP 442: __del__ runs at most once).
+        if self._torn_down:
+            return
         try:
-            self.teardown()
+            from ray_tpu._private.runtime import _ctx
+
+            if _ctx is not None and not _ctx.closed:
+                _ctx.enqueue_gc("thunk", self.teardown)
+                return
         except Exception:
             pass
+        # no live context: skip the blocking exec-loop join but still unlink
+        # the channels' shm segments (destroy needs no runtime) — GC-safe
+        # because channel close/destroy touch no head or connection locks
+        self._torn_down = True
+        for ch in self._all_channels:
+            try:
+                ch.close()
+                ch.destroy()
+            except Exception:
+                pass
