@@ -187,25 +187,27 @@ class ObjectRefGenerator:
         return ObjectRef(payload, owned=True)
 
     def close(self) -> None:
-        if not self._disposed:
-            self._disposed = True
-            try:
+        self._dispose(blocking=True)
+
+    def _dispose(self, blocking: bool) -> None:
+        """Single dispose path: explicit close() blocks; the GC path may only
+        enqueue (a blocking RPC from a GC tick can deadlock against a thread
+        holding the head lock — see ObjectRef.__del__)."""
+        if self._disposed:
+            return
+        self._disposed = True
+        try:
+            if blocking:
                 self._ctx.call("stream_dispose", task_id=self._task_id)
-            except Exception:
-                pass
+            elif not self._ctx.closed:
+                self._ctx.enqueue_gc(
+                    "call", ("stream_dispose", {"task_id": self._task_id})
+                )
+        except Exception:
+            pass
 
     def __del__(self):
-        # GC-safe dispose: close() issues a blocking RPC, which must never
-        # run from a GC tick (see ObjectRef.__del__); enqueue it instead.
-        if not self._disposed:
-            self._disposed = True
-            try:
-                if not self._ctx.closed:
-                    self._ctx.enqueue_gc(
-                        "call", ("stream_dispose", {"task_id": self._task_id})
-                    )
-            except Exception:
-                pass
+        self._dispose(blocking=False)
 
     def __repr__(self):
         return f"ObjectRefGenerator({self._task_id.hex()[:8]}, next={self._i})"
@@ -237,6 +239,7 @@ class BaseContext:
         # critical section can never re-enter head/connection locks. The
         # drain thread performs the real (possibly blocking) calls.
         self._gc_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thunk_threads: list[threading.Thread] = []
         self._gc_thread = threading.Thread(
             target=self._gc_drain_loop, name="gc-drain", daemon=True
         )
@@ -263,9 +266,15 @@ class BaseContext:
                 elif kind == "thunk":
                     # thunks may block for seconds (e.g. CompiledDAG teardown
                     # joins its exec loops): run off-thread so queued ref
-                    # frees aren't stalled behind them
+                    # frees aren't stalled behind them; tracked so shutdown's
+                    # drain can join them (they unlink shm channels)
                     try:
-                        threading.Thread(target=payload, daemon=True).start()
+                        t = threading.Thread(target=payload, daemon=True)
+                        self._thunk_threads = [
+                            x for x in self._thunk_threads if x.is_alive()
+                        ]
+                        self._thunk_threads.append(t)
+                        t.start()
                     except RuntimeError:
                         payload()
             except Exception:
@@ -533,6 +542,9 @@ class BaseContext:
         self._gc_q.put(None)
         if threading.current_thread() is not self._gc_thread:
             self._gc_thread.join(timeout=5.0)
+        for t in self._thunk_threads:  # DAG teardowns must finish their
+            if t is not threading.current_thread():  # channel unlinks
+                t.join(timeout=5.0)
         self.closed = True
         with self._readers_lock:
             for reader in self._readers.values():

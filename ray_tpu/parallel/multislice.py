@@ -28,6 +28,10 @@ mesh (``__graft_entry__.dryrun_multichip``).
 
 from __future__ import annotations
 
+import os
+import socket
+import subprocess
+import sys
 from typing import Optional, Sequence
 
 import numpy as np
@@ -104,3 +108,124 @@ def make_multislice_mesh(
     perm = [order.index(a) for a in axis_names]
     arr = np.transpose(arr, perm)
     return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def launch_multislice_procs(
+    num_procs: int = 2,
+    local_devices: int = 4,
+    steps: int = 2,
+    timeout: float = 600.0,
+) -> list[list[float]]:
+    """Run the REAL multi-process multislice dryrun: ``num_procs`` fresh
+    subprocesses, each ``jax.distributed.initialize``-ing into one shared
+    runtime with ``local_devices`` virtual CPU chips, training the tiny GPT
+    over a single global mesh whose dp axis crosses the process boundary
+    (``_multislice_worker.py``; reference counterpart: the cross-host torch
+    process group in ``python/ray/train/torch/config.py:47-91``).
+
+    Returns per-rank loss trajectories (all ranks must agree bit-for-bit:
+    the update is a deterministic function of replicated inputs, so
+    agreement proves the cross-process collective ran correctly).
+    """
+    # the free-port probe is TOCTOU (another process can claim it between
+    # close and the coordinator's bind): retry the whole launch on a fresh
+    # port when the failure smells like a bind clash
+    last_err: Optional[BaseException] = None
+    for _attempt in range(3):
+        try:
+            return _launch_once(num_procs, local_devices, steps, timeout)
+        except RuntimeError as e:
+            msg = str(e).lower()
+            if "bind" in msg or "address" in msg or "in use" in msg:
+                last_err = e
+                continue
+            raise
+    raise last_err  # type: ignore[misc]
+
+
+def _launch_once(
+    num_procs: int, local_devices: int, steps: int, timeout: float
+) -> list[list[float]]:
+    import tempfile
+    import time as _time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    # output to files, not pipes: a crashed rank's log must survive the
+    # kill path, and pipes deadlock if a worker fills one while we block
+    # on a sibling's communicate()
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f".ms{r}.log") for r in range(num_procs)]
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.parallel._multislice_worker",
+                "--rank", str(r), "--coord", coord,
+                "--procs", str(num_procs),
+                "--local-devices", str(local_devices),
+                "--steps", str(steps),
+            ],
+            env=env,
+            stdout=logs[r],
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(num_procs)
+    ]
+
+    def read_log(r: int) -> str:
+        logs[r].flush()
+        logs[r].seek(0)
+        return logs[r].read()
+
+    try:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            rcs = [p.poll() for p in procs]
+            # any rank dying early would leave the others waiting in
+            # distributed barriers until the full timeout: fail fast with
+            # the crashed rank's log (the informative one)
+            if any(rc is not None and rc != 0 for rc in rcs):
+                bad = next(r for r, rc in enumerate(rcs) if rc not in (None, 0))
+                raise RuntimeError(
+                    f"multislice worker rank {bad} failed "
+                    f"(rc={rcs[bad]}):\n{read_log(bad)[-4000:]}"
+                )
+            if all(rc == 0 for rc in rcs):
+                break
+            _time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                "multislice dryrun timed out; rank logs:\n"
+                + "\n---\n".join(read_log(r)[-2000:] for r in range(num_procs))
+            )
+        outs = [read_log(r) for r in range(num_procs)]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    losses: list[list[float]] = [None] * num_procs  # type: ignore[list-item]
+    for p, out in zip(procs, outs):
+        for line in out.splitlines():
+            if line.startswith("MSPROC rank="):
+                rank = int(line.split("rank=")[1].split()[0])
+                losses[rank] = eval(line.split("losses=")[1])  # noqa: S307 - our own output
+    if any(l is None for l in losses):
+        raise RuntimeError(f"missing MSPROC lines in worker output:\n{outs}")
+    for r in range(1, num_procs):
+        if losses[r] != losses[0]:
+            raise RuntimeError(
+                f"rank {r} diverged from rank 0: {losses[r]} vs {losses[0]} — "
+                "cross-process collective inconsistency"
+            )
+    return losses

@@ -25,6 +25,22 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
+def global_put(x, sharding) -> jax.Array:
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    ``jax.device_put`` rejects shardings that span non-addressable devices;
+    ``make_array_from_callback`` builds the global array from the shards this
+    process owns, so the SAME init path serves the single-process virtual
+    mesh and the real two-process DCN dryrun (every process must hold the
+    same host value — true for seeded param init and test batches)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)  # no host round-trip single-process
+    import numpy as np
+
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def build_train_step(
     loss_fn: Callable[[Any, jax.Array], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -41,7 +57,7 @@ def build_train_step(
     def init_fn(params) -> TrainState:
         p_specs = param_sharding_rules(params)
         params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, p_specs
+            lambda x, s: global_put(x, NamedSharding(mesh, s)), params, p_specs
         )
         opt_state = jax.jit(
             optimizer.init,

@@ -57,3 +57,16 @@ def test_single_slice_degenerates_to_plain_mesh():
         MeshConfig(dp=2, fsdp=1, tp=2, sp=1), num_slices=1, devices=devs
     )
     assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+
+
+def test_two_process_dcn_dp():
+    """REAL multi-process multislice: 2 subprocesses jax.distributed-join
+    one 8-device mesh; dp gradient reduction crosses the process boundary
+    (gloo = the DCN stand-in); all ranks must agree bit-for-bit and the
+    loss must decrease. Reference counterpart: the cross-host process group
+    built by python/ray/train/torch/config.py:47-91."""
+    from ray_tpu.parallel.multislice import launch_multislice_procs
+
+    losses = launch_multislice_procs(num_procs=2, local_devices=4, steps=2)
+    assert losses[0] == losses[1]
+    assert losses[0][1] < losses[0][0]
