@@ -1,8 +1,9 @@
-"""Checkpoint: a directory handle with metadata.
+"""Checkpoint: a directory handle with metadata, local or on ``pyarrow.fs``.
 
 Reference: ``python/ray/train/_checkpoint.py:56`` — a Checkpoint is a
-directory on a filesystem, never a live object graph; frameworks serialize
-into it (here: orbax/msgpack/npz for JAX pytrees).
+directory on a filesystem (local, S3, GS, NFS — resolved via pyarrow.fs),
+never a live object graph; frameworks serialize into it (here: orbax/npz for
+JAX pytrees). ``from_uri/to_uri`` mirror the reference's cloud round-trip.
 """
 
 from __future__ import annotations
@@ -14,41 +15,102 @@ import shutil
 import tempfile
 from typing import Any, Iterator, Optional
 
+from ray_tpu.train import _storage
+
 _METADATA_FILE = ".ray_tpu_checkpoint.json"
 
 
 class Checkpoint:
-    """A handle to a checkpoint directory on the local/shared filesystem."""
+    """A handle to a checkpoint directory.
 
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+    ``path`` may be a local directory, a URI (``s3://…``, ``gs://…``,
+    ``file:///…``), or an fs-internal path paired with an explicit
+    ``filesystem`` (reference: ``Checkpoint(path, filesystem)``).
+    """
 
+    def __init__(self, path: str, filesystem=None):
+        if filesystem is None and not _storage.is_uri(path):
+            self.path = os.path.abspath(path)
+            self.filesystem = None
+            self._fs_path = self.path
+        else:
+            self.path = str(path)
+            fs, fs_path = _storage.get_fs_and_path(path, filesystem)
+            self.filesystem = fs
+            self._fs_path = fs_path
+
+    # -- constructors ------------------------------------------------------
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """Handle to a checkpoint already persisted at ``uri``
+        (reference: ``Checkpoint.from_uri``)."""
+        return cls(uri)
+
+    def to_uri(self, uri: str) -> "Checkpoint":
+        """Upload this (local) checkpoint to ``uri`` and return the remote
+        handle (reference: ``Checkpoint.to_uri``)."""
+        fs, fs_path = _storage.get_fs_and_path(uri)
+        with self.as_directory() as local:
+            _storage.upload_dir(fs, fs_path, local)
+        return Checkpoint(uri)
+
+    # -- local access ------------------------------------------------------
+    @property
+    def _is_remote(self) -> bool:
+        if self.filesystem is None:
+            return False  # keep purely-local flows pyarrow-free
+        from pyarrow import fs as pafs
+
+        return not isinstance(self.filesystem, pafs.LocalFileSystem)
 
     def to_directory(self, path: Optional[str] = None) -> str:
         """Copy checkpoint contents into ``path`` (or a fresh temp dir)."""
         dest = path or tempfile.mkdtemp(prefix="ckpt_")
         os.makedirs(dest, exist_ok=True)
-        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        if self._is_remote:
+            _storage.download_dir(self.filesystem, self._fs_path, dest)
+        else:
+            shutil.copytree(self._fs_path, dest, dirs_exist_ok=True)
         return dest
 
     @contextlib.contextmanager
     def as_directory(self) -> Iterator[str]:
         """Yield a local directory with the checkpoint contents. Local
-        checkpoints are yielded as-is (zero-copy)."""
-        yield self.path
+        checkpoints are yielded as-is (zero-copy); remote ones download to a
+        temp dir that is removed afterwards."""
+        if not self._is_remote:
+            yield self._fs_path
+            return
+        dest = self.to_directory()
+        try:
+            yield dest
+        finally:
+            shutil.rmtree(dest, ignore_errors=True)
 
+    # -- metadata ----------------------------------------------------------
     def get_metadata(self) -> dict:
-        f = os.path.join(self.path, _METADATA_FILE)
+        if self._is_remote:
+            meta = _storage.fs_join(self._fs_path, _METADATA_FILE)
+            if _storage.exists(self.filesystem, meta):
+                return _storage.read_json(self.filesystem, meta)
+            return {}
+        f = os.path.join(self._fs_path, _METADATA_FILE)
         if os.path.exists(f):
             with open(f) as fp:
                 return json.load(fp)
         return {}
 
     def set_metadata(self, metadata: dict) -> None:
-        with open(os.path.join(self.path, _METADATA_FILE), "w") as fp:
+        if self._is_remote:
+            _storage.write_json(
+                self.filesystem, _storage.fs_join(self._fs_path, _METADATA_FILE), metadata
+            )
+            return
+        with open(os.path.join(self._fs_path, _METADATA_FILE), "w") as fp:
             json.dump(metadata, fp)
 
     def update_metadata(self, metadata: dict) -> None:
@@ -71,10 +133,16 @@ def save_pytree(tree: Any, path: str, *, step: Optional[int] = None) -> Checkpoi
 
     Uses numpy .npz of flattened leaves + a JSON treedef — robust, fast, no
     format churn. (Orbax integration lives in ray_tpu.train.orbax_utils for
-    async multihost checkpointing of sharded arrays.)
+    async multihost checkpointing of sharded arrays.) ``path`` may be a URI:
+    the pytree is staged locally and uploaded.
     """
     import jax
     import numpy as np
+
+    if _storage.is_uri(path):
+        with tempfile.TemporaryDirectory(prefix="ckpt_stage_") as stage:
+            save_pytree(tree, stage, step=step)
+            return Checkpoint(stage).to_uri(path)
 
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -96,16 +164,19 @@ def save_pytree(tree: Any, path: str, *, step: Optional[int] = None) -> Checkpoi
 
 def load_pytree(checkpoint: "Checkpoint | str") -> Any:
     """Inverse of :func:`save_pytree`; leaves come back as numpy arrays
-    (device placement/sharding is the caller's job via device_put)."""
+    (device placement/sharding is the caller's job via device_put). Accepts
+    a Checkpoint (local or remote), a local path, or a URI."""
     import pickle
 
     import numpy as np
 
-    path = checkpoint.path if isinstance(checkpoint, Checkpoint) else checkpoint
-    with open(os.path.join(path, "treedef.pkl"), "rb") as fp:
-        treedef = pickle.load(fp)
-    data = np.load(os.path.join(path, "pytree.npz"))
-    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    if isinstance(checkpoint, str):
+        checkpoint = Checkpoint(checkpoint)
+    with checkpoint.as_directory() as path:
+        with open(os.path.join(path, "treedef.pkl"), "rb") as fp:
+            treedef = pickle.load(fp)
+        with np.load(os.path.join(path, "pytree.npz")) as data:
+            leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
     import jax
 
     return jax.tree_util.tree_unflatten(treedef, leaves)

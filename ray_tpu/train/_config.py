@@ -80,16 +80,28 @@ class RunConfig:
     (reference ``air/config.py:576``)."""
 
     name: Optional[str] = None
+    #: local path OR pyarrow.fs URI (``s3://…``, ``gs://…``, ``file:///…``)
+    #: — reference ``RunConfig.storage_path`` (``train/_internal/storage.py``)
     storage_path: Optional[str] = None
+    #: custom ``pyarrow.fs.FileSystem`` (tests / exotic backends); when set,
+    #: ``storage_path`` is interpreted as a path INSIDE this filesystem
+    storage_filesystem: Optional[object] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 1
     log_to_file: bool = False
 
     def resolved_storage_path(self) -> str:
+        if self.storage_filesystem is not None:
+            # fs-internal path (may legitimately be "" = the fs root)
+            return str(self.storage_path or "")
         base = self.storage_path or os.environ.get(
             "RAY_TPU_STORAGE_PATH", os.path.expanduser("~/ray_tpu_results")
         )
+        from ray_tpu.train._storage import is_uri
+
+        if is_uri(base):
+            return str(base)  # URI: never abspath
         return os.path.abspath(os.path.expanduser(base))
 
 

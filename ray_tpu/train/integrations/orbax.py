@@ -21,8 +21,19 @@ _SUBDIR = "pytree"
 
 def save_pytree_checkpoint(state: Any, path: str) -> Checkpoint:
     """Write ``state`` (a pytree of arrays/scalars) to ``path`` with orbax
-    and return a train ``Checkpoint`` handle for ``session.report``."""
+    and return a train ``Checkpoint`` handle for ``session.report``.
+    ``path`` may be a pyarrow.fs URI — orbax writes to a local stage and the
+    result is uploaded through the storage layer."""
     import orbax.checkpoint as ocp
+
+    from ray_tpu.train import _storage
+
+    if _storage.is_uri(path):
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="orbax_stage_") as stage:
+            save_pytree_checkpoint(state, stage)
+            return Checkpoint(stage).to_uri(path)
 
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -42,9 +53,11 @@ def load_pytree_checkpoint(
     """
     import orbax.checkpoint as ocp
 
-    path = checkpoint if isinstance(checkpoint, str) else checkpoint.path
-    item = os.path.join(os.path.abspath(path), _SUBDIR)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        if target is not None:
-            return ckptr.restore(item, item=target)
-        return ckptr.restore(item)
+    if isinstance(checkpoint, str):
+        checkpoint = Checkpoint(checkpoint)
+    with checkpoint.as_directory() as path:
+        item = os.path.join(os.path.abspath(path), _SUBDIR)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            if target is not None:
+                return ckptr.restore(item, item=target)
+            return ckptr.restore(item)

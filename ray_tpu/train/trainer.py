@@ -76,13 +76,33 @@ class DataParallelTrainer:
     # ------------------------------------------------------------------ fit
 
     def fit(self) -> Result:
+        from ray_tpu.train import _storage as storage_mod
+        from ray_tpu.train._storage import StorageContext
+
         run_name = self.run_config.name or f"{type(self).__name__}_{int(time.time())}"
-        exp_dir = os.path.join(self.run_config.resolved_storage_path(), run_name)
-        trial_dir = os.path.join(exp_dir, "trial_0")
+        storage_path = self.run_config.resolved_storage_path()
+        storage_fs = self.run_config.storage_filesystem
+        # URI / custom-fs storage persists through pyarrow.fs (reference:
+        # StorageContext, train/_internal/storage.py); plain local paths keep
+        # the direct-directory layout
+        use_storage = storage_fs is not None or storage_mod.is_uri(storage_path)
+        if use_storage:
+            storage = StorageContext(
+                storage_path, run_name, "trial_0", storage_filesystem=storage_fs
+            )
+            trial_dir = os.path.join(
+                os.path.expanduser("~/ray_tpu_results"), "_staging", run_name, "trial_0"
+            )
+            result_path = storage.uri_for("")
+        else:
+            storage = None
+            exp_dir = os.path.join(storage_path, run_name)
+            trial_dir = os.path.join(exp_dir, "trial_0")
+            result_path = trial_dir
         os.makedirs(trial_dir, exist_ok=True)
         failure = self.run_config.failure_config or FailureConfig()
         ckpt_cfg = self.run_config.checkpoint_config or CheckpointConfig()
-        manager = CheckpointManager(trial_dir, ckpt_cfg)
+        manager = CheckpointManager(trial_dir, ckpt_cfg, storage=storage)
 
         failures_left = failure.max_failures
         start_ckpt = self.resume_from_checkpoint
@@ -128,7 +148,7 @@ class DataParallelTrainer:
         result = Result(
             metrics=last_metrics,
             checkpoint=manager.best(),
-            path=trial_dir,
+            path=result_path,
             error=error,
             metrics_history=history,
         )

@@ -123,9 +123,23 @@ class Tuner:
         self.run_config = run_config or RunConfig()
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.train import _storage as storage_mod
+        from ray_tpu.train._storage import StorageContext
+
         cfg = self.tune_config
         name = self.run_config.name or f"tune_{int(time.time())}"
-        exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        storage_path = self.run_config.resolved_storage_path()
+        storage_fs = self.run_config.storage_filesystem
+        if storage_fs is not None or storage_mod.is_uri(storage_path):
+            # cloud/URI persistence via pyarrow.fs: trial dirs stay local
+            # staging, checkpoints + experiment state ride the StorageContext
+            storage = StorageContext(storage_path, name, storage_filesystem=storage_fs)
+            exp_dir = os.path.join(
+                os.path.expanduser("~/ray_tpu_results"), "_staging", name
+            )
+        else:
+            storage = None
+            exp_dir = os.path.join(storage_path, name)
         searcher = None
         configs: list[dict] = []
         if cfg.search_alg is not None and hasattr(cfg.search_alg, "suggest"):
@@ -150,15 +164,19 @@ class Tuner:
             verbose=self.run_config.verbose > 1,
             searcher=searcher,
             num_samples=cfg.num_samples,
+            storage=storage,
         )
         trials = controller.run()
         results = []
         for t in trials:
+            # with cloud storage, point users at the durable location — the
+            # staging dir is throwaway and dies with the head
+            t_storage = t.ckpt_manager.storage
             results.append(
                 Result(
                     metrics=t.last_result,
                     checkpoint=t.ckpt_manager.best(),
-                    path=t.dir,
+                    path=t_storage.uri_for("") if t_storage is not None else t.dir,
                     error=t.error,
                     metrics_history=t.results,
                 )

@@ -38,6 +38,7 @@ class Trial:
         exp_dir: str,
         ckpt_config: CheckpointConfig,
         trial_id: Optional[str] = None,
+        storage=None,  # experiment-level StorageContext (cloud persistence)
     ):
         self.id = trial_id or f"{idx:05d}_{uuid.uuid4().hex[:6]}"
         self.idx = idx
@@ -51,7 +52,10 @@ class Trial:
         self.retries_left = 0
         self.dir = os.path.join(exp_dir, f"trial_{self.id}")
         os.makedirs(self.dir, exist_ok=True)
-        self.ckpt_manager = CheckpointManager(self.dir, ckpt_config)
+        trial_storage = storage.for_trial(f"trial_{self.id}") if storage else None
+        self.ckpt_manager = CheckpointManager(
+            self.dir, ckpt_config, storage=trial_storage
+        )
         self.start_checkpoint: Optional[Checkpoint] = None
         self._rungs_hit: set = set()
 
@@ -79,9 +83,12 @@ class TuneController:
         verbose: int = 0,
         searcher=None,
         num_samples: int = 0,
+        storage=None,  # StorageContext: checkpoints + experiment state ride pyarrow.fs
     ):
         self.trainable = trainable
         self.exp_dir = exp_dir
+        self.storage = storage
+        self._last_state_upload = float("-inf")
         os.makedirs(exp_dir, exist_ok=True)
         self.scheduler = scheduler or sched_mod.FIFOScheduler()
         self.metric, self.mode = metric, mode
@@ -97,7 +104,10 @@ class TuneController:
         self.searcher = searcher
         self.num_samples = num_samples
         self._searcher_done = searcher is None
-        self.trials = [Trial(i, c, exp_dir, ckpt_config) for i, c in enumerate(configs)]
+        self.trials = [
+            Trial(i, c, exp_dir, ckpt_config, storage=storage)
+            for i, c in enumerate(configs)
+        ]
         for t in self.trials:
             t.retries_left = self.failure_config.max_failures
 
@@ -118,7 +128,7 @@ class TuneController:
         finally:
             for t in self.trials:
                 self._stop_actor(t)
-            self._save_experiment_state()
+            self._save_experiment_state(force=True)  # final state must land
 
     def _pull_suggestions(self):
         """Ask the sequential searcher for new trials while slots are free."""
@@ -137,7 +147,10 @@ class TuneController:
                 self._searcher_done = True
                 self.num_samples = len(self.trials)
                 return
-            trial = Trial(idx, out, self.exp_dir, self._ckpt_config, trial_id=trial_id)
+            trial = Trial(
+                idx, out, self.exp_dir, self._ckpt_config,
+                trial_id=trial_id, storage=self.storage,
+            )
             trial.retries_left = self.failure_config.max_failures
             self.trials.append(trial)
             active += 1
@@ -288,9 +301,10 @@ class TuneController:
 
     # ------------------------------------------------------- state snapshot
 
-    def _save_experiment_state(self):
+    def _save_experiment_state(self, force: bool = False):
         """Experiment-state checkpoint (reference ``tune_controller.py:451``
-        periodic experiment snapshots)."""
+        periodic experiment snapshots). The local JSON is cheap and written
+        every call; the cloud upload is throttled unless ``force``."""
         state = {
             "timestamp": time.time(),
             "trials": [
@@ -310,6 +324,18 @@ class TuneController:
         with open(tmp, "w") as f:
             json.dump(state, f, indent=1)
         os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
+        if self.storage is not None and (
+            force or time.monotonic() - self._last_state_upload >= 10.0
+        ):
+            # experiment state rides the same pyarrow.fs tier as checkpoints,
+            # PERIODICALLY — a blocking cloud PUT per trial result would
+            # serialize the whole control loop behind uploads (reference:
+            # tune_controller.py:451 periodic cloud snapshots)
+            try:
+                self.storage.write_json("experiment_state.json", state)
+                self._last_state_upload = time.monotonic()
+            except Exception as e:  # noqa: BLE001 - storage outage must not kill the loop
+                print(f"[ray_tpu.tune] experiment-state upload failed: {e!r}")
 
 
 from ray_tpu.train._checkpoint_manager import json_safe as _json_safe  # noqa: E402
