@@ -17,6 +17,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     get_app_handle,
     get_deployment_handle,
     run,
+    run_config,
     shutdown,
     status,
 )

@@ -34,6 +34,10 @@ class DeploymentConfig:
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 1.0
+    #: downscale grace: a victim replica leaves the routing set immediately
+    #: but is only killed once its in-flight requests finish (or this
+    #: deadline passes) — reference: graceful_shutdown_timeout_s
+    graceful_shutdown_timeout_s: float = 10.0
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
 
 

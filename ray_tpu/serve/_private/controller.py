@@ -36,6 +36,9 @@ class _DeploymentState:
             self.target_replicas = max(
                 spec.config.autoscaling_config.min_replicas, 1
             )
+        # downscale victims draining in-flight requests: (ReplicaInfo,
+        # kill-deadline) — out of the routing set, not yet killed
+        self.draining: list[tuple[ReplicaInfo, float]] = []
         # autoscaling bookkeeping
         self._scale_pressure_since: Optional[float] = None
         self._scale_direction = 0
@@ -111,7 +114,11 @@ class ServeController:
             return
         import ray_tpu
 
-        for r in state.replicas:
+        # draining victims too: once the deployment is gone nothing else
+        # would ever reap them (the reconcile loop only sees _deployments)
+        victims = list(state.replicas) + [r for r, _ in state.draining]
+        state.draining = []
+        for r in victims:
             try:
                 ray_tpu.kill(r.actor)
             except Exception:
@@ -299,15 +306,47 @@ class ServeController:
                 for _ in range(max(0, missing)):
                     self._start_replica(state)
                     self._bump_version_locked()
-                # stop excess (highest-index first)
+                # stop excess (highest-index first): GRACEFUL — the victim
+                # leaves the routing set now (version bump pushes the new
+                # replica list to routers), but is only killed once its
+                # in-flight requests finish or the grace deadline passes
+                # (reference: graceful_shutdown_timeout_s drain in
+                # deployment_state.py)
                 excess = len(state.replicas) - state.target_replicas
                 for _ in range(max(0, excess)):
                     victim = state.replicas.pop()
-                    try:
-                        ray_tpu.kill(victim.actor)
-                    except Exception:
-                        pass
+                    deadline = (
+                        time.time() + spec.config.graceful_shutdown_timeout_s
+                    )
+                    state.draining.append((victim, deadline))
                     self._bump_version_locked()
+            self._process_draining(state)
+
+    def _process_draining(self, state: _DeploymentState):
+        """Kill draining victims whose in-flight count hit zero (or whose
+        grace deadline passed / who stopped answering)."""
+        import ray_tpu
+
+        with self._lock:
+            draining = list(state.draining)
+        still = []
+        for victim, deadline in draining:
+            done = time.time() >= deadline
+            if not done:
+                try:
+                    m = ray_tpu.get(victim.actor.get_metrics.remote(), timeout=5.0)
+                    done = m["num_ongoing_requests"] <= 0
+                except Exception:
+                    done = True  # unreachable: nothing left to drain
+            if done:
+                try:
+                    ray_tpu.kill(victim.actor)
+                except Exception:
+                    pass
+            else:
+                still.append((victim, deadline))
+        with self._lock:
+            state.draining = still
 
     def _start_replica(self, state: _DeploymentState):
         import ray_tpu

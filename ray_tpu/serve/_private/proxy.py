@@ -1,11 +1,26 @@
-"""HTTP ingress proxy.
+"""HTTP ingress proxy — asyncio + h11.
 
 Reference: ``serve/_private/proxy.py:1115`` (ProxyActor per node wrapping an
 HTTP server that resolves routes to app ingress deployments and awaits the
-handle response; ``proxy.py:759`` streams ASGI responses). stdlib
-``ThreadingHTTPServer`` here — one thread per in-flight request, each
-blocking on its DeploymentResponse. The controller runs one ProxyActor per
-alive node; any proxy routes to any replica.
+handle response; ``proxy.py:759`` runs uvicorn/ASGI). The round-3
+``ThreadingHTTPServer`` held one OS thread per in-flight request and
+collapsed under concurrency; this proxy is a single asyncio event loop
+(h11 for HTTP/1.1 parsing/framing — the same state machine family the
+reference's uvicorn uses) with:
+
+* a bounded dispatch executor for the blocking control-plane touches
+  (first-route lookup, router admission/pick, result fetches, failover
+  re-picks) — never occupied for a request's full lifetime;
+* ONE resolver thread that watches ALL in-flight unary ObjectRefs via a
+  single batched ``ray_tpu.wait`` — hundreds of concurrent requests cost
+  hundreds of parked coroutines, not hundreds of threads;
+* router semantics preserved end-to-end: the handle slot is held until the
+  response settles (admission caps + pow-2 balancing stay live) and replica
+  death re-routes through ``DeploymentResponse._async_failed`` exactly like
+  the blocking ``result()`` path;
+* streaming responses on a dedicated thread per stream with a bounded
+  in-flight chunk window and client-disconnect cancellation (the generator
+  is closed, which disposes the remote stream).
 
 Routes: ``POST/GET /<app_name>`` → the app's ingress deployment, invoked as
 ``__call__(payload)``. Bodies: JSON stays JSON, ``text/*`` arrives as str,
@@ -16,117 +31,216 @@ text/plain, else JSON). Generator ingress deployments stream chunked
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+import h11
+
 from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+_READ_CHUNK = 1 << 16
+_DISPATCH_THREADS = 32  # blocking picks/lookups/fetches — never held per-request
+_STREAM_WINDOW = 64  # max un-consumed chunks in flight per stream
+_UNARY_TIMEOUT_S = 60.0
+
+
+class _Resolution:
+    """One in-flight unary request: its asyncio future plus the CURRENT
+    response being awaited (failover swaps in a re-routed response)."""
+
+    __slots__ = ("loop", "future", "resp")
+
+    def __init__(self, loop, resp):
+        self.loop = loop
+        self.future = loop.create_future()
+        self.resp = resp
+
+
+class _RefResolver:
+    """Settles every in-flight unary request with one watcher thread.
+
+    The thread batches all outstanding refs into a single ``ray_tpu.wait``;
+    ready refs are handed to the dispatch pool to fetch + settle (a big
+    payload fetch must not head-of-line-block other settlements), post the
+    result to the owning event loop, and — on replica death — re-route via
+    ``DeploymentResponse._async_failed`` and re-register the fresh ref.
+    """
+
+    def __init__(self):
+        # OWN pool, never shared with dispatch: dispatch threads block in
+        # pick() waiting for router slots that only _finish (settle) frees —
+        # sharing one pool deadlocks the proxy at max_ongoing saturation
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="proxy-finish"
+        )
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # ObjectRef -> _Resolution
+        self._wake = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="proxy-resolver", daemon=True
+        )
+        self._thread.start()
+
+    def register(self, resp, loop) -> _Resolution:
+        res = _Resolution(loop, resp)
+        with self._lock:
+            self._pending[resp._async_ref()] = res
+        self._wake.set()
+        return res
+
+    def _rearm(self, res: _Resolution, resp) -> None:
+        res.resp = resp
+        with self._lock:
+            self._pending[resp._async_ref()] = res
+        self._wake.set()
+
+    def discard(self, res: _Resolution) -> None:
+        """Caller timed out / disconnected: stop tracking (and free the
+        router slot so abandoned requests don't eat the admission cap)."""
+        with self._lock:
+            ref = res.resp._async_ref()
+            if self._pending.get(ref) is res:
+                self._pending.pop(ref, None)
+        try:
+            res.resp._async_done()
+        except Exception:
+            pass
+
+    def close(self):
+        self._closed = True
+        self._wake.set()
+        self._pool.shutdown(wait=False)
+
+    def _run(self):
+        import ray_tpu
+
+        while not self._closed:
+            with self._lock:
+                refs = list(self._pending.keys())
+            if not refs:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            try:
+                ready, _ = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=0.05, fetch_local=False
+                )
+            except Exception:
+                ready = []
+            for ref in ready:
+                with self._lock:
+                    res = self._pending.pop(ref, None)
+                if res is not None:
+                    self._pool.submit(self._finish, ref, res)
+
+    def _finish(self, ref, res: _Resolution):
+        """Dispatch-pool side: fetch the value, settle the router slot, post
+        to the event loop; on failure mirror result()'s failover."""
+        import ray_tpu
+
+        try:
+            value = ray_tpu.get(ref)  # ready: no artificial timeout
+            res.resp._async_done()
+            err = None
+        except BaseException as e:  # noqa: BLE001
+            try:
+                nxt = res.resp._async_failed(e)  # may block in pick(): pool thread
+            except BaseException as pick_err:  # noqa: BLE001
+                nxt = None
+                e = pick_err
+            if nxt is not None:
+                self._rearm(res, nxt)
+                return
+            value, err = None, e
+        def _post():
+            if res.future.cancelled():
+                return
+            if err is not None:
+                res.future.set_exception(err)
+            else:
+                res.future.set_result(value)
+        try:
+            res.loop.call_soon_threadsafe(_post)
+        except RuntimeError:
+            pass  # loop already closed (proxy stopping)
+
+
+def _parse_payload(body: bytes, ctype: str):
+    """JSON stays JSON; anything else arrives as raw bytes (reference: the
+    ASGI proxy hands the body through; JSON is a convenience)."""
+    if not body:
+        return None
+    ctype = (ctype or "").split(";")[0].strip()
+    if ctype in ("", "application/json"):
+        return json.loads(body)
+    if ctype.startswith("text/"):
+        return body.decode()
+    return body
+
+
+def _encode_body(body) -> tuple[bytes, str]:
+    if isinstance(body, (bytes, bytearray, memoryview)):
+        return bytes(body), "application/octet-stream"
+    if isinstance(body, str):
+        return body.encode(), "text/plain; charset=utf-8"
+    return json.dumps(body).encode(), "application/json"
+
+
+class _StreamCancelled(BaseException):
+    pass
 
 
 class ProxyActor:
     def __init__(self, port: int):
         self.port = port
-        proxy = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"  # chunked responses need 1.1
-
-            def log_message(self, *args):  # quiet
-                pass
-
-            def _read_payload(self):
-                """JSON stays JSON; anything else arrives as raw bytes
-                (reference: the ASGI proxy hands the body through; JSON is a
-                convenience, not a requirement)."""
-                length = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(length) if length else b""
-                ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
-                if not raw:
-                    return None
-                if ctype in ("", "application/json"):
-                    return json.loads(raw)
-                if ctype.startswith("text/"):
-                    return raw.decode()
-                return raw
-
-            def _send_body(self, code: int, body, ctype=None):
-                if isinstance(body, (bytes, bytearray, memoryview)):
-                    data = bytes(body)
-                    ctype = ctype or "application/octet-stream"
-                elif isinstance(body, str):
-                    data = body.encode()
-                    ctype = ctype or "text/plain; charset=utf-8"
-                else:
-                    data = json.dumps(body).encode()
-                    ctype = ctype or "application/json"
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _send_stream(self, items):
-                """Chunked transfer: one chunk per generator item as it is
-                produced (bytes raw; anything else NDJSON). Errors after the
-                200 header cannot become a second response — log and drop
-                the connection so the client sees a clean truncation."""
-                self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
-                def chunk(data: bytes):
-                    self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
-                    self.wfile.flush()
-
-                try:
-                    for item in items:
-                        if isinstance(item, (bytes, bytearray, memoryview)):
-                            chunk(bytes(item))
-                        else:
-                            chunk((json.dumps(item) + "\n").encode())
-                    self.wfile.write(b"0\r\n\r\n")
-                except BaseException:  # noqa: BLE001
-                    # swallow: a second HTTP response injected into an open
-                    # chunked stream would corrupt the framing — log and
-                    # drop the connection (clean truncation for the client)
-                    import traceback
-
-                    print("[serve-proxy] streaming response failed:", flush=True)
-                    traceback.print_exc()
-                    self.close_connection = True
-
-            def _dispatch(self):
-                try:
-                    app = self.path.strip("/").split("/")[0] or "default"
-                    payload = self._read_payload()
-                    handle, streaming = proxy._handle_for(app)
-                    if streaming:
-                        resp = handle.options(stream=True).remote(payload)
-                        self._send_stream(resp)
-                        return
-                    result = handle.remote(payload).result(timeout=60)
-                    self._send_body(200, result)
-                except KeyError as e:
-                    self._send_body(404, {"error": str(e)})
-                except Exception as e:  # noqa: BLE001
-                    self._send_body(500, {"error": repr(e)})
-
-            do_GET = _dispatch
-            do_POST = _dispatch
-
-        class _Server(ThreadingHTTPServer):
-            daemon_threads = True
-            request_queue_size = 256  # default 5 resets bursty clients
-
-        self._server = _Server(("127.0.0.1", port), Handler)
-        self.port = self._server.server_address[1]  # resolves port=0
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
-        self._thread.start()
         self._handles: dict[str, object] = {}
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=_DISPATCH_THREADS, thread_name_prefix="proxy-dispatch"
+        )
+        self._resolver = _RefResolver()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        started = threading.Event()
+        boot_err: list = []
+
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def boot():
+                try:
+                    self._server = await asyncio.start_server(
+                        self._handle_conn, "127.0.0.1", port, backlog=1024
+                    )
+                    self.port = self._server.sockets[0].getsockname()[1]
+                except BaseException as e:  # noqa: BLE001
+                    boot_err.append(e)
+                finally:
+                    started.set()
+
+            loop.run_until_complete(boot())
+            if not boot_err:
+                loop.run_forever()
+            # drain callbacks after stop() so close() completes cleanly
+            loop.run_until_complete(asyncio.sleep(0))
+            loop.close()
+
+        self._thread = threading.Thread(target=run_loop, name="proxy-loop", daemon=True)
+        self._thread.start()
+        started.wait(timeout=30)
+        if boot_err:
+            raise boot_err[0]
+
+    # ------------------------------------------------------------- routing
 
     def _handle_for(self, app: str):
+        """Blocking (controller RPC) on first touch — always called from a
+        worker thread, never the event loop."""
         import ray_tpu
         from ray_tpu.serve.handle import DeploymentHandle
 
@@ -140,6 +254,215 @@ class ProxyActor:
             self._handles[app] = ent
         return ent
 
+    def _route(self, app: str, payload):
+        """Dispatch pool (ONE hop per request): route lookup + admission/
+        pick may block. Returns ("stream", None) for streaming apps, else
+        ("unary", un-settled DeploymentResponse) — the slot stays held until
+        resolution so admission caps and pow-2 balancing see async requests
+        exactly like blocking callers."""
+        handle, streaming = self._handle_for(app)
+        if streaming:
+            return "stream", None
+        return "unary", handle.remote(payload)
+
+    def _run_stream(self, app: str, payload, loop, q: "asyncio.Queue",
+                    cancel: threading.Event, window: threading.Semaphore):
+        """Dedicated thread per stream (long-lived by nature — must not
+        occupy the dispatch pool): iterates the streaming generator with a
+        bounded chunk window and stops (disposing the remote stream) when
+        the client disconnects. Sentinels: ("end", None) | ("error", exc)."""
+
+        def post(item):
+            loop.call_soon_threadsafe(q.put_nowait, item)
+
+        gen = None
+        try:
+            handle, _ = self._handle_for(app)
+            gen = handle.options(stream=True).remote(payload)
+            for item in gen:
+                if isinstance(item, (bytes, bytearray, memoryview)):
+                    data = bytes(item)
+                else:
+                    data = (json.dumps(item) + "\n").encode()
+                while not window.acquire(timeout=0.25):
+                    if cancel.is_set():
+                        raise _StreamCancelled
+                if cancel.is_set():
+                    raise _StreamCancelled
+                post(("chunk", data))
+            post(("end", None))
+        except _StreamCancelled:
+            pass
+        except BaseException as e:  # noqa: BLE001
+            post(("error", e))
+        finally:
+            if gen is not None and cancel.is_set():
+                try:
+                    gen.close()  # disposes the remote stream + producer
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------- http plumbing
+
+    async def _read_request(self, conn: h11.Connection, reader, writer):
+        """Collect one (Request, body) off the connection; None on close.
+        Answers ``Expect: 100-continue`` with the interim response so
+        clients that wait for it (curl on >1KB bodies) don't stall."""
+        request = None
+        body = b""
+        while True:
+            event = conn.next_event()
+            if event is h11.NEED_DATA:
+                data = await reader.read(_READ_CHUNK)
+                conn.receive_data(data)
+                if data == b"" and request is None:
+                    return None  # clean close between requests
+                continue
+            if isinstance(event, h11.Request):
+                request = event
+                expect = next(
+                    (v for k, v in request.headers if k == b"expect"), b""
+                )
+                if expect.lower() == b"100-continue":
+                    await self._send(
+                        writer, conn, h11.InformationalResponse(status_code=100)
+                    )
+            elif isinstance(event, h11.Data):
+                body += event.data
+            elif isinstance(event, h11.EndOfMessage):
+                return request, body
+            elif isinstance(event, (h11.ConnectionClosed,)):
+                return None
+
+    async def _send(self, writer, conn, event):
+        data = conn.send(event)
+        if data:
+            writer.write(data)
+            await writer.drain()
+
+    async def _respond(self, writer, conn, code: int, body, ctype=None):
+        data, default_ctype = _encode_body(body)
+        headers = [
+            ("content-type", ctype or default_ctype),
+            ("content-length", str(len(data))),
+        ]
+        await self._send(writer, conn, h11.Response(status_code=code, headers=headers))
+        await self._send(writer, conn, h11.Data(data=data))
+        await self._send(writer, conn, h11.EndOfMessage())
+
+    async def _respond_stream(self, writer, conn, app: str, payload, loop):
+        """Chunked transfer: h11 frames chunks automatically when no
+        content-length is declared. Errors after the header cannot become a
+        second response — truncate the stream (close) like the reference."""
+        q: asyncio.Queue = asyncio.Queue()
+        cancel = threading.Event()
+        window = threading.Semaphore(_STREAM_WINDOW)
+        threading.Thread(
+            target=self._run_stream,
+            args=(app, payload, loop, q, cancel, window),
+            name="proxy-stream",
+            daemon=True,
+        ).start()
+        try:
+            first_kind, first_val = await q.get()
+            window.release()
+            if first_kind == "error":
+                code = 404 if isinstance(first_val, KeyError) else 500
+                await self._respond(writer, conn, code, {"error": repr(first_val)})
+                return
+            await self._send(
+                writer,
+                conn,
+                h11.Response(
+                    status_code=200,
+                    headers=[
+                        ("content-type", "application/octet-stream"),
+                        ("transfer-encoding", "chunked"),
+                    ],
+                ),
+            )
+            kind, val = first_kind, first_val
+            while True:
+                if kind == "chunk":
+                    await self._send(writer, conn, h11.Data(data=val))
+                elif kind == "end":
+                    await self._send(writer, conn, h11.EndOfMessage())
+                    return
+                else:  # mid-stream error: truncate
+                    import traceback
+
+                    print("[serve-proxy] streaming response failed:", flush=True)
+                    traceback.print_exception(val)
+                    writer.close()
+                    return
+                kind, val = await q.get()
+                window.release()
+        finally:
+            cancel.set()  # stops (and disposes) the producer on disconnect
+
+    async def _handle_conn(self, reader, writer):
+        loop = asyncio.get_running_loop()
+        conn = h11.Connection(h11.SERVER)
+        try:
+            while True:
+                try:
+                    req = await self._read_request(conn, reader, writer)
+                except h11.RemoteProtocolError:
+                    await self._send(
+                        writer, conn,
+                        h11.Response(status_code=400, headers=[("content-length", "0")]),
+                    )
+                    await self._send(writer, conn, h11.EndOfMessage())
+                    return
+                if req is None:
+                    return
+                request, body = req
+                target = request.target.decode()
+                headers = {k.decode().lower(): v.decode() for k, v in request.headers}
+                app = target.strip("/").split("/")[0] or "default"
+                try:
+                    payload = _parse_payload(body, headers.get("content-type", ""))
+                    kind, resp = await loop.run_in_executor(
+                        self._dispatch_pool, self._route, app, payload
+                    )
+                    if kind == "stream":
+                        await self._respond_stream(writer, conn, app, payload, loop)
+                    else:
+                        res = self._resolver.register(resp, loop)
+                        try:
+                            result = await asyncio.wait_for(
+                                res.future, timeout=_UNARY_TIMEOUT_S
+                            )
+                        except (asyncio.TimeoutError, asyncio.CancelledError):
+                            self._resolver.discard(res)  # free slot + tracking
+                            raise
+                        await self._respond(writer, conn, 200, result)
+                except KeyError as e:
+                    await self._respond(writer, conn, 404, {"error": str(e)})
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        await self._respond(writer, conn, 500, {"error": repr(e)})
+                    except h11.LocalProtocolError:
+                        return  # headers already sent (stream): just close
+                # keep-alive
+                if conn.our_state is h11.MUST_CLOSE or conn.their_state is h11.MUST_CLOSE:
+                    return
+                try:
+                    conn.start_next_cycle()
+                except h11.LocalProtocolError:
+                    return
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ lifecycle
+
     def ready(self) -> int:
         return self.port
 
@@ -147,7 +470,15 @@ class ProxyActor:
         return self.port
 
     def stop(self) -> bool:
-        self._server.shutdown()
+        self._resolver.close()
+        loop = self._loop
+        if loop is not None and loop.is_running():
+            def _shut():
+                if self._server is not None:
+                    self._server.close()
+                loop.stop()
+            loop.call_soon_threadsafe(_shut)
+        self._dispatch_pool.shutdown(wait=False)
         return True
 
     def check_health(self) -> bool:

@@ -91,6 +91,7 @@ def deployment(
     autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
     ray_actor_options: Optional[dict] = None,
     health_check_period_s: float = 1.0,
+    graceful_shutdown_timeout_s: float = 10.0,
 ) -> Union[Deployment, Callable[..., Deployment]]:
     """Reference: ``serve/api.py:246``. ``num_replicas="auto"`` enables
     autoscaling with defaults."""
@@ -111,6 +112,7 @@ def deployment(
             user_config=user_config,
             autoscaling_config=asc,
             health_check_period_s=health_check_period_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {},
         )
         return Deployment(cls, name or getattr(target, "__name__", "deployment"), cfg)
@@ -214,6 +216,115 @@ def run(
                 raise TimeoutError("Serve application failed to become ready")
             time.sleep(0.1)
     return DeploymentHandle(ingress)
+
+
+def run_config(config: "dict | str", _blocking: bool = True) -> dict:
+    """Declarative deploy from a config file/dict (reference:
+    ``serve/schema.py`` ServeDeploySchema + ``serve deploy`` CLI).
+
+    Schema::
+
+        proxy:
+          port: 8000                  # optional: enables the HTTP ingress
+        applications:
+          - name: app1
+            import_path: pkg.mod:obj  # Application, Deployment, or builder
+            args: {...}               # builder kwargs / Deployment.bind kwargs
+            deployments:              # per-deployment config overrides
+              - name: MyDeployment    # the @serve.deployment name
+                num_replicas: 2
+                max_ongoing_requests: 16
+
+    ``config`` may be the dict itself, a path to a YAML/JSON file, or a YAML
+    string. Returns ``{app_name: ingress_deployment_name}``.
+    """
+    import dataclasses as _dc
+    import importlib
+    import os
+
+    if isinstance(config, str):
+        text = None
+        if os.path.exists(config):
+            with open(config) as f:
+                text = f.read()
+        else:
+            text = config
+        try:
+            import yaml
+
+            config = yaml.safe_load(text)
+        except ImportError:
+            import json as _json
+
+            config = _json.loads(text)
+    if not isinstance(config, dict) or "applications" not in config:
+        raise ValueError("serve config must be a mapping with an 'applications' list")
+
+    handles: dict[str, str] = {}
+    http_port = (config.get("proxy") or {}).get("port")
+    for app_cfg in config["applications"]:
+        app_name = app_cfg.get("name", "default")
+        import_path = app_cfg["import_path"]
+        mod_name, _, attr = import_path.partition(":")
+        if not attr:
+            raise ValueError(
+                f"import_path {import_path!r} must be 'module.sub:attribute'"
+            )
+        obj = importlib.import_module(mod_name)
+        for part in attr.split("."):
+            obj = getattr(obj, part)
+        args = app_cfg.get("args") or {}
+        if isinstance(obj, Application):
+            app = obj
+        elif isinstance(obj, Deployment):
+            app = obj.bind(**args)
+        elif callable(obj):
+            app = obj(**args)  # builder (reference: app builders with args)
+            if isinstance(app, Deployment):
+                app = app.bind()
+        else:
+            raise TypeError(
+                f"{import_path!r} resolved to {type(obj).__name__}; expected an "
+                "Application, Deployment, or builder callable"
+            )
+        if not isinstance(app, Application):
+            raise TypeError(f"{import_path!r} did not produce an Application")
+
+        controller = _get_or_start_controller()
+        specs, ingress = _collect_specs(app, app_name)
+        overrides = {
+            d["name"]: d for d in app_cfg.get("deployments", []) if "name" in d
+        }
+        for spec in specs:
+            base = spec.name[len(app_name) + 1 :]
+            ov = overrides.get(base)
+            if not ov:
+                continue
+            cfg = _dc.replace(spec.config)  # never mutate the shared Deployment config
+            for k, v in ov.items():
+                if k == "name":
+                    continue
+                if k == "autoscaling_config" and isinstance(v, dict):
+                    v = AutoscalingConfig(**v)
+                if not hasattr(cfg, k):
+                    raise TypeError(f"Unknown deployment option {k!r} for {base!r}")
+                setattr(cfg, k, v)
+            spec.config = cfg
+        ray_tpu.get(controller.deploy_application.remote(app_name, specs), timeout=120)
+        handles[app_name] = ingress
+    if http_port is not None:
+        controller = _get_or_start_controller()
+        ray_tpu.get(controller.ensure_proxy.remote(int(http_port)), timeout=120)
+    if _blocking:
+        import time
+
+        controller = _get_or_start_controller()
+        deadline = time.time() + 120
+        while not ray_tpu.get(controller.ready.remote(), timeout=30):
+            if time.time() > deadline:
+                raise TimeoutError("Serve applications failed to become ready")
+            time.sleep(0.1)
+    return handles
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

@@ -74,6 +74,36 @@ class DeploymentResponse:
         self._settle()
         return self._ref
 
+    # -- async completion protocol (used by the HTTP proxy resolver) -------
+    # The slot stays held until _async_done/_async_failed so admission
+    # accounting and pow-2 balancing see async requests exactly like
+    # blocking result() callers.
+
+    def _async_ref(self):
+        """The ref to await WITHOUT settling the router slot."""
+        return self._ref
+
+    def _async_done(self) -> None:
+        self._settle()
+
+    def _async_failed(self, exc) -> "Optional[DeploymentResponse]":
+        """Mirror ``result()``'s failover: on replica death, mark it failed
+        and return a freshly-routed response to keep awaiting (may block in
+        pick() — call from a worker thread, not an event loop). Returns None
+        when ``exc`` should surface to the caller."""
+        from ray_tpu.exceptions import RayActorError
+
+        self._settle()
+        if not isinstance(exc, RayActorError):
+            return None
+        if self._replica is not None:
+            self._router.mark_failed(self._replica)
+        else:
+            self._router.drop()
+        if self._retry is None:
+            return None
+        return self._retry()
+
     def _settle(self):
         if not self._done:
             self._done = True
