@@ -125,6 +125,43 @@ def main() -> list[dict]:
 
     results.append(timeit("single_client_wait_1k_refs", wait_1k))
 
+    # -- scalability envelope (reference release/benchmarks/README.md:
+    # queued tasks, actor fan-out, large-object broadcast) ------------------
+    def queued_100k(n=100_000):
+        ray_tpu.get([noop.remote() for _ in range(n)], timeout=600)
+        return n
+
+    results.append(timeit("envelope_queued_tasks_100k", queued_100k,
+                          warmup=False, windows=1))
+
+    @ray_tpu.remote(num_cpus=0)
+    class E:
+        def ping(self):
+            return 1
+
+    def actor_wave(n=200):
+        wave = [E.remote() for _ in range(n)]
+        assert ray_tpu.get([x.ping.remote() for x in wave], timeout=600) == [1] * n
+        for x in wave:
+            ray_tpu.kill(x)
+        return n
+
+    results.append(timeit("envelope_actors_spawned", actor_wave,
+                          warmup=False, windows=1))
+
+    def broadcast_256mb(n=8):
+        blob_ref = ray_tpu.put(np.ones((256 << 20) // 8, np.float64))
+
+        @ray_tpu.remote
+        def read(b):
+            return b.nbytes
+
+        sizes = ray_tpu.get([read.remote(blob_ref) for _ in range(n)], timeout=300)
+        return sum(sizes) / 1e9  # logical GB fanned out
+
+    results.append(timeit("envelope_broadcast_256mb_x8", broadcast_256mb,
+                          unit="GB_per_s", warmup=False, windows=1))
+
     ray_tpu.shutdown()
     print(
         json.dumps(

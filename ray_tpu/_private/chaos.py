@@ -1,0 +1,112 @@
+"""Randomized fault injection for stress/chaos testing.
+
+Reference: ``python/ray/_private/test_utils.py:1396,1464``
+(ResourceKillerActor / NodeKillerActor randomly SIGKILL worker and raylet
+processes while workloads run) and ``python/ray/tests/test_chaos.py``. The
+round-3 GC deadlock was exactly the class of bug that per-feature tests miss
+and randomized pressure finds — this module is product code (not buried in a
+test helper) so any deployment can soak-test its own workloads.
+
+The killer runs inside the driver process of an in-process head (the test
+topology) and SIGKILLs random live worker subprocesses; the head's existing
+failure machinery — conn-EOF death detection, task retries, actor restart
+FSM, lineage reconstruction — must absorb every kill.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+
+class ResourceKiller:
+    """Periodically SIGKILL a random live worker while a workload runs.
+
+    Seeded for reproducibility (a failing seed is a regression test). Use as
+    a context manager::
+
+        with ResourceKiller(interval_s=0.4, seed=7):
+            run_workload()
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 0.5,
+        seed: int = 0,
+        warmup_s: float = 0.3,
+        max_kills: Optional[int] = None,
+        kill_actor_workers: bool = True,
+    ):
+        self.interval_s = interval_s
+        self.rng = random.Random(seed)
+        self.warmup_s = warmup_s
+        self.max_kills = max_kills
+        self.kill_actor_workers = kill_actor_workers
+        self.kills: list[tuple[float, int, str]] = []  # (t, pid, kind)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- targets -----------------------------------------------------------
+    def _candidates(self):
+        from ray_tpu._private.runtime import get_ctx
+
+        head = getattr(get_ctx(), "head", None)
+        if head is None:
+            raise RuntimeError("ResourceKiller needs an in-process head (driver)")
+        out = []
+        with head.lock:
+            for node in head.nodes.values():
+                for wh in node.all_workers:
+                    if not wh.alive or wh.proc is None or not wh.proc.is_alive():
+                        continue
+                    if wh.actor_id is not None and not self.kill_actor_workers:
+                        continue
+                    out.append(wh)
+        return out
+
+    def _kill_one(self) -> bool:
+        victims = self._candidates()
+        if not victims:
+            return False
+        wh = self.rng.choice(victims)
+        kind = "actor-worker" if wh.actor_id is not None else "task-worker"
+        pid = wh.proc.pid
+        try:
+            os.kill(pid, signal.SIGKILL)  # brutal, like the reference
+        except (ProcessLookupError, OSError):
+            return False
+        self.kills.append((time.monotonic(), pid, kind))
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def _run(self):
+        time.sleep(self.warmup_s)
+        while not self._stop.is_set():
+            if self.max_kills is not None and len(self.kills) >= self.max_kills:
+                return
+            self._kill_one()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "ResourceKiller":
+        self._thread = threading.Thread(
+            target=self._run, name="resource-killer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> list[tuple[float, int, str]]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return self.kills
+
+    def __enter__(self) -> "ResourceKiller":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

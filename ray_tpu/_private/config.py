@@ -96,6 +96,13 @@ class Config:
     #: kill/respawn (agent-side spawns that crash before connecting then
     #: fall back to a fixed 60s reap).
     worker_register_timeout_s: float = 30.0
+    #: Max worker processes booting (spawned, not yet registered) per node
+    #: at once; further spawns queue in the dispatcher. Interpreter boot is
+    #: CPU-bound, so an unbounded spawn storm (e.g. 100 actor creations)
+    #: makes EVERY boot exceed the registration timeout (reference:
+    #: ``maximum_startup_concurrency`` ≈ num_cpus, ray_config_def.h).
+    #: 0 = per-node CPU count (min 2).
+    worker_startup_concurrency: int = 0
     #: How many times a registration-timed-out spawn is retried before the
     #: slot's work is failed (actor creation) or left to the scheduler
     #: (pool workers).

@@ -206,6 +206,7 @@ class NodeState:
         self.resources_avail = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        self.dispatching = 0  # spawns handed to a thread, handle not yet visible
         # (host, port) of the node's data-plane server (agent nodes only;
         # head-host nodes are served by the head's own DataServer)
         self.data_address: Optional[tuple] = None
@@ -1458,27 +1459,84 @@ class Head:
             node.release(res)
             self._retry_pending_pgs()
 
+    def _startup_cap(self, node: NodeState) -> int:
+        cap = GLOBAL_CONFIG.worker_startup_concurrency
+        if cap > 0:
+            return cap
+        return max(int(node.resources_total.get("CPU", 1)), 2)
+
+    def _booting_count(self, node: NodeState) -> int:
+        """Workers booting on this node: handed to a spawn thread but not
+        yet visible in all_workers (``node.dispatching``, counted
+        SYNCHRONOUSLY by the dispatcher — the handle only appears after the
+        multi-ms Popen, far too late to throttle a storm) plus spawned-but-
+        unregistered handles."""
+        with self.lock:
+            return node.dispatching + len(
+                [w for w in node.all_workers if w.alive and w.conn is None]
+            )
+
     def _spawn_dispatch_loop(self):
         """Runs spawn thunks on fresh threads from OUTSIDE any lock (see
-        _spawn_q comment in __init__). Must never die: if the OS refuses a
-        new thread, degrade to running the spawn inline (serialized but
-        alive) rather than silently disabling all future spawning."""
+        _spawn_q comment in __init__). Throttles per-node startup
+        concurrency: interpreter boot is CPU-bound, and an unbounded storm
+        (100 actor creations at once) pushes every boot past the
+        registration timeout (reference: maximum_startup_concurrency).
+        Must never die: if the OS refuses a new thread, degrade to running
+        the spawn inline (serialized but alive) rather than silently
+        disabling all future spawning."""
         import traceback as _tb
 
+        deferred: list = []
         while True:
-            item = self._spawn_q.get()
+            try:
+                item = self._spawn_q.get(timeout=0.05 if deferred else None)
+            except queue.Empty:
+                item = False  # tick: only re-examine deferred spawns
             if item is None:
                 return
-            fn, args, kwargs = item
-            try:
-                threading.Thread(
-                    target=fn, args=args, kwargs=kwargs, daemon=True
-                ).start()
-            except RuntimeError:  # can't start new thread
+            pending = deferred + ([item] if item is not False else [])
+            deferred = []
+            for fn, args, kwargs in pending:
+                node = args[0]
+                if not node.alive:
+                    # node died while the spawn was queued: a dropped ACTOR
+                    # spawn must still feed the actor FSM (its create rec is
+                    # keyed in _actor_create_recs, invisible to node-death
+                    # cleanup) or the actor's waiters hang forever
+                    if fn is self._spawn_actor_worker:
+                        with self.lock:
+                            self._on_actor_worker_death(args[1])
+                            self._schedule()
+                    else:
+                        node.spawning = max(0, node.spawning - 1)
+                    continue
+                if self._booting_count(node) >= self._startup_cap(node):
+                    deferred.append((fn, args, kwargs))
+                    continue
+                with self.lock:
+                    node.dispatching += 1  # released in _run_spawn_item
                 try:
-                    fn(*args, **kwargs)
-                except Exception:  # noqa: BLE001 - keep the dispatcher alive
-                    _tb.print_exc()
+                    threading.Thread(
+                        target=self._run_spawn_item,
+                        args=(fn, node, args, kwargs),
+                        daemon=True,
+                    ).start()
+                except RuntimeError:  # can't start new thread
+                    try:
+                        self._run_spawn_item(fn, node, args, kwargs)
+                    except Exception:  # noqa: BLE001 - keep the dispatcher alive
+                        _tb.print_exc()
+
+    def _run_spawn_item(self, fn, node, args, kwargs):
+        try:
+            fn(*args, **kwargs)
+        finally:
+            # _spawn_worker returns right after the handle lands in
+            # all_workers, so from here _booting_count sees the handle
+            # instead of this counter
+            with self.lock:
+                node.dispatching = max(0, node.dispatching - 1)
 
     def _maybe_spawn(self, node: NodeState):
         cap = max(int(node.resources_total.get("CPU", 1)), 1)
@@ -2043,8 +2101,9 @@ class Head:
         if spec["kind"] == "actor_method":
             # handled by the actor restart machinery
             return
-        if rec["retries_left"] > 0:
-            rec["retries_left"] -= 1
+        if rec["retries_left"] != 0:  # -1 = unlimited (reference max_retries)
+            if rec["retries_left"] > 0:
+                rec["retries_left"] -= 1
             rec["state"] = "PENDING"
             rec["worker"] = None
             rec.pop("oom_killed", None)  # fresh attempt, fresh failure cause

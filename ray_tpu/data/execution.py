@@ -336,47 +336,99 @@ class ReadOp(PhysicalOp):
         return bool(self._tasks) and len(self._streams) < ctx.max_tasks_per_op
 
     def dispatch(self, ctx):
-        import threading
-
         rt = self._tasks.popleft()
-        gen = self._remote.remote(
-            rt, ctx.target_max_block_size, ctx.target_max_rows_per_block
-        )
-        rec = {"gen": gen, "buf": collections.deque(), "done": False, "err": None}
+        rec = {
+            "rt": rt, "buf": collections.deque(), "done": False, "err": None,
+            # operator-level fault tolerance: streams are never replayed by
+            # the core (a consumer may have seen items of the dead run), so
+            # the READ OP re-runs the deterministic read task itself and
+            # skips the bundles it already emitted — the data-plane analog
+            # of lineage reconstruction (reference: Ray Data retries failed
+            # read/map tasks at the operator layer)
+            "emitted": 0, "retries": 3, "epoch": 0,
+            "ctx_args": (ctx.target_max_block_size, ctx.target_max_rows_per_block),
+        }
         with self._slock:
             self._streams.append(rec)
+        self._spawn_feed(rec)
+
+    def _spawn_feed(self, rec):
+        import threading
+
+        old = rec.get("gen")
+        if old is not None:
+            try:
+                old.close()  # dispose the superseded stream + its producer
+            except Exception:
+                pass
+        gen = self._remote.remote(rec["rt"], *rec["ctx_args"])
+        rec["gen"] = gen
         threading.Thread(
-            target=self._feed, args=(gen, rec), name="read-stream-feed", daemon=True
+            target=self._feed, args=(gen, rec, rec["emitted"], rec["epoch"]),
+            name="read-stream-feed", daemon=True,
         ).start()
 
-    def _feed(self, gen, rec):
+    def _feed(self, gen, rec, skip: int, epoch: int):
+        """All rec mutations are epoch-guarded under _slock: a superseded
+        feed thread (its stream was retried) must never mark the fresh
+        epoch done/errored or append stale bundles."""
         try:
             for item_ref in gen:
                 blocks_ref, metas = ray_tpu.get(item_ref)
                 with self._slock:
+                    if rec["epoch"] != epoch:
+                        return  # retried underneath us: hand over entirely
+                    if skip > 0:
+                        skip -= 1  # replay of an already-emitted bundle
+                        continue
                     rec["buf"].append(RefBundle(blocks_ref, metas))
         except BaseException as e:  # noqa: BLE001 - surfaced in poll()
-            rec["err"] = e
+            with self._slock:
+                if rec["epoch"] == epoch:
+                    rec["err"] = e
         finally:
-            rec["done"] = True
+            with self._slock:
+                if rec["epoch"] == epoch:
+                    rec["done"] = True
+
+    @staticmethod
+    def _retriable(err) -> bool:
+        from ray_tpu import exceptions as rex
+
+        return isinstance(
+            err, (rex.WorkerCrashedError, rex.RayActorError, rex.ObjectLostError)
+        )
 
     def poll(self, ctx):
         if self.finished:
             self.shutdown()
             return
         err = None
+        respawn = None
         with self._slock:
             while self._streams:
                 rec = self._streams[0]
                 while rec["buf"]:
                     self.output_queue.append(rec["buf"].popleft())
+                    rec["emitted"] += 1
                 if rec["err"] is not None:
-                    err = rec["err"]
+                    if self._retriable(rec["err"]) and rec["retries"] != 0:
+                        if rec["retries"] > 0:
+                            rec["retries"] -= 1
+                        rec["err"] = None
+                        rec["done"] = False
+                        rec["buf"].clear()
+                        rec["epoch"] += 1  # invalidates the old feed thread
+                        respawn = rec
+                    else:
+                        err = rec["err"]
                     break
                 if rec["done"]:
                     self._streams.popleft()
                     continue
                 break
+        if respawn is not None:
+            self._spawn_feed(respawn)  # outside _slock: submits a task
         if err is not None:
             raise err
 

@@ -1,0 +1,91 @@
+"""Scalability envelope (reference: ``release/benchmarks/README.md`` — 1M
+queued tasks, 40k actors, 1 GiB broadcast on big clusters). Scaled to this
+CI box (1 core) but structurally identical: deep scheduler queues, actor
+fan-out, one large object fanned to every node. The full-size numbers are
+recorded per round by ``bench_core.py``'s envelope section.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_4cpu():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_deep_task_queue_100k(ray_4cpu):
+    """100k no-op tasks queued at once: the signature-bucketed pending queue
+    must stay O(signatures) per pass, not O(tasks) (head._PendingQueue) —
+    submission and drain both complete in bounded time."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def nop(i):
+        return i
+
+    t0 = time.monotonic()
+    refs = [nop.remote(i) for i in range(100_000)]
+    t_submit = time.monotonic() - t0
+    out = ray_tpu.get(refs, timeout=600)
+    t_total = time.monotonic() - t0
+    assert out == list(range(100_000))
+    # generous envelope bounds: catching O(n^2) scheduler regressions, not
+    # measuring throughput (bench_core does that uncontended)
+    assert t_submit < 120, f"submission took {t_submit:.1f}s"
+    assert t_total < 540, f"drain took {t_total:.1f}s"
+
+
+def test_actor_wave_100(ray_4cpu):
+    """100 concurrent actors (each a real OS process) all answering."""
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    actors = [A.remote(i) for i in range(100)]
+    out = ray_tpu.get([a.ping.remote() for a in actors], timeout=300)
+    assert out == list(range(100))
+    # second round-trip: all still alive
+    out2 = ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    assert out2 == list(range(100))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_broadcast_256mb_8_nodes():
+    """One 256MB object read by a task on each of 8 virtual nodes
+    (reference: 1 GiB broadcast to 50 nodes). Same-host shm is zero-copy;
+    the data-plane path is exercised separately in test_data_plane."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        for _ in range(7):
+            cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+
+        blob = np.ones((256 << 20) // 8, dtype=np.float64)  # 256MB
+        ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote(num_cpus=1)
+        def digest(x):
+            return float(x[0]) + float(x[-1]) + x.nbytes
+
+        t0 = time.monotonic()
+        outs = ray_tpu.get([digest.remote(ref) for _ in range(8)], timeout=300)
+        dt = time.monotonic() - t0
+        assert outs == [2.0 + (256 << 20)] * 8
+        # zero-copy shm reads: 2GB of logical traffic must not take minutes
+        assert dt < 120, f"8-node 256MB broadcast took {dt:.1f}s"
+    finally:
+        cluster.shutdown()
