@@ -88,7 +88,12 @@ class ResourceKiller:
         while not self._stop.is_set():
             if self.max_kills is not None and len(self.kills) >= self.max_kills:
                 return
-            self._kill_one()
+            try:
+                self._kill_one()
+            except Exception:  # noqa: BLE001
+                # the runtime may be torn down (shutdown() mid-chaos) while
+                # this thread is live — that is "no candidates", not a crash
+                return
             self._stop.wait(self.interval_s)
 
     def start(self) -> "ResourceKiller":

@@ -1504,12 +1504,16 @@ class Head:
                     # spawn must still feed the actor FSM (its create rec is
                     # keyed in _actor_create_recs, invisible to node-death
                     # cleanup) or the actor's waiters hang forever
-                    if fn is self._spawn_actor_worker:
+                    # NB: compare unbound functions — `fn is self._spawn_actor_worker`
+                    # is always False (each attribute access builds a fresh
+                    # bound-method object)
+                    if getattr(fn, "__func__", None) is Head._spawn_actor_worker:
                         with self.lock:
                             self._on_actor_worker_death(args[1])
                             self._schedule()
                     else:
-                        node.spawning = max(0, node.spawning - 1)
+                        with self.lock:
+                            node.spawning = max(0, node.spawning - 1)
                     continue
                 if self._booting_count(node) >= self._startup_cap(node):
                     deferred.append((fn, args, kwargs))

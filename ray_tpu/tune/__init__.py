@@ -45,6 +45,10 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     MedianStoppingRule,
     PopulationBasedTraining,
 )
+from ray_tpu.tune.pb2 import PB2  # noqa: F401
+
+# OptunaSearcher lives in ray_tpu.tune.optuna_adapter; not imported eagerly
+# here so `import ray_tpu.tune` never requires optuna.
 
 
 @dataclasses.dataclass
