@@ -925,7 +925,11 @@ class Head:
     # -------------------------------------------------------------- workers
 
     def _spawn_worker(
-        self, node: NodeState, actor_id: Optional[bytes] = None, attempts: int = 0
+        self,
+        node: NodeState,
+        actor_id: Optional[bytes] = None,
+        attempts: int = 0,
+        container: Optional[dict] = None,
     ) -> None:
         # Workers are fresh interpreter processes running a dedicated entry
         # point (`python -m ray_tpu._private.worker_main`), like the
@@ -935,6 +939,14 @@ class Head:
         # delegate the spawn to their agent daemon over TCP.
         import uuid as _uuid
 
+        if actor_id is not None and container is None:
+            # every actor spawn path (first spawn, registration-timeout
+            # retry, restart FSM) funnels here; resolve the container spec
+            # from the create rec so no caller can drop it
+            with self.lock:
+                rec = self._actor_create_recs.get(actor_id)
+                if rec is not None:
+                    container = (rec["spec"].get("runtime_env") or {}).get("container")
         token = _uuid.uuid4().hex
         if node.agent is not None:
             wh = WorkerHandle(node, None)
@@ -943,7 +955,10 @@ class Head:
             wh.spawn_attempts = attempts
             with self.lock:
                 node.all_workers.add(wh)
-            if not node.agent.send(("spawn_worker", {"token": token})):
+            msg: dict = {"token": token}
+            if container:
+                msg["container"] = container
+            if not node.agent.send(("spawn_worker", msg)):
                 self._on_worker_dead(wh)
             return
 
@@ -962,19 +977,20 @@ class Head:
             # the unix socket dies with the old head process, the TCP
             # address is what a restarted head rebinds
             env["RAY_TPU_HEAD_TCP"] = f"{self.tcp_address[0]}:{self.tcp_address[1]}"
-        popen = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu._private.worker_main",
-                self.socket_path,
-                self.authkey.hex(),
-                node.node_id.binary().hex(),
-                token,
-            ],
-            env=env,
-            start_new_session=False,
-        )
+        argv = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.worker_main",
+            self.socket_path,
+            self.authkey.hex(),
+            node.node_id.binary().hex(),
+            token,
+        ]
+        if container:
+            from ray_tpu._private import runtime_env as _renv
+
+            argv, env = _renv.container_wrap(argv, env, pkg_root, container)
+        popen = subprocess.Popen(argv, env=env, start_new_session=False)
         proc = _WorkerProc(popen)
         wh = WorkerHandle(node, proc)
         wh.actor_id = actor_id

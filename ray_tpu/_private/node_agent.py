@@ -179,19 +179,21 @@ class NodeAgent:
         # over-arena-cap objects get dedicated segments tagged with this
         # agent's prefix, so shutdown can sweep any the head never freed
         env["RAY_TPU_SEG_PREFIX"] = self._seg_prefix
-        popen = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "ray_tpu._private.worker_main",
-                self.address,
-                self.authkey.hex(),
-                self.node_id_bin.hex(),
-                info.get("token", ""),
-                "--remote",
-            ],
-            env=env,
-        )
+        argv = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.worker_main",
+            self.address,
+            self.authkey.hex(),
+            self.node_id_bin.hex(),
+            info.get("token", ""),
+            "--remote",
+        ]
+        if info.get("container"):
+            from ray_tpu._private.runtime_env import container_wrap
+
+            argv, env = container_wrap(argv, env, pkg_root, info["container"])
+        popen = subprocess.Popen(argv, env=env)
         self._procs.append(popen)
         token = info.get("token", "")
         if token:

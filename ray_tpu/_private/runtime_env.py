@@ -1,4 +1,5 @@
-"""Runtime environments: env_vars, working_dir, py_modules, pip + plugins.
+"""Runtime environments: env_vars, working_dir, py_modules, pip, conda,
+container + plugins.
 
 Reference: ``python/ray/_private/runtime_env/`` — ``packaging.py`` (zipped
 URIs through the GCS KV, extracted per node with a URI cache), ``pip.py``
@@ -17,6 +18,18 @@ API third-party env features hang off). TPU-first simplifications:
   :func:`applied`) is where an exec-based implementation would slot in.
   Requirements that name LOCAL files (wheels) are shipped through the KV,
   so air-gapped clusters install with ``--no-index``;
+* ``conda`` (reference ``runtime_env/conda.py``): yml specs build a
+  per-content-hash prefix env once per node (``conda env create -p``);
+  named envs resolve against the node's installation. Activation is
+  in-process (PATH/CONDA_PREFIX + site-packages injection when the
+  interpreter minor version matches) — the "conda ACTIVATION SEAM" in
+  :func:`applied` is where an exec-based worker swap would slot in;
+* ``container`` (reference ``runtime_env/container.py``): actors (which
+  own a dedicated worker process) spawn inside ``podman run`` joining the
+  host's network/IPC/PID namespaces with /tmp, /dev/shm, and the package
+  root bound — see :func:`container_wrap`, applied in
+  ``head._spawn_worker`` and ``node_agent._spawn``. Pooled task workers
+  reject the key at submission;
 * plugins: :func:`register_plugin` adds a key handled by a
   :class:`RuntimeEnvPlugin` — ``package_value`` runs at submission (upload
   side-channel data through ``ctx``), ``apply`` is a worker-side context
@@ -39,7 +52,7 @@ import tempfile
 import zipfile
 from typing import Any, Optional
 
-_ALLOWED = {"env_vars", "working_dir", "py_modules", "pip"}
+_ALLOWED = {"env_vars", "working_dir", "py_modules", "pip", "conda", "container"}
 _KV_PREFIX = "__runtime_env_pkg__/"
 _EXTRACT_CACHE: dict[str, str] = {}  # kv key -> extracted dir (per process)
 
@@ -71,9 +84,11 @@ def register_plugin(key: str, plugin: RuntimeEnvPlugin) -> None:
     _PLUGINS[key] = plugin
 
 
-def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
+def package(runtime_env: Optional[dict], ctx, kind: str = "task") -> Optional[dict]:
     """Validate + normalize at submission: working_dir is zipped into the
-    head KV (content-addressed, uploaded once)."""
+    head KV (content-addressed, uploaded once). ``kind`` is "task" or
+    "actor" — container isolation needs a dedicated worker process, which
+    only actors (and job supervisors) own."""
     if not runtime_env:
         return None
     unknown = set(runtime_env) - _ALLOWED - set(_PLUGINS)
@@ -140,6 +155,46 @@ def package(runtime_env: Optional[dict], ctx) -> Optional[dict]:
             else:
                 shipped.append({"req": r})
         out["pip"] = shipped
+    conda = runtime_env.get("conda")
+    if conda:
+        # reference conda.py semantics: a dict is an environment.yml spec,
+        # a string is either a yml FILE path or the NAME of a pre-existing
+        # env on the nodes. yml content ships in the spec (it is tiny) so
+        # workers need no submission-host filesystem access.
+        if isinstance(conda, dict):
+            import yaml as _yaml
+
+            out["conda"] = {"yaml": _yaml.safe_dump(conda, sort_keys=True)}
+        elif isinstance(conda, str) and conda.endswith((".yml", ".yaml")):
+            if not os.path.isfile(conda):
+                raise ValueError(f"runtime_env['conda'] file {conda!r} not found")
+            with open(conda) as f:
+                out["conda"] = {"yaml": f.read()}
+        elif isinstance(conda, str):
+            out["conda"] = {"name": conda}
+        else:
+            raise TypeError("runtime_env['conda'] must be a dict, yml path, or env name")
+    container = runtime_env.get("container")
+    if container:
+        if kind != "actor":
+            # a pooled task worker cannot be retroactively containerized;
+            # the reference's worker-level container support likewise rides
+            # dedicated worker startup (runtime_env/container.py)
+            raise ValueError(
+                "runtime_env['container'] requires a dedicated worker "
+                "process — use an actor (or submit a job)"
+            )
+        if not isinstance(container, dict) or not container.get("image"):
+            raise TypeError("runtime_env['container'] must be {'image': ..., ...}")
+        unknown_c = set(container) - {"image", "run_options", "worker_python", "runner"}
+        if unknown_c:
+            raise ValueError(f"unsupported container key(s) {sorted(unknown_c)}")
+        out["container"] = {
+            "image": str(container["image"]),
+            "run_options": [str(o) for o in container.get("run_options") or []],
+            "worker_python": str(container.get("worker_python") or "python3"),
+            **({"runner": str(container["runner"])} if container.get("runner") else {}),
+        }
     for key, plugin in _PLUGINS.items():
         if key in runtime_env:
             out.setdefault("plugins", {})[key] = plugin.package_value(
@@ -284,6 +339,130 @@ def ensure_pip_prefix(shipped: list, ctx) -> str:
     return prefix
 
 
+def _conda_exe() -> Optional[str]:
+    import shutil
+
+    return (
+        os.environ.get("RAY_TPU_CONDA_EXE")
+        or os.environ.get("CONDA_EXE")
+        or shutil.which("conda")
+        or shutil.which("mamba")
+        or shutil.which("micromamba")
+    )
+
+
+def ensure_conda_prefix(spec: dict) -> str:
+    """Materialize the conda environment for this node (reference: conda.py
+    ``get_or_create_conda_env`` — per-yml-hash env built once, cached).
+    Named envs resolve against the node's conda installation; yml specs
+    create a prefix env under the runtime-env cache, exactly once per node
+    per content hash."""
+    import json
+    import shutil
+    import subprocess as sp
+
+    exe = _conda_exe()
+    if exe is None:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda/mamba binary on the node "
+            "(set RAY_TPU_CONDA_EXE to override discovery)"
+        )
+    name = spec.get("name")
+    if name:
+        if name == "base":
+            # the root prefix's basename is the install dir ('miniconda3'),
+            # never 'base' — resolve it like the reference conda.py does
+            proc = sp.run([exe, "info", "--json"], capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                raise RuntimeError(f"conda info failed:\n{proc.stderr[-1000:]}")
+            root = json.loads(proc.stdout).get("root_prefix")
+            if root:
+                return root
+        proc = sp.run([exe, "env", "list", "--json"], capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(f"conda env list failed:\n{proc.stderr[-1000:]}")
+        for prefix in json.loads(proc.stdout).get("envs", []):
+            if os.path.basename(prefix) == name:
+                return prefix
+        raise RuntimeError(f"conda env {name!r} not found on this node")
+    yml = spec["yaml"]
+    env_hash = hashlib.sha1(yml.encode()).hexdigest()[:16]
+    prefix = os.path.join(_cache_root(), f"conda-{env_hash}")
+    done = os.path.join(prefix, ".done")
+    if os.path.exists(done):
+        return prefix
+    with _build_lock(f"conda-{env_hash}"):
+        if os.path.exists(done):
+            return prefix  # another worker built it while we waited
+        scratch = prefix + ".building"
+        shutil.rmtree(scratch, ignore_errors=True)
+        shutil.rmtree(prefix, ignore_errors=True)
+        yml_path = os.path.join(_cache_root(), f"conda-{env_hash}.yml")
+        with open(yml_path, "w") as f:
+            f.write(yml)
+        try:
+            proc = sp.run(
+                [exe, "env", "create", "-p", scratch, "-f", yml_path, "-q"],
+                capture_output=True,
+                text=True,
+                timeout=900,
+            )
+        except sp.TimeoutExpired as e:
+            shutil.rmtree(scratch, ignore_errors=True)
+            raise RuntimeError(f"conda env create timed out: {e}") from None
+        if proc.returncode != 0:
+            shutil.rmtree(scratch, ignore_errors=True)
+            raise RuntimeError(
+                f"conda env create failed (rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+            )
+        with open(os.path.join(scratch, ".done"), "w") as f:
+            f.write("ok")
+        os.rename(scratch, prefix)
+    return prefix
+
+
+def container_wrap(argv: list, env: dict, pkg_root: str, spec: dict) -> tuple[list, dict]:
+    """Wrap a worker spawn command in a container runner invocation
+    (reference: runtime_env/container.py — podman run with host namespaces).
+
+    The worker must still reach the head's AF_UNIX socket (/tmp), the shm
+    arena (/dev/shm), and the ray_tpu package (ro bind of pkg_root), so the
+    container joins the host's network/IPC/PID namespaces and binds those
+    paths. ``argv`` must start with the host python; it is swapped for the
+    image's ``worker_python``. RAY_TPU_*/PYTHONPATH env vars cross the
+    boundary as explicit --env flags (a container does not inherit the
+    spawner's environ). Returns (wrapped_argv, spawn_env)."""
+    runner = (
+        spec.get("runner")
+        or os.environ.get("RAY_TPU_CONTAINER_RUNNER")
+        or "podman"
+    )
+    tmp = tempfile.gettempdir()  # head socket + env caches follow TMPDIR
+    prefix = [
+        runner,
+        "run",
+        "--rm",
+        "--network=host",
+        "--ipc=host",
+        "--pid=host",
+        "-v",
+        f"{pkg_root}:{pkg_root}:ro",
+        "-v",
+        f"{tmp}:{tmp}",
+        "-v",
+        "/dev/shm:/dev/shm",
+    ]
+    if tmp != "/tmp":
+        prefix += ["-v", "/tmp:/tmp"]
+    for k, v in sorted(env.items()):
+        if k == "PYTHONPATH" or k.startswith("RAY_TPU_"):
+            prefix += ["--env", f"{k}={v}"]
+    prefix += spec.get("run_options") or []
+    prefix.append(spec["image"])
+    inner = [spec.get("worker_python") or "python3"] + list(argv[1:])
+    return prefix + inner, env
+
+
 @contextlib.contextmanager
 def applied(runtime_env: Optional[dict], ctx, permanent: bool = False):
     """Worker-side application. ``permanent=True`` (actors) leaves the env
@@ -315,6 +494,27 @@ def applied(runtime_env: Optional[dict], ctx, permanent: bool = False):
         for k, v in (runtime_env.get("env_vars") or {}).items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = v
+        conda = runtime_env.get("conda")
+        if conda:
+            # conda ACTIVATION SEAM: like pip below, activation is in-process
+            # — PATH/CONDA_PREFIX for the env's binaries + native libs, and
+            # sys.path for its pure-python packages when the env's
+            # interpreter minor version matches this worker's. A full
+            # interpreter swap would slot in at worker spawn (next to the
+            # container prefix in head._spawn_worker).
+            prefix = ensure_conda_prefix(conda)
+            for k, v in (
+                ("PATH", os.path.join(prefix, "bin") + os.pathsep + os.environ.get("PATH", "")),
+                ("CONDA_PREFIX", prefix),
+                ("CONDA_DEFAULT_ENV", os.path.basename(prefix)),
+            ):
+                saved_env.setdefault(k, os.environ.get(k))
+                os.environ[k] = v
+            site = os.path.join(
+                prefix, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}", "site-packages"
+            )
+            if os.path.isdir(site):
+                sys.path.insert(0, site)
         reqs = runtime_env.get("pip")
         if reqs:
             # pip ACTIVATION SEAM (see module docstring): swap this

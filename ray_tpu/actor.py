@@ -229,7 +229,7 @@ class ActorClass:
         if options.get("runtime_env"):
             from ray_tpu._private import runtime_env as renv
 
-            spec["runtime_env"] = renv.package(options["runtime_env"], ctx)
+            spec["runtime_env"] = renv.package(options["runtime_env"], ctx, kind="actor")
         for rid in return_ids:
             ctx.call("add_ref", obj_id=rid)
         try:

@@ -54,7 +54,7 @@ def test_runtime_env_validation(ray_start_regular):
         return 1
 
     with pytest.raises(ValueError, match="Unsupported runtime_env"):
-        f.options(runtime_env={"conda": "env"}).remote()
+        f.options(runtime_env={"nonexistent_tier": "x"}).remote()
     with pytest.raises(ValueError, match="not a directory"):
         f.options(runtime_env={"working_dir": "/nonexistent/xyz"}).remote()
 

@@ -1,0 +1,225 @@
+"""Conda + container runtime_env tiers (reference:
+``python/ray/_private/runtime_env/conda.py``, ``container.py``;
+``python/ray/tests/test_runtime_env_conda_and_pip.py`` /
+``test_container.py`` themes). Both tiers are driven through FAKE
+binaries that record their command lines — the real ones need a conda
+installation / a container runtime, neither of which CI has."""
+
+import json
+import os
+import shutil
+import stat
+import sys
+import uuid
+
+import pytest
+
+import ray_tpu
+
+
+def _write_exe(path, body):
+    with open(path, "w") as f:
+        f.write(f"#!{sys.executable}\n" + body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+@pytest.fixture
+def fake_conda(tmp_path, monkeypatch):
+    """A conda stand-in: `env create -p P -f YML` materializes a prefix with
+    a site-packages marker module + a bin tool; `env list --json` reports
+    the envs it created (plus a pretend named env). Every invocation is
+    appended to a log."""
+    log = tmp_path / "conda_calls.log"
+    named_prefix = tmp_path / "envs" / "preexisting"
+    body = f"""
+import json, os, sys
+LOG = {str(log)!r}
+NAMED = {str(named_prefix)!r}
+with open(LOG, "a") as f:
+    f.write(json.dumps(sys.argv[1:]) + "\\n")
+args = sys.argv[1:]
+if args[:2] == ["env", "create"]:
+    prefix = args[args.index("-p") + 1]
+    site = os.path.join(prefix, "lib",
+                        f"python{{sys.version_info[0]}}.{{sys.version_info[1]}}",
+                        "site-packages")
+    os.makedirs(site, exist_ok=True)
+    os.makedirs(os.path.join(prefix, "bin"), exist_ok=True)
+    with open(os.path.join(site, "conda_marker_mod.py"), "w") as f:
+        f.write("VALUE = 'from-conda-env'\\n")
+    tool = os.path.join(prefix, "bin", "condatool")
+    with open(tool, "w") as f:
+        f.write("#!/bin/sh\\necho tool\\n")
+    os.chmod(tool, 0o755)
+elif args[:2] == ["env", "list"]:
+    os.makedirs(os.path.join(NAMED, "bin"), exist_ok=True)
+    print(json.dumps({{"envs": [NAMED]}}))
+"""
+    exe = _write_exe(tmp_path / "conda", body)
+    monkeypatch.setenv("RAY_TPU_CONDA_EXE", exe)
+    return {"log": log, "named_prefix": named_prefix}
+
+
+def _conda_create_calls(log):
+    if not log.exists():
+        return []
+    return [
+        json.loads(line)
+        for line in log.read_text().splitlines()
+        if json.loads(line)[:2] == ["env", "create"]
+    ]
+
+
+def test_conda_yaml_env_builds_activates_and_caches(ray_start_regular, fake_conda):
+    yml = {
+        "name": "t",
+        "dependencies": ["pip", str(uuid.uuid4())],  # uuid => unique hash per run
+    }
+
+    @ray_tpu.remote
+    def probe():
+        import conda_marker_mod
+
+        return (
+            conda_marker_mod.VALUE,
+            os.environ.get("CONDA_PREFIX", ""),
+            shutil.which("condatool") is not None,
+        )
+
+    env = {"conda": yml}
+    val, prefix, tool = ray_tpu.get(
+        probe.options(runtime_env=env).remote(), timeout=90
+    )
+    assert val == "from-conda-env"
+    assert prefix.startswith(os.path.join(__import__("tempfile").gettempdir(), "ray_tpu_runtime_env"))
+    assert tool
+
+    # same yml again: the cached prefix is reused, no second create
+    ray_tpu.get(probe.options(runtime_env=env).remote(), timeout=90)
+    assert len(_conda_create_calls(fake_conda["log"])) == 1
+
+    # the env never leaks into plain tasks on the (reused) pooled worker
+    @ray_tpu.remote
+    def plain():
+        return os.environ.get("CONDA_PREFIX")
+
+    assert ray_tpu.get(plain.remote(), timeout=60) in (None, "")
+
+
+def test_conda_named_env_resolves_node_side(ray_start_regular, fake_conda):
+    @ray_tpu.remote
+    def probe():
+        return os.environ.get("CONDA_PREFIX", "")
+
+    got = ray_tpu.get(
+        probe.options(runtime_env={"conda": "preexisting"}).remote(), timeout=90
+    )
+    assert got == str(fake_conda["named_prefix"])
+    assert len(_conda_create_calls(fake_conda["log"])) == 0  # resolve, not create
+
+    with pytest.raises(Exception):
+        ray_tpu.get(
+            probe.options(runtime_env={"conda": "no-such-env"}).remote(), timeout=90
+        )
+
+
+def test_conda_real_binary_smoke(ray_start_regular):
+    """Offline-tolerant: only runs where a real conda exists (resolving the
+    always-present base env needs no network)."""
+    if os.environ.get("RAY_TPU_CONDA_EXE") or not shutil.which("conda"):
+        pytest.skip("no real conda on this machine")
+
+    @ray_tpu.remote
+    def probe():
+        return os.environ.get("CONDA_PREFIX", "")
+
+    got = ray_tpu.get(probe.options(runtime_env={"conda": "base"}).remote(), timeout=120)
+    assert got
+
+
+@pytest.fixture
+def fake_runner(tmp_path):
+    """A podman stand-in: records its argv, then execs the wrapped worker
+    command with the --env vars applied — so the containerized actor REALLY
+    runs and the full create->call->result path is exercised."""
+    log = tmp_path / "runner_calls.json"
+    body = f"""
+import json, os, sys
+LOG = {str(log)!r}
+args = sys.argv[1:]
+with open(LOG, "w") as f:
+    json.dump(args, f)
+env = dict(os.environ)
+i = 0
+while i < len(args):
+    if args[i] == "--env":
+        k, _, v = args[i + 1].partition("=")
+        env[k] = v
+        i += 2
+    else:
+        i += 1
+k = args.index("ray_tpu._private.worker_main")
+os.execve(sys.executable, [sys.executable, "-m"] + args[k:], env)
+"""
+    exe = _write_exe(tmp_path / "podman", body)
+    return {"exe": exe, "log": log}
+
+
+def test_container_actor_spawns_through_runner(ray_start_regular, fake_runner):
+    @ray_tpu.remote(
+        runtime_env={
+            "container": {
+                "image": "example.io/worker:v1",
+                "run_options": ["--device=/dev/fuse"],
+                "runner": fake_runner["exe"],
+            }
+        }
+    )
+    class Boxed:
+        def whoami(self):
+            return os.getpid()
+
+    a = Boxed.remote()
+    pid = ray_tpu.get(a.whoami.remote(), timeout=90)
+    assert pid != os.getpid()
+
+    argv = json.loads(fake_runner["log"].read_text())
+    assert argv[0] == "run" and "--rm" in argv
+    # host namespaces + the three binds the worker needs to function
+    assert "--network=host" in argv and "--ipc=host" in argv and "--pid=host" in argv
+    binds = [argv[i + 1] for i, a_ in enumerate(argv) if a_ == "-v"]
+    assert any(b.startswith("/tmp:") for b in binds)
+    assert any(b.startswith("/dev/shm:") for b in binds)
+    # user run_options ride along; image is the last pre-command token
+    assert "--device=/dev/fuse" in argv
+    img_i = argv.index("example.io/worker:v1")
+    assert argv[img_i + 1] == "python3"  # default worker_python
+    # PYTHONPATH crosses the boundary as an explicit --env
+    envs = [argv[i + 1] for i, a_ in enumerate(argv) if a_ == "--env"]
+    assert any(e.startswith("PYTHONPATH=") for e in envs)
+
+
+def test_container_rejected_for_pooled_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="dedicated worker"):
+        f.options(runtime_env={"container": {"image": "x"}}).remote()
+
+
+def test_container_validation(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"container": {"image": "x", "bogus": 1}})
+    class A:
+        pass
+
+    with pytest.raises(ValueError, match="bogus"):
+        A.remote()
+
+    @ray_tpu.remote(runtime_env={"container": "just-a-string"})
+    class B:
+        pass
+
+    with pytest.raises(TypeError):
+        B.remote()
