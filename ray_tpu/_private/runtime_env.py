@@ -339,6 +339,11 @@ def ensure_pip_prefix(shipped: list, ctx) -> str:
     return prefix
 
 
+# named env -> resolved prefix, per worker process: pooled workers apply
+# envs per TASK, and a conda subprocess per task would dominate latency
+_NAMED_CONDA_CACHE: dict[str, str] = {}
+
+
 def _conda_exe() -> Optional[str]:
     import shutil
 
@@ -369,6 +374,9 @@ def ensure_conda_prefix(spec: dict) -> str:
         )
     name = spec.get("name")
     if name:
+        cached = _NAMED_CONDA_CACHE.get(name)
+        if cached is not None:
+            return cached
         if name == "base":
             # the root prefix's basename is the install dir ('miniconda3'),
             # never 'base' — resolve it like the reference conda.py does
@@ -377,12 +385,14 @@ def ensure_conda_prefix(spec: dict) -> str:
                 raise RuntimeError(f"conda info failed:\n{proc.stderr[-1000:]}")
             root = json.loads(proc.stdout).get("root_prefix")
             if root:
+                _NAMED_CONDA_CACHE[name] = root
                 return root
         proc = sp.run([exe, "env", "list", "--json"], capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             raise RuntimeError(f"conda env list failed:\n{proc.stderr[-1000:]}")
         for prefix in json.loads(proc.stdout).get("envs", []):
             if os.path.basename(prefix) == name:
+                _NAMED_CONDA_CACHE[name] = prefix
                 return prefix
         raise RuntimeError(f"conda env {name!r} not found on this node")
     yml = spec["yaml"]

@@ -245,8 +245,16 @@ class AutoscalerV2:
         by_label = {
             n.get("labels", {}).get("instance_id"): n for n in nodes if n.get("labels")
         }
+        # cloud pools (GKE) can't stamp the autoscaler's instance_id on a VM
+        # ahead of a resize — those nodes join labeled with their VM name
+        # instead (the startup-script contract in autoscaler/gke.py)
+        by_provider = {
+            n.get("labels", {}).get("provider_node_id"): n
+            for n in nodes
+            if n.get("labels", {}).get("provider_node_id")
+        }
         for inst in self.im.with_status(ALLOCATED):
-            node = by_label.get(inst.instance_id)
+            node = by_label.get(inst.instance_id) or by_provider.get(inst.provider_id)
             if node is not None:
                 inst.ray_node_id = node.get("node_id")
                 inst.set_status(RAY_RUNNING)
@@ -308,7 +316,7 @@ class FakeAsyncProvider(AsyncNodeProvider):
         self.created.append(instance.provider_id)
         if self.cluster is not None:
             node_id = self.cluster.add_node(
-                dict(self._resources_by_id[instance.instance_id]),
+                resources=dict(self._resources_by_id[instance.instance_id]),
                 labels={**self._labels_by_id[instance.instance_id],
                         "instance_id": instance.instance_id},
             )

@@ -30,13 +30,27 @@ def resolve_address(address: str) -> "Cluster":
 
 
 class Cluster:
-    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[dict] = None,
+        head: Optional[Head] = None,
+    ):
+        """``head=`` wraps an ALREADY-RUNNING head (e.g. the one
+        ``ray_tpu up`` hosts) instead of creating a private one — virtual
+        nodes then register against the live cluster."""
+        self._owns_head = head is None
+        if head is not None:
+            self.head = head
+            self.nodes: list[NodeID] = []
+            self.head_node: Optional[NodeID] = None
+            return
         self._session_dir = tempfile.mkdtemp(prefix="ray_tpu_cluster_")
         sock = os.path.join(self._session_dir, "head.sock")
         self.head = Head(sock, authkey=os.urandom(16))
         self.head.start()
-        self.nodes: list[NodeID] = []
-        self.head_node: Optional[NodeID] = None
+        self.nodes = []
+        self.head_node = None
         if initialize_head:
             args = dict(head_node_args or {})
             self.head_node = self.add_node(**args)
@@ -83,6 +97,8 @@ class Cluster:
 
     def shutdown(self):
         _api.shutdown()
+        if not self._owns_head:
+            return  # a borrowed head (ray_tpu up) outlives this wrapper
         try:
             self.head.shutdown()
         except Exception:

@@ -66,7 +66,8 @@ def cmd_start(args) -> int:
     res = _parse_resources(args.resources)
     if args.num_cpus:
         res.setdefault("CPU", float(args.num_cpus))
-    agent = NodeAgent(args.address, authkey, resources=res or None)
+    labels = json.loads(args.labels) if getattr(args, "labels", None) else None
+    agent = NodeAgent(args.address, authkey, resources=res or None, labels=labels)
     print(f"ray_tpu node joined {args.address} as {agent.node_id_bin.hex()[:12]}")
     sys.stdout.flush()
     agent.run()
@@ -205,6 +206,67 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """Launch a cluster from YAML: head in this process + autoscaler loop
+    (reference: `ray up` in autoscaler/_private/commands.py)."""
+    from ray_tpu._private.config import resolve_authkey
+    from ray_tpu._private.head import Head
+    from ray_tpu.autoscaler.cluster_config import (
+        build_provider,
+        load_cluster_config,
+        run_cluster,
+    )
+
+    cfg = load_cluster_config(args.config)
+    head_cfg = cfg.get("head") or {}
+    session = tempfile.mkdtemp(prefix="ray_tpu_head_")
+    head = Head(os.path.join(session, "head.sock"), authkey=resolve_authkey())
+    head.start()
+    host, port = head.listen_tcp(
+        head_cfg.get("host", "127.0.0.1"), int(head_cfg.get("port", 0))
+    )
+    head.add_node({"CPU": float(head_cfg.get("num_cpus", os.cpu_count() or 1))})
+    print(f"[{cfg['cluster_name']}] head listening on {host}:{port}")
+    print(
+        "  worker join: python -m ray_tpu start "
+        f"--address={host}:{port} "
+        "--labels '{\"provider_node_id\": \"'$(hostname)'\"}'"
+    )
+    sys.stdout.flush()
+    cluster = None
+    if cfg["provider"]["type"] == "fake":
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(head=head)
+    provider = build_provider(cfg, cluster=cluster)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        counts = run_cluster(
+            cfg,
+            head,
+            provider,
+            max_ticks=args.ticks,
+            stop_check=lambda: bool(stop),
+        )
+        print(f"[{cfg['cluster_name']}] instances: {json.dumps(counts)}")
+    finally:
+        head.shutdown()
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.cluster_config import load_cluster_config, teardown_cluster
+
+    cfg = load_cluster_config(args.config)
+    gone = teardown_cluster(cfg)
+    print(f"[{cfg['cluster_name']}] terminated {len(gone)} instance(s)")
+    for name in gone:
+        print(f"  {name}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -216,7 +278,21 @@ def main(argv=None) -> int:
     p.add_argument("--address", help="HOST:PORT of a running head (node mode)")
     p.add_argument("--num-cpus", type=int)
     p.add_argument("--resources", help="JSON resource dict")
+    p.add_argument("--labels", help="JSON node labels (e.g. provider_node_id)")
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config", help="path to cluster YAML")
+    p.add_argument(
+        "--ticks",
+        type=int,
+        help="run N autoscaler reconcile ticks then exit (default: forever)",
+    )
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="terminate every cluster VM from a YAML config")
+    p.add_argument("config", help="path to cluster YAML")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("summary", help="cluster state summary")
     p.add_argument("--address", required=True)

@@ -1,0 +1,270 @@
+"""GKE provider + cluster YAML + up/down CLI (reference:
+``python/ray/autoscaler/_private/gcp/node_provider.py``,
+``autoscaler/ray-schema.json``, ``ray up`` commands.py themes)."""
+
+import json
+import textwrap
+
+import pytest
+
+from ray_tpu.autoscaler.cluster_config import (
+    build_provider,
+    load_cluster_config,
+    teardown_cluster,
+    validate_cluster_config,
+)
+from ray_tpu.autoscaler.gke import GKEClient, GKETPUAsyncProvider
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+    AutoscalerV2,
+)
+
+
+class FakeGCP:
+    """An http-transport stand-in implementing just enough of the GKE +
+    Compute REST surface: node pools with instance groups whose size
+    follows setSize; deleteInstances removes named VMs. Every request is
+    recorded for assertions."""
+
+    def __init__(self, pools):
+        self.pools = {p: [] for p in pools}  # pool -> [vm names]
+        self._counter = 0
+        self.requests = []
+        self.alloc_delay = 0  # extra polls before a resize materializes
+        self._pending = []  # (pool, remaining_polls)
+
+    def __call__(self, method, url, body):
+        self.requests.append((method, url, body))
+        for pool in self.pools:
+            if f"/nodePools/{pool}" in url:
+                if url.endswith(":setSize"):
+                    want = body["nodeCount"]
+                    if want > len(self.pools[pool]):
+                        for _ in range(want - len(self.pools[pool])):
+                            self._pending.append([pool, self.alloc_delay])
+                    return {"name": "op-1"}
+                return {
+                    "name": pool,
+                    "initialNodeCount": len(self.pools[pool]),
+                    "instanceGroupUrls": [
+                        f"https://compute/zones/z/instanceGroups/{pool}-grp"
+                    ],
+                }
+            if f"instanceGroupManagers/{pool}-grp" in url:
+                if url.endswith("listManagedInstances"):
+                    self._tick(pool)
+                    return {
+                        "managedInstances": [
+                            {"instance": f"https://compute/zones/z/instances/{n}"}
+                            for n in self.pools[pool]
+                        ]
+                    }
+                if url.endswith("deleteInstances"):
+                    for inst_url in body["instances"]:
+                        name = inst_url.rsplit("/", 1)[-1]
+                        if name in self.pools[pool]:
+                            self.pools[pool].remove(name)
+                    return {"name": "op-2"}
+        raise AssertionError(f"unexpected request {method} {url}")
+
+    def _tick(self, pool):
+        for rec in self._pending:
+            if rec[0] == pool:
+                if rec[1] <= 0:
+                    self._counter += 1
+                    self.pools[pool].append(f"{pool}-vm-{self._counter:03d}")
+                rec[1] -= 1
+        self._pending = [r for r in self._pending if r[1] >= 0]
+
+
+def _client(fake):
+    return GKEClient("proj", "us-central2-b", "clus", http=fake, token_provider=lambda: "t")
+
+
+def test_gke_client_rest_shapes():
+    fake = FakeGCP(["v5e-pool"])
+    c = _client(fake)
+    c.set_node_pool_size("v5e-pool", 2)
+    assert fake.requests[-1][0] == "POST"
+    assert fake.requests[-1][1].endswith(
+        "projects/proj/zones/us-central2-b/clusters/clus/nodePools/v5e-pool:setSize"
+    )
+    assert fake.requests[-1][2] == {"nodeCount": 2}
+    names = c.list_pool_instances("v5e-pool")
+    assert len(names) == 2 and all(n.startswith("v5e-pool-vm-") for n in names)
+    c.delete_instance("v5e-pool", names[0])
+    assert len(c.list_pool_instances("v5e-pool")) == 1
+
+
+NODE_TYPES = {
+    "v5e-8": {
+        "pool": "v5e-pool",
+        "resources": {"TPU": 8.0, "CPU": 44.0},
+        "labels": {"accelerator": "v5e"},
+        "min_workers": 0,
+        "max_workers": 4,
+    }
+}
+
+
+def _feed_with_nodes(fake, pool, busy=False):
+    """Simulate the GKE contract: every VM in the pool has 'joined' ray
+    labeled with its VM name as provider_node_id."""
+    return {
+        "pending_demand": [],
+        "nodes": [
+            {
+                "node_id": f"ray-{n}",
+                "labels": {"provider_node_id": n},
+                "busy": busy,
+            }
+            for n in fake.pools[pool]
+        ],
+    }
+
+
+def test_gke_provider_scale_up_down_through_v2():
+    fake = FakeGCP(["v5e-pool"])
+    provider = GKETPUAsyncProvider(pools={"v5e-8": "v5e-pool"}, client=_client(fake))
+    feed = {"pending_demand": [{"TPU": 8.0}], "nodes": []}
+    scaler = AutoscalerV2(provider, NODE_TYPES, idle_timeout_s=0.0)
+    scaler._demand = lambda: feed
+
+    counts = scaler.update()  # demand -> QUEUED -> REQUESTED (resize +1)
+    assert counts.get(REQUESTED) == 1
+    assert any(u.endswith(":setSize") for _, u, _ in fake.requests)
+
+    counts = scaler.update()  # poll discovers the new VM
+    assert counts.get(ALLOCATED) == 1
+    inst = next(iter(scaler.im.instances.values()))
+    assert inst.provider_id and inst.provider_id.startswith("v5e-pool-vm-")
+
+    feed = _feed_with_nodes(fake, "v5e-pool", busy=True)
+    counts = scaler.update()  # the VM's ray node pairs via provider_node_id
+    assert counts.get(RAY_RUNNING) == 1
+    assert inst.status == RAY_RUNNING and inst.ray_node_id == f"ray-{inst.provider_id}"
+
+    # work done (idle) beyond the (zero) timeout -> precision deleteInstances
+    feed = _feed_with_nodes(fake, "v5e-pool", busy=False)
+    scaler.update()
+    counts = scaler.update()
+    assert counts.get(TERMINATED) == 1
+    assert fake.pools["v5e-pool"] == []
+    assert any(u.endswith("deleteInstances") for _, u, _ in fake.requests)
+
+
+def test_gke_concurrent_creates_claim_distinct_vms():
+    """Two creates in one tick, with ASYNC resizes (alloc_delay>0): the
+    second resize must target len+outstanding+1, or it is a no-op and one
+    instance polls REQUESTED forever."""
+    fake = FakeGCP(["v5e-pool"])
+    fake.alloc_delay = 2
+    provider = GKETPUAsyncProvider(pools={"v5e-8": "v5e-pool"}, client=_client(fake))
+    types = {"v5e-8": dict(NODE_TYPES["v5e-8"], min_workers=2)}
+    scaler = AutoscalerV2(provider, types)
+    scaler._demand = lambda: {"pending_demand": [], "nodes": []}
+    for _ in range(6):
+        scaler.update()
+    ids = {
+        i.provider_id
+        for i in scaler.im.instances.values()
+        if i.provider_id is not None
+    }
+    assert len(ids) == 2, f"instances did not claim two distinct VMs: {ids}"
+    sizes = [b["nodeCount"] for _, u, b in fake.requests if u.endswith(":setSize")]
+    assert sizes == [1, 2], sizes  # second resize accounts for the first
+
+
+def _yaml(tmp_path, provider="fake", extra=""):
+    cfg = textwrap.dedent(
+        f"""
+        cluster_name: t
+        provider:
+          type: {provider}
+          {"project: p" if provider == "gke_tpu" else ""}
+          {"zone: z" if provider == "gke_tpu" else ""}
+          {"cluster: c" if provider == "gke_tpu" else ""}
+        node_types:
+          v5e-8:
+            pool: v5e-pool
+            resources: {{TPU: 8, CPU: 44}}
+            min_workers: 1
+            max_workers: 2
+        idle_timeout_s: 60
+        update_interval_s: 0
+        {extra}
+        """
+    )
+    path = tmp_path / "cluster.yaml"
+    path.write_text(cfg)
+    return str(path)
+
+
+def test_yaml_schema_validation(tmp_path):
+    cfg = load_cluster_config(_yaml(tmp_path))
+    assert cfg["cluster_name"] == "t"
+    with pytest.raises(ValueError, match="provider.type"):
+        validate_cluster_config({"cluster_name": "x", "provider": {"type": "aws"},
+                                 "node_types": {"a": {"resources": {}}}})
+    with pytest.raises(ValueError, match="missing required"):
+        validate_cluster_config({"cluster_name": "x"})
+    with pytest.raises(ValueError, match="project"):
+        validate_cluster_config(
+            {"cluster_name": "x", "provider": {"type": "gke_tpu"},
+             "node_types": {"a": {"resources": {"CPU": 1}}}}
+        )
+    with pytest.raises(ValueError, match="min_workers > max_workers"):
+        validate_cluster_config(
+            {"cluster_name": "x", "provider": {"type": "fake"},
+             "node_types": {"a": {"resources": {"CPU": 1},
+                                  "min_workers": 3, "max_workers": 1}}}
+        )
+    with pytest.raises(ValueError, match="unknown"):
+        validate_cluster_config(
+            {"cluster_name": "x", "provider": {"type": "fake"}, "typo_key": 1,
+             "node_types": {"a": {"resources": {"CPU": 1}}}}
+        )
+
+
+def test_build_provider_gke_pools_map(tmp_path):
+    cfg = load_cluster_config(_yaml(tmp_path, provider="gke_tpu"))
+    fake = FakeGCP(["v5e-pool"])
+    provider = build_provider(cfg, client=_client(fake))
+    assert isinstance(provider, GKETPUAsyncProvider)
+    assert provider.pools == {"v5e-8": "v5e-pool"}
+
+
+def test_teardown_deletes_every_pool_vm(tmp_path):
+    cfg = load_cluster_config(_yaml(tmp_path, provider="gke_tpu"))
+    fake = FakeGCP(["v5e-pool"])
+    client = _client(fake)
+    client.set_node_pool_size("v5e-pool", 3)
+    client.list_pool_instances("v5e-pool")  # materialize
+    gone = teardown_cluster(cfg, client=client)
+    assert len(gone) == 3
+    assert fake.pools["v5e-pool"] == []
+
+
+def test_up_cli_fake_provider_end_to_end(tmp_path, capsys):
+    """`ray_tpu up --ticks N` with the fake provider: head comes up, the
+    autoscaler buys min_workers virtual nodes, they join and run."""
+    from ray_tpu.scripts import main
+
+    rc = main(["up", _yaml(tmp_path), "--ticks", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "head listening on" in out
+    assert "provider_node_id" in out  # the worker-join hint
+    counts = json.loads(out.rsplit("instances: ", 1)[1].splitlines()[0])
+    assert counts.get("RAY_RUNNING") == 1, counts
+
+
+def test_down_cli_fake_provider(tmp_path, capsys):
+    from ray_tpu.scripts import main
+
+    rc = main(["down", _yaml(tmp_path)])
+    assert rc == 0
+    assert "terminated 0 instance(s)" in capsys.readouterr().out
