@@ -52,6 +52,7 @@ def main():
     # core microbench first: it is CPU-only and must not run while this
     # process holds the single-tenant TPU tunnel (import jax acquires it)
     core = _core_microbench()
+    fit = _gptj_fit_proof()
 
     import jax
     import jax.numpy as jnp
@@ -137,6 +138,9 @@ def main():
         "loss": float(loss),
     }
     detail["core"] = core
+    if fit:
+        detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
+        detail["gptj_6b_fit"] = fit
     print(
         json.dumps(
             {
@@ -181,6 +185,46 @@ def _core_microbench() -> dict:
         return {}
     except Exception as e:
         print(f"[bench] core microbench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _gptj_fit_proof() -> dict:
+    """GPT-J-6B fsdp-8 AOT fit proof on a virtual CPU mesh (subprocess: it
+    must not inherit this process's TPU backend, and a failure must not
+    cost the headline number). See ray_tpu/parallel/fit_proof.py."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(
+            os.environ,
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.parallel.fit_proof"],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(out.stdout.splitlines()):
+            if line == "{" or line.startswith('{"'):
+                return json.loads(line)
+        print(
+            f"[bench] gptj fit proof produced no report (rc={out.returncode}): "
+            f"{out.stderr[-500:]}",
+            file=sys.stderr,
+        )
+        return {}
+    except Exception as e:
+        print(f"[bench] gptj fit proof failed: {e!r}", file=sys.stderr)
         return {}
 
 

@@ -135,9 +135,12 @@ class WorkerHandle:
         # (reference: lease-based pipelined submission,
         # max_tasks_in_flight_per_worker in the direct task submitter).
         self.queued_recs: deque = deque()
-        # scheduling signature the current pipeline accepts; None = worker
+        # (signature, func_id) the current pipeline accepts; None = worker
         # not leaseable (mixed queue, strategy task, or empty)
         self.lease_sig: Optional[tuple] = None
+        # in-flight blocking get/wait RPCs from this worker: a worker parked
+        # in ray.get must not receive lease followers (nested-submit deadlock)
+        self.blocked_gets = 0
         self.actor_id: Optional[bytes] = None
         self.idle_since = time.monotonic()
         self.created_at = time.monotonic()
@@ -526,8 +529,32 @@ class Head:
         self._flush_event = threading.Event()
         # selector-served worker connections: conn -> (WorkerHandle, remote)
         self._io_conns: dict = {}
-        self._io_wake = threading.Event()
         self._io_thread: Optional[threading.Thread] = None
+        # worker-conn pump ownership (see _pump_or_wait): a blocked getter
+        # may take over the IO thread's job so a completion wakes the getter
+        # DIRECTLY instead of via IO-thread-handles-then-notifies — one
+        # fewer thread handoff on the sync task round trip
+        self._pump_mutex = threading.Lock()
+        self._pump_count_lock = threading.Lock()
+        self._pump_requests = 0
+        self._last_pump = 0.0  # sticky grace: IO thread defers while fresh
+        self._io_resume = threading.Event()
+        self._io_wake_r, self._io_wake_w = os.pipe()
+        os.set_blocking(self._io_wake_w, False)
+        # progress signal TO pumpers: whoever processed worker messages
+        # while getters were waiting writes here, so a pumper whose object
+        # became ready in the handoff window doesn't sit out its select
+        # timeout against conns that will stay silent
+        self._io_prog_r, self._io_prog_w = os.pipe()
+        os.set_blocking(self._io_prog_w, False)
+        # persistent selector for pumpers (guarded by _pump_mutex):
+        # multiprocessing.connection.wait builds+tears down a poll object
+        # per call — real money at 1 call per sync task
+        import selectors as _selectors
+
+        self._pump_sel = _selectors.DefaultSelector()
+        self._pump_sel.register(self._io_prog_r, _selectors.EVENT_READ)
+        self._pump_registered: set = set()
         self.pending_sched = _PendingQueue()  # dep-free tasks awaiting node pick
         # bumped whenever placement capacity can have INCREASED (release,
         # node add, pg placement): lets _schedule skip signatures that
@@ -703,7 +730,10 @@ class Head:
 
     def _adopt_worker_conn(self, conn, wh: WorkerHandle, remote: bool) -> None:
         self._io_conns[conn] = (wh, remote)
-        self._io_wake.set()
+        try:
+            os.write(self._io_wake_w, b"c")  # pick up the new conn now
+        except OSError:
+            pass
         with self.lock:
             if self._io_thread is None:
                 self._io_thread = threading.Thread(
@@ -712,26 +742,60 @@ class Head:
                 self._io_thread.start()
                 self._threads.append(self._io_thread)
 
-    def _worker_io_loop(self) -> None:
-        """One selector thread serves EVERY worker connection."""
-        from multiprocessing.connection import wait as _mpwait
-
-        while not self._shutdown:
-            conns = list(self._io_conns)
-            if not conns:
-                self._io_wake.wait(timeout=0.1)
-                self._io_wake.clear()
-                continue
+    def _drain_io(
+        self, sel, registered: set, special_fd: int, timeout: float, budget: int = 64
+    ) -> bool:
+        """Shared selector-drain for the IO thread and pumping getters
+        (caller must hold ``_pump_mutex``): sync ``registered`` with the
+        live conn set on ``sel``, then drain ready messages — one recv per
+        ready conn per select round, re-selecting at timeout 0 until quiet
+        or ``budget`` messages (one chatty worker can't starve the rest). A
+        readable ``special_fd`` (wake/progress pipe) is drained and ends
+        the drain after the current event batch — the caller has a decision
+        to make. Returns True when any worker message was handled."""
+        current = self._io_conns
+        if registered != current.keys():
+            live = set(current)
+            for c in registered - live:
+                try:
+                    sel.unregister(c)
+                except (KeyError, ValueError, OSError):
+                    pass
+            for c in live - registered:
+                try:
+                    sel.register(c, 1)  # EVENT_READ
+                except (ValueError, OSError):
+                    self._reap_io_conn(c)
+                    live.discard(c)
+            registered.clear()
+            registered.update(live)
+        progressed = False
+        while budget > 0:
             try:
-                ready = _mpwait(conns, timeout=0.1)
+                events = sel.select(timeout=timeout)
             except OSError:
-                ready = []
                 # a conn died mid-wait: find and reap it
-                for c in conns:
+                for c in list(registered):
                     if c.closed or c.fileno() < 0:
+                        try:
+                            sel.unregister(c)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                        registered.discard(c)
                         self._reap_io_conn(c)
-            progressed = False
-            for conn in ready:
+                break
+            if not events:
+                break
+            timeout = 0
+            for key, _mask in events:
+                conn = key.fileobj
+                if conn == special_fd:
+                    try:
+                        os.read(special_fd, 4096)
+                    except OSError:
+                        pass
+                    budget = 0
+                    continue
                 ent = self._io_conns.get(conn)
                 if ent is None:
                     continue
@@ -739,12 +803,54 @@ class Head:
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError):
+                    try:
+                        sel.unregister(conn)
+                    except (KeyError, ValueError, OSError):
+                        pass
+                    registered.discard(conn)
                     self._reap_io_conn(conn)
                     continue
                 progressed = True
+                budget -= 1
                 self._handle_worker_msg(conn, wh, remote, msg)
-            if progressed:
-                self.flush_outbox()
+        return progressed
+
+    def _worker_io_loop(self) -> None:
+        """One selector thread serves EVERY worker connection.
+
+        The selector is PERSISTENT (epoll): `multiprocessing.connection.wait`
+        builds, registers, and tears down a fresh poll object per call —
+        measurable per-message overhead once every completion wakes it. The
+        conn set is re-synced only when `_io_conns` changes, and each ready
+        conn is drained (bounded) before re-polling so a burst of
+        completions costs one selector wakeup, not one per message."""
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        sel.register(self._io_wake_r, selectors.EVENT_READ)
+        registered: set = set()
+        while not self._shutdown:
+            if self._pump_requests or (time.monotonic() - self._last_pump) < 0.003:
+                # a getter owns the pump (it is doing this loop's job) or
+                # pumped within the last few ms (a sync get loop: the next
+                # pump is imminent) — park instead of ping-ponging the
+                # mutex, which costs two context switches per task
+                self._io_resume.wait(timeout=0.01)
+                self._io_resume.clear()
+                continue
+            if not self._pump_mutex.acquire(timeout=0.1):
+                continue
+            try:
+                progressed = self._drain_io(sel, registered, self._io_wake_r, 0.1)
+                if progressed:
+                    self.flush_outbox()
+                    if self._pump_requests:
+                        try:
+                            os.write(self._io_prog_w, b"g")
+                        except OSError:
+                            pass
+            finally:
+                self._pump_mutex.release()
 
     def _reap_io_conn(self, conn) -> None:
         ent = self._io_conns.pop(conn, None)
@@ -839,6 +945,20 @@ class Head:
             # instead of spawning one per call (reference: the event-loop
             # pipelining in grpc_server.h — many-task workloads would
             # otherwise hit thread-spawn overhead and exhaustion)
+            if worker is not None and method in ("get", "wait"):
+                # the submitter is about to park in ray.get/wait: it must
+                # not be handed lease followers meanwhile (_try_lease_dispatch)
+                with self.lock:
+                    worker.blocked_gets += 1
+                wh0 = worker
+
+                def handler(h=handler, wh0=wh0, **kw):  # noqa: B008
+                    try:
+                        return h(**kw)
+                    finally:
+                        with self.lock:
+                            wh0.blocked_gets = max(0, wh0.blocked_gets - 1)
+
             self._blocking_pool.submit(
                 self._run_request, conn, worker, seq, handler, payload
             )
@@ -1110,7 +1230,13 @@ class Head:
         wh.queued_recs.append(rec)
         wh.current_task = wh.queued_recs[0]
         leaseable = not spec.get("strategy") and spec["kind"] == "task"
-        sig = _PendingQueue.sig_of(rec) if leaseable else None
+        # the lease key includes func_id on top of the scheduling signature:
+        # queueing a DIFFERENT function behind a running task deadlocks when
+        # the running task is its submitter blocked in ray.get on it (the
+        # nested fan-out pattern: parent and leaf share {CPU: 1})
+        sig = (
+            (_PendingQueue.sig_of(rec), spec.get("func_id")) if leaseable else None
+        )
         if len(wh.queued_recs) == 1:
             wh.lease_sig = sig
         elif wh.lease_sig != sig:
@@ -1149,18 +1275,47 @@ class Head:
         driver direct calls, the health loop). Exactly ONE thread drains at
         a time — per-worker message order is the dispatch order workers'
         FIFO execution depends on; the outer re-check catches items
-        appended while the active drainer was releasing."""
+        appended while the active drainer was releasing.
+
+        Consecutive run_task dispatches to the SAME worker coalesce into one
+        run_task_batch message (one pickle + one socket write for a burst of
+        pipelined leases), preserving each worker's FIFO order."""
         while self._outbox:
             if not self._flush_lock.acquire(blocking=False):
                 return  # active drainer will pick ours up (or we re-enter)
             try:
+                pending_wh = None
+                pending_specs: list = []
+
+                def _flush_pending():
+                    nonlocal pending_wh, pending_specs
+                    if pending_wh is None:
+                        return
+                    wh0, specs = pending_wh, pending_specs
+                    pending_wh, pending_specs = None, []
+                    out = ("run_task", specs[0]) if len(specs) == 1 else (
+                        "run_task_batch", specs
+                    )
+                    if wh0.alive and not wh0.send(out):
+                        self._on_worker_dead(wh0)
+
                 while True:
                     try:
                         wh, msg = self._outbox.popleft()
                     except IndexError:
                         break
+                    if msg[0] == "run_task":
+                        if wh is pending_wh:
+                            pending_specs.append(msg[1])
+                            continue
+                        _flush_pending()
+                        pending_wh, pending_specs = wh, [msg[1]]
+                        continue
+                    if wh is pending_wh:
+                        _flush_pending()  # non-dispatch msg: keep FIFO order
                     if wh.alive and not wh.send(msg):
                         self._on_worker_dead(wh)
+                _flush_pending()
             finally:
                 self._flush_lock.release()
 
@@ -1177,7 +1332,7 @@ class Head:
         spec = rec["spec"]
         if spec.get("strategy") or spec["kind"] != "task":
             return False
-        sig = _PendingQueue.sig_of(rec)
+        sig = (_PendingQueue.sig_of(rec), spec.get("func_id"))
         for nid in self.node_order:
             node = self.nodes[nid]
             if not node.alive:
@@ -1188,6 +1343,7 @@ class Head:
                     and wh.conn is not None
                     and wh.actor_id is None
                     and wh.lease_sig == sig
+                    and wh.blocked_gets == 0
                     and len(wh.queued_recs) < depth
                 ):
                     rec["node"] = node.node_id
@@ -1275,6 +1431,16 @@ class Head:
             "retries_left": spec.get("max_retries", GLOBAL_CONFIG.default_max_retries),
         }
         with self.lock:
+            # the submitter's refs on the return objects are taken HERE, not
+            # by per-id add_ref RPCs before the submit: for a worker
+            # submitting nested tasks that is one control round trip instead
+            # of 1 + num_returns (reference: task returns are born owned by
+            # the submitter, reference_count.h)
+            for rid in spec["return_ids"]:
+                ent = self.objects.get(rid)
+                if ent is None:
+                    ent = self.objects[rid] = ObjectEntry()
+                ent.refcount += 1
             strategy = spec.get("strategy")
             if strategy and strategy[0] == "pg":
                 # Fail fast if the task can never fit its designated bundle
@@ -1338,6 +1504,8 @@ class Head:
         feasible node (spread). Honors strategies: SPREAD, node affinity,
         placement-group bundles. One pass visits each distinct scheduling
         signature once (see _PendingQueue) — O(signatures), not O(tasks)."""
+        if not self.pending_sched:
+            return  # hot path: every completion triggers a pass
 
         def try_place(rec: dict) -> bool:
             if rec["task_id"] in self.cancelled:
@@ -2174,6 +2342,12 @@ class Head:
             actor = self.actors.get(actor_id)
             if actor is None:
                 return
+            if actor.state == ACTOR_DEAD:
+                # killed while this spawn was in flight: NEVER resurrect —
+                # the fallback re-reserve below would allocate resources no
+                # kill will ever release. Tell the orphan worker to exit.
+                self._enqueue_send(wh, ("exit",))
+                return
             if payload.get("error") is not None:
                 # __init__ raised: actor is DEAD, creation error propagates to
                 # the creation "ready" object and all queued calls.
@@ -2204,6 +2378,11 @@ class Head:
 
     def submit_actor_task(self, spec: dict) -> None:
         with self.lock:
+            for rid in spec["return_ids"]:  # submitter's refs (see submit_task)
+                ent = self.objects.get(rid)
+                if ent is None:
+                    ent = self.objects[rid] = ObjectEntry()
+                ent.refcount += 1
             actor = self.actors.get(spec["actor_id"])
             if actor is None or actor.state == ACTOR_DEAD:
                 cause = actor.death_cause if actor else "actor not found"
@@ -2234,7 +2413,13 @@ class Head:
             rec["state"] = "RUNNING"
             rec["worker"] = actor.worker
         if not actor.worker.send(("run_task", spec)):
-            self._on_actor_worker_death(actor.actor_id)
+            # route through the DEDUPLICATING death path (wh.alive guard) —
+            # calling _on_actor_worker_death directly left the handle alive,
+            # and the conn reap then ran the death machinery a SECOND time:
+            # an extra restart charge, a kill of the restarting actor, and a
+            # leaked allocation when its in-flight respawn came up
+            self._handle_worker_death_locked(actor.worker)
+            self._schedule()
 
     def _on_actor_worker_death(self, actor_id: bytes):
         """Lock held. Actor restart state machine (reference
@@ -2374,12 +2559,64 @@ class Head:
         with self.lock:
             self._store_locator(obj_id, locator)
 
+    def _pump_or_wait(self, t: float) -> None:
+        """A getter with nothing to do yet either takes over the worker-IO
+        pump (processing completions on ITS thread — the message that makes
+        its object ready wakes no one else first) or, when another thread
+        already pumps, parks on the condition variable. Single pump at a
+        time via _pump_mutex; the IO thread defers while _pump_requests>0.
+        Never called with the head lock held."""
+        with self._pump_count_lock:
+            self._pump_requests += 1
+            self._last_pump = time.monotonic()
+        try:
+            # fast path: mutex free (IO thread parked in its sticky-grace
+            # window) — no kick, no handoff, straight to the select
+            acquired = self._pump_mutex.acquire(blocking=False)
+            if not acquired:
+                try:
+                    os.write(self._io_wake_w, b"p")  # kick IO out of its select
+                except OSError:
+                    pass
+                acquired = self._pump_mutex.acquire(timeout=min(t, 0.005))
+            if not acquired:
+                with self.lock:
+                    self.cv.wait(timeout=t)
+                return
+            try:
+                if self._shutdown:
+                    return
+                if not self._io_conns:
+                    with self.lock:
+                        self.cv.wait(timeout=min(t, 0.01))
+                    return
+                progressed = self._drain_io(
+                    self._pump_sel, self._pump_registered, self._io_prog_r, t
+                )
+                if progressed:
+                    self.flush_outbox()
+                    if self._pump_requests > 1:
+                        # other getters wait behind the mutex/cv: what we
+                        # just handled may be THEIR completion
+                        try:
+                            os.write(self._io_prog_w, b"g")
+                        except OSError:
+                            pass
+            finally:
+                self._pump_mutex.release()
+        finally:
+            with self._pump_count_lock:
+                self._pump_requests -= 1
+            self._io_resume.set()
+
     def get_locators(self, obj_ids: list[bytes], timeout: Optional[float]) -> list:
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
-        with self.lock:
-            for oid in obj_ids:
-                while True:
+        i = 0
+        while True:
+            with self.lock:
+                while i < len(obj_ids):
+                    oid = obj_ids[i]
                     ent = self.objects.get(oid)
                     if ent is not None and ent.ready:
                         if ent.small is None and ent.shm is None:
@@ -2389,26 +2626,29 @@ class Head:
                             # keep waiting for the recomputed value instead
                             ent.last_access = ent.last_read = time.monotonic()
                             out.append(ent.locator())
-                            break
-                    remaining = None if deadline is None else deadline - time.monotonic()
-                    if remaining is not None and remaining <= 0:
-                        raise rex.GetTimeoutError(f"Get timed out on {ObjectID(oid)}")
-                    if self._shutdown:
-                        raise rex.RayError("shutting down")
-                    self.cv.wait(timeout=min(remaining, 1.0) if remaining else 1.0)
-        return out
+                            i += 1
+                            continue
+                    break
+                if i >= len(obj_ids):
+                    return out
+                if self._shutdown:
+                    raise rex.RayError("shutting down")
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise rex.GetTimeoutError(f"Get timed out on {ObjectID(obj_ids[i])}")
+            self._pump_or_wait(min(remaining, 0.05) if remaining else 0.05)
 
     def wait_objects(self, obj_ids: list[bytes], num_returns: int, timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self.lock:
-            while True:
+        while True:
+            with self.lock:
                 ready = [oid for oid in obj_ids if (e := self.objects.get(oid)) and e.ready]
                 if len(ready) >= num_returns:
                     return ready
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    return ready
-                self.cv.wait(timeout=min(remaining, 1.0) if remaining else 1.0)
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return ready
+            self._pump_or_wait(min(remaining, 0.05) if remaining else 0.05)
 
     def add_ref(self, obj_id: bytes):
         with self.lock:
@@ -3481,6 +3721,11 @@ class Head:
         self._pub_queue.put(None)
         self._spawn_q.put(None)
         self._blocking_pool.shutdown()
+        try:
+            os.write(self._io_wake_w, b"x")  # unblock the IO selector
+        except OSError:
+            pass
+        self._io_resume.set()
         self._snapshot()
         self.shm_owner.shutdown()
         if self.arena_name:
@@ -3491,6 +3736,17 @@ class Head:
             os.unlink(self.socket_path)
         except OSError:
             pass
+        # release the pump plumbing (pipes are raw fds: without this every
+        # Head — one per test — leaks 4 fds + an epoll fd)
+        try:
+            self._pump_sel.close()
+        except OSError:
+            pass
+        for fd in (self._io_wake_r, self._io_wake_w, self._io_prog_r, self._io_prog_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     # --------------------------------------------------------- observability
 

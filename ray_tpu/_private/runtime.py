@@ -226,6 +226,9 @@ class BaseContext:
         self._uploaded_funcs: set[bytes] = set()
         self._readers: dict[bytes, ShmReader] = {}
         self._readers_lock = threading.Lock()
+        # task-id source (see new_task_returns): nonce drawn once per context
+        self._task_nonce = os.urandom(6)
+        self._task_seq = itertools.count(1)
         self.current_actor = None  # set in actor workers
         self.node_id_bin: Optional[bytes] = None
         self.task_depth = 0
@@ -511,27 +514,30 @@ class BaseContext:
         return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
 
     def submit_task(self, spec: dict) -> list[ObjectRef]:
+        # the head takes the submitter's refs on the return ids inside
+        # submit_task itself — one round trip, not 1 + num_returns
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
-        for rid in spec["return_ids"]:
-            self.call("add_ref", obj_id=rid)
         self.call("submit_task", spec=spec)
         return refs
 
     def submit_actor_task(self, spec: dict) -> list[ObjectRef]:
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
-        for rid in spec["return_ids"]:
-            self.call("add_ref", obj_id=rid)
         self.call("submit_actor_task", spec=spec)
         return refs
 
     def new_task_returns(self, num_returns: int):
         # Task ids end in 4 zero bytes so a return ObjectID's 12-byte prefix
         # uniquely reconstructs its task id (used by ray_tpu.cancel()).
-        import os as _os
-
-        task_id = TaskID(_os.urandom(12) + b"\x00" * 4)
-        return task_id.binary(), [
-            ObjectID.for_task_return(task_id, i).binary() for i in range(num_returns)
+        # 6-byte per-process nonce + 6-byte counter instead of a per-task
+        # urandom syscall: uniqueness across submitters comes from the nonce
+        # (48 bits — birthday-safe for any realistic process count), and the
+        # counter never wraps in practice (2^48 submissions).
+        prefix = self._task_nonce + next(self._task_seq).to_bytes(6, "big")
+        # raw bytes on purpose: this runs once per .remote() and the
+        # TaskID/ObjectID wrappers would be built only to call .binary()
+        # (layout must match ObjectID.for_task_return: prefix + LE index)
+        return prefix + b"\x00\x00\x00\x00", [
+            prefix + i.to_bytes(4, "little") for i in range(num_returns)
         ]
 
     def shutdown(self):
@@ -580,6 +586,11 @@ class DriverContext(BaseContext):
             return self.head.get_locators(payload["obj_ids"], payload.get("timeout"))
         if method == "wait":
             return self.head.wait_objects(payload["obj_ids"], payload["num_returns"], payload.get("timeout"))
+        if method == "submit_task":  # hot path: skip the getattr dispatch
+            try:
+                return self.head.submit_task(payload["spec"])
+            finally:
+                self.head.flush_outbox()
         try:
             return getattr(self.head, "rpc_" + method)(**payload)
         finally:

@@ -224,6 +224,11 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
             ctx.on_pub(msg[1], msg[2])
         elif kind == "run_task":
             state.task_queue.put(msg[1])
+        elif kind == "run_task_batch":
+            # head coalesced consecutive dispatches (flush_outbox); FIFO
+            # order within the batch is the dispatch order
+            for spec in msg[1]:
+                state.task_queue.put(spec)
         elif kind == "cancel":
             _handle_cancel(state, msg[1])
         elif kind == "stream_ack":

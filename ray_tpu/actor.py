@@ -230,8 +230,9 @@ class ActorClass:
             from ray_tpu._private import runtime_env as renv
 
             spec["runtime_env"] = renv.package(options["runtime_env"], ctx, kind="actor")
-        for rid in return_ids:
-            ctx.call("add_ref", obj_id=rid)
+        # head.submit_task takes the submitter's refs on return_ids; the
+        # except-free below is a no-op when the failure preceded the submit
+        # (remove_ref on a missing entry does nothing)
         try:
             ctx.call("create_actor", spec=spec)
         except Exception:

@@ -148,21 +148,25 @@ class GKEClient:
         return names
 
     def delete_instance(self, pool: str, name: str) -> None:
-        """Precision scale-down: remove ONE named VM and shrink the group."""
-        for group_url in self._group_urls(pool):
+        """Precision scale-down: remove ONE named VM and shrink its group.
+        Multi-location pools have one managed group per zone — the delete
+        must target the group that actually CONTAINS the VM."""
+        groups = self._group_urls(pool)
+        if not groups:
+            raise RuntimeError(f"node pool for instance {name!r} has no instance group")
+        for group_url in groups:
             mgr = group_url.replace("instanceGroups", "instanceGroupManagers")
-            self._http(
-                "POST",
-                mgr + "/deleteInstances",
-                {
-                    "instances": [
-                        f"{self.COMPUTE}/projects/{self.project}/zones/"
-                        f"{self.zone}/instances/{name}"
-                    ]
-                },
-            )
-            return
-        raise RuntimeError(f"node pool for instance {name!r} has no instance group")
+            listed = self._http("POST", mgr + "/listManagedInstances", None)
+            members = {
+                mi["instance"].rsplit("/", 1)[-1]: mi["instance"]
+                for mi in listed.get("managedInstances", [])
+            }
+            if name in members:
+                self._http(
+                    "POST", mgr + "/deleteInstances", {"instances": [members[name]]}
+                )
+                return
+        raise RuntimeError(f"instance {name!r} not found in node pool {pool!r}")
 
 
 class GKETPUAsyncProvider(AsyncNodeProvider):

@@ -1,0 +1,147 @@
+"""GPT-J-6B fit proof: AOT-compile the 6B train step under fsdp and read
+XLA's static memory analysis — evidence the north-star model fits a v5e-8
+HBM budget without owning 8 chips.
+
+BASELINE.md's reference headline is the GPT-J-6B fine-tune
+(``release/air_examples/gptj_deepspeed_finetuning``). This module compiles
+the same-shape decoder (vocab 50432, d_model 4096, 28 layers, 16 heads,
+seq 2048) through ``build_train_step`` on an 8-device mesh with ZeRO-3
+fsdp sharding, using ONLY abstract values (``jax.eval_shape`` +
+``ShapeDtypeStruct`` with shardings) — no 6B parameters are ever
+materialized, so this runs on a CPU host under
+``--xla_force_host_platform_device_count=8``.
+
+``memory_analysis()`` is the per-device XLA estimate: arguments (params +
+opt state resident in HBM) + temporaries (activations, collective
+buffers) + outputs − donated aliases. v5e HBM is 16 GiB/chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+
+def fit_report(cfg, n_devices: int = 8, batch: int = 8) -> dict:
+    """AOT-compile ``cfg``'s train step under fsdp-``n_devices`` from
+    abstract values only; return XLA's per-device memory analysis."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.models.gpt import gpt_init, gpt_loss
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.parallel.sharding import batch_spec, param_sharding_rules
+    from ray_tpu.parallel.train_step import TrainState, _opt_shardings, build_train_step
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=n_devices, tp=1, sp=1))
+    optimizer = optax.adamw(1e-4)
+
+    def loss_fn(params, tokens):
+        return gpt_loss(cfg, params, tokens, mesh)
+
+    _, step_fn = build_train_step(loss_fn, optimizer, mesh)
+
+    # abstract state with the REAL shardings attached — eval_shape never
+    # allocates the 24 GB of fp32 master weights
+    params_abs = jax.eval_shape(
+        functools.partial(gpt_init, cfg=cfg), jax.random.PRNGKey(0)
+    )
+    p_specs = param_sharding_rules(params_abs)
+    params_sds = jax.tree_util.tree_map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        params_abs,
+        p_specs,
+    )
+    opt_abs = jax.eval_shape(optimizer.init, params_abs)
+    opt_sh = _opt_shardings(optimizer, params_abs, p_specs, mesh)
+    opt_sds = jax.tree_util.tree_map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s), opt_abs, opt_sh
+    )
+    state_abs = TrainState(
+        params_sds,
+        opt_sds,
+        jax.ShapeDtypeStruct((), jnp.int32, sharding=NamedSharding(mesh, P())),
+    )
+    tokens_abs = jax.ShapeDtypeStruct(
+        (batch, cfg.seq_len + 1),
+        jnp.int32,
+        sharding=NamedSharding(mesh, batch_spec()),
+    )
+
+    compiled = step_fn.lower(state_abs, tokens_abs).compile()
+    import math
+
+    n_params = sum(math.prod(l.shape) for l in jax.tree_util.tree_leaves(params_abs))
+    out = {
+        "model_params": n_params,
+        "n_devices": n_devices,
+        "batch": batch,
+        "seq_len": cfg.seq_len,
+        "remat_policy": cfg.remat_policy,
+        "compiles": True,
+    }
+    ma = compiled.memory_analysis()
+    per_chip: Optional[int] = None
+    if ma is not None:
+        try:
+            args = int(ma.argument_size_in_bytes)
+            temps = int(ma.temp_size_in_bytes)
+            outs = int(ma.output_size_in_bytes)
+            alias = int(ma.alias_size_in_bytes)
+            # donated state aliases outputs: resident = args + temps + the
+            # non-aliased output tail
+            per_chip = args + temps + max(0, outs - alias)
+            out.update(
+                {
+                    "argument_bytes": args,
+                    "temp_bytes": temps,
+                    "output_bytes": outs,
+                    "alias_bytes": alias,
+                }
+            )
+        except AttributeError:
+            per_chip = None
+    if per_chip is not None:
+        out["per_chip_bytes"] = per_chip
+        out["per_chip_gib"] = round(per_chip / (1 << 30), 2)
+        out["fits_v5e_16gib"] = per_chip < 16 * (1 << 30)
+    return out
+
+
+def gptj_6b_fit_report(
+    n_devices: int = 8,
+    batch: int = 8,
+    remat_policy: str = "full",
+    seq_len: int = 2048,
+) -> dict:
+    from ray_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(
+        vocab_size=50_432,  # GPT-J's 50400 padded to the lane multiple
+        seq_len=seq_len,
+        d_model=4096,
+        n_layers=28,
+        n_heads=16,
+        remat_policy=remat_policy,
+    )
+    return fit_report(cfg, n_devices=n_devices, batch=batch)
+
+
+def main() -> None:  # pragma: no cover - exercised via bench.py subprocess
+    import json
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    print(json.dumps(gptj_6b_fit_report()))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -15,6 +15,7 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.multi_agent import (  # noqa: F401
     MultiAgentEnv,

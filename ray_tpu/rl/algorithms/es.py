@@ -1,0 +1,138 @@
+"""ES — evolution strategies (ARS variant): gradient-free policy search.
+
+Reference: ``rllib/algorithms/es/`` (Salimans et al. 2017 OpenAI-ES) and
+``rllib/algorithms/ars/`` (Mania et al. 2018 Augmented Random Search).
+Implemented as the ARS formulation — antithetic (+/-sigma) perturbation
+pairs, top-fraction direction selection, reward-std step normalization —
+which subsumes plain ES at ``top_frac=1.0``.
+
+TPU-first notes: there is no backward pass at all — the entire "training"
+is episode evaluations, so the work distributes as perturbed-weight
+rollouts fanned over env-runner ACTORS via the task system (each direction
+is two independent ``eval_return`` calls; the only synchronization is the
+rank-and-update reduction at the end of the iteration, on the driver).
+Policy noise is reproducible from (iteration, direction) seeds, so only
+seeds would need to travel in a multi-host variant — here full perturbed
+pytrees ship because MLP policies are tiny.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, register_algorithm
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        #: antithetic direction pairs per iteration (2x this many evals)
+        self.num_rollouts = 8
+        #: perturbation scale in parameter space
+        self.sigma = 0.05
+        #: step size
+        self.lr = 0.02
+        #: fraction of directions (ranked by max(R+, R-)) used in the update
+        self.top_frac = 0.5
+        #: complete episodes averaged per perturbation evaluation
+        self.episodes_per_eval = 1
+        #: env-step bound per evaluation (non-terminating policy guard)
+        self.eval_max_steps = 2000
+
+    algo_class = None  # set below
+
+
+class ES(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> "ESConfig":
+        return ESConfig()
+
+    def _setup(self):
+        from jax.flatten_util import ravel_pytree
+
+        # the search space is the runner module's full parameter pytree
+        # flattened (the unused value head rides along — its perturbations
+        # never influence action selection, so they are return-neutral)
+        params = self.foreach_runner("get_weights")[0]
+        self._theta, self._unravel = ravel_pytree(params)
+        self._theta = np.asarray(self._theta, np.float64)
+        self._np_rng = np.random.default_rng(self.config.seed or 0)
+
+    def get_weights(self):
+        return self._unravel(self._theta.astype(np.float32))
+
+    def set_weights(self, params) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        self._theta = np.asarray(ravel_pytree(params)[0], np.float64)
+        self.sync_weights(self.get_weights())
+
+    def _eval(self, flat: np.ndarray, runner_idx: int, futures: list) -> None:
+        cfg: ESConfig = self.config
+        params = self._unravel(flat.astype(np.float32))
+        if self._local_runner is not None:
+            futures.append(
+                self._local_runner.eval_return(
+                    params, cfg.episodes_per_eval, cfg.eval_max_steps
+                )
+            )
+        else:
+            actor = self._runner_actors[runner_idx % len(self._runner_actors)]
+            futures.append(
+                actor.eval_return.remote(
+                    params, cfg.episodes_per_eval, cfg.eval_max_steps
+                )
+            )
+
+    def training_step(self) -> dict:
+        cfg: ESConfig = self.config
+        dim = self._theta.size
+        deltas = self._np_rng.standard_normal((cfg.num_rollouts, dim))
+        futures: list = []
+        for i, delta in enumerate(deltas):
+            self._eval(self._theta + cfg.sigma * delta, 2 * i, futures)
+            self._eval(self._theta - cfg.sigma * delta, 2 * i + 1, futures)
+        if self._local_runner is None:
+            results = ray_tpu.get(futures, timeout=600)
+        else:
+            results = futures
+        r_pos = np.array([results[2 * i]["return_mean"] for i in range(cfg.num_rollouts)])
+        r_neg = np.array([results[2 * i + 1]["return_mean"] for i in range(cfg.num_rollouts)])
+        steps = int(sum(r["steps"] for r in results))
+        self._timesteps_total += steps
+
+        # ARS update: rank directions by their best side, keep the top
+        # fraction, normalize the step by the kept returns' std
+        k = max(1, int(round(cfg.top_frac * cfg.num_rollouts)))
+        order = np.argsort(np.maximum(r_pos, r_neg))[::-1][:k]
+        kept = np.concatenate([r_pos[order], r_neg[order]])
+        r_std = float(kept.std()) or 1.0
+        grad = ((r_pos[order] - r_neg[order])[:, None] * deltas[order]).sum(0)
+        self._theta = self._theta + cfg.lr / (k * r_std) * grad
+
+        # central-policy evaluation doubles as the weight sync (runners end
+        # the iteration holding the updated central weights)
+        central: list = []
+        for idx in range(max(1, len(self._runner_actors))):
+            self._eval(self._theta, idx, central)
+        if self._local_runner is None:
+            evals = ray_tpu.get(central, timeout=600)
+        else:
+            evals = central
+        rets = [e["return_mean"] for e in evals if e["episodes"]]
+        if rets:
+            self._episode_return_mean = float(np.mean(rets))
+        self._timesteps_total += int(sum(e["steps"] for e in evals))
+        return {
+            "es_reward_pos_mean": float(r_pos.mean()),
+            "es_reward_neg_mean": float(r_neg.mean()),
+            "es_reward_std": r_std,
+            "es_update_norm": float(np.linalg.norm(cfg.lr / (k * r_std) * grad)),
+            "episode_return_central": self._episode_return_mean,
+        }
+
+
+ESConfig.algo_class = ES
+register_algorithm("ES", ES)
+register_algorithm("ARS", ES)  # same machinery; ARS is the formulation used

@@ -330,5 +330,34 @@ class EnvRunner:
             "episode_len_mean": float(np.mean(lens)),
         }
 
+    def eval_return(
+        self, params=None, episodes: int = 1, max_steps: int = 5000
+    ) -> dict:
+        """Roll COMPLETE episodes with the (optionally supplied) weights and
+        report their mean return — the evaluation primitive evolution
+        strategies are built on (reference: ``rllib/algorithms/es/``
+        ``Worker.do_rollouts``). Consumes and clears the episode-stat
+        buffer; ``max_steps`` bounds runaway non-terminating policies."""
+        if params is not None:
+            self.set_weights(params)
+        # fresh episodes ONLY: without a reset, the first "episode" counted
+        # here started under the PREVIOUS weights (back-to-back perturbation
+        # evals on one runner would cross-contaminate the ES ranking)
+        self._obs = self._obs_transform(self.vec.reset())
+        self._ep_ret[:] = 0
+        self._ep_len[:] = 0
+        self.episode_stats(clear=True)
+        chunk = max(1, min(self.fragment, 100))
+        steps = 0
+        while steps < max_steps and len(self._completed) < episodes:
+            self._rollout(chunk)
+            steps += chunk * self.vec.n
+        s = self.episode_stats(clear=True)
+        return {
+            "episodes": s["episodes"],
+            "return_mean": s["episode_return_mean"] if s["episodes"] else 0.0,
+            "steps": steps,
+        }
+
     def ping(self) -> bool:
         return True

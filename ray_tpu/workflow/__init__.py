@@ -25,6 +25,11 @@ from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+from ray_tpu.workflow.virtual_actor import (  # noqa: F401
+    get_actor,
+    readonly,
+    virtual_actor,
+)
 
 _DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
 
@@ -64,6 +69,8 @@ class _Store:
             return {}
 
     def step_path(self, step_id: str) -> str:
+        # continuation steps namespace under their parent ("003_x/001_y"):
+        # sub-workflow checkpoints live in a per-step subtree
         return os.path.join(self.dir, "steps", step_id + ".pkl")
 
     def has_step(self, step_id: str) -> bool:
@@ -71,10 +78,12 @@ class _Store:
 
     def save_step(self, step_id: str, value: Any):
         self._ensure()
-        tmp = self.step_path(step_id) + ".tmp"
+        path = self.step_path(step_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(value, f)
-        os.replace(tmp, self.step_path(step_id))  # atomic commit
+        os.replace(tmp, path)  # atomic commit
 
     def load_step(self, step_id: str) -> Any:
         with open(self.step_path(step_id), "rb") as f:
@@ -113,6 +122,96 @@ class _Store:
         except OSError:
             pass
         return out
+
+
+class _PrefixStore:
+    """Store view for a continuation: step ids namespace under the parent
+    step, events share the root workflow's log."""
+
+    def __init__(self, store, prefix: str):
+        self._store = store
+        self._prefix = prefix
+
+    @property
+    def dir(self):
+        return self._store.dir
+
+    def has_step(self, step_id: str) -> bool:
+        return self._store.has_step(self._prefix + step_id)
+
+    def save_step(self, step_id: str, value: Any):
+        self._store.save_step(self._prefix + step_id, value)
+
+    def load_step(self, step_id: str) -> Any:
+        return self._store.load_step(self._prefix + step_id)
+
+    def append_event(self, event: dict) -> None:
+        self._store.append_event(event)
+
+
+class Continuation:
+    """A step's return value saying "the workflow continues with THIS DAG"
+    (reference: ``workflow.continuation`` — dynamic/recursive workflows).
+    The sub-DAG executes durably with its steps namespaced under the
+    returning step; the step's checkpoint is the sub-workflow's result, so
+    a resume never re-enters a finished continuation."""
+
+    def __init__(self, dag: DAGNode, *input_args):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation(dag) takes a bound DAG node")
+        self.dag = dag
+        self.input_args = input_args
+
+
+def continuation(dag: DAGNode, *input_args) -> Continuation:
+    return Continuation(dag, *input_args)
+
+
+class EventNode(DAGNode):
+    """A workflow step that blocks until an external event arrives
+    (reference: ``workflow.wait_for_event`` + the event-listener system).
+    The event payload is the step's (checkpointed) value — a crash after
+    the event committed never waits for it again."""
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None, poll_s: float = 0.2):
+        super().__init__((), {})
+        self.name = name
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+
+def wait_for_event(name: str, timeout_s: Optional[float] = None) -> EventNode:
+    return EventNode(name, timeout_s)
+
+
+def send_event(
+    workflow_id: str, name: str, payload: Any = None, storage: Optional[str] = None
+) -> None:
+    """Deliver an external event to a (possibly not-yet-waiting) workflow.
+    Durable: the payload commits to the workflow's storage, so delivery
+    survives both driver and sender crashes."""
+    store = _Store(storage or _DEFAULT_STORAGE, workflow_id)
+    store._ensure()
+    evdir = os.path.join(store.dir, "events_in")
+    os.makedirs(evdir, exist_ok=True)
+    tmp = os.path.join(evdir, name + ".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, os.path.join(evdir, name + ".pkl"))
+
+
+@ray_tpu.remote(num_cpus=0)
+def _await_event(store_dir: str, name: str, timeout_s: Optional[float], poll_s: float):
+    """The event-wait step body: poll the durable mailbox (num_cpus=0 — a
+    parked waiter must not hold a CPU slot away from real steps)."""
+    path = os.path.join(store_dir, "events_in", name + ".pkl")
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if deadline is not None and time.monotonic() >= deadline:
+            raise TimeoutError(f"workflow event {name!r} not delivered in {timeout_s}s")
+        time.sleep(poll_s)
+    with open(path, "rb") as f:
+        return pickle.load(f)
 
 
 def _step_ids(dag: DAGNode) -> dict[int, str]:
@@ -173,6 +272,8 @@ def _execute_durable(
                 pass  # a broken listener must not kill the workflow
 
     pending: dict[Any, str] = {}  # ref -> step_id (awaiting checkpoint)
+    resolved: dict[Any, Any] = {}  # ref -> final value (continuations differ
+    # from the raw task result, so materialize must NOT re-get those refs)
 
     def _deref_lists(v):
         """A MultiOutputNode upstream produces a LIST of in-flight refs:
@@ -205,6 +306,12 @@ def _execute_durable(
         }
         if isinstance(node, MultiOutputNode):
             value = list(args)  # refs/values; materialized at harvest
+        elif isinstance(node, EventNode):
+            value = _await_event.remote(
+                store.dir, node.name, node.timeout_s, node.poll_s
+            )
+            pending[value] = step_id
+            emit("step_started", step_id)
         elif isinstance(node, FunctionNode):
             # submit, don't wait: ref args chain dependencies through the
             # scheduler; task max_retries = the step's retry budget
@@ -238,6 +345,24 @@ def _execute_durable(
                     if failure is None:
                         failure = e
                     continue
+                if isinstance(value, Continuation) and not best_effort:
+                    # dynamic workflow: the step's "result" is a sub-DAG;
+                    # execute it durably, namespaced under this step — the
+                    # checkpoint below is the continuation's FINAL value
+                    emit("continuation_started", step_id)
+                    try:
+                        value = _execute_durable(
+                            value.dag,
+                            value.input_args,
+                            _PrefixStore(store, step_id + "/"),
+                            on_event=on_event,
+                        )
+                    except Exception as e:  # sub-workflow failed
+                        emit("step_failed", step_id)
+                        if failure is None:
+                            failure = e
+                        continue
+                resolved[ref] = value
                 try:
                     # a save failure is a DRIVER/storage problem, not a step
                     # failure: surface it now rather than re-running a step
@@ -270,7 +395,9 @@ def _execute_durable(
             return [materialize(x) for x in v]
         from ray_tpu._private.runtime import ObjectRef
 
-        return ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+        if isinstance(v, ObjectRef):
+            return resolved[v] if v in resolved else ray_tpu.get(v)
+        return v
 
     return materialize(root)
 

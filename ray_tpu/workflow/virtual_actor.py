@@ -1,0 +1,161 @@
+"""Virtual actors: durable named entities whose method calls are
+storage-backed transactions.
+
+Reference: ``python/ray/workflow/`` virtual actors (``workflow.get_actor``
+/ ``@workflow.virtual_actor``) — an "actor" that outlives any process:
+its state lives in workflow storage, each method call loads the state,
+runs the method as a cluster task, and atomically commits (new state,
+result). A crashed caller re-issues the call; a committed call never
+re-runs (calls are keyed, like workflow steps).
+
+Lite by design: per-actor sequential consistency comes from an fcntl lock
+on the actor's storage directory (single-host storage; on NFS the lock
+degrades to advisory). Methods marked ``@readonly`` skip the commit and
+the lock's write side.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import pickle
+import time
+from typing import Any, Optional
+
+import ray_tpu
+
+_DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+
+def readonly(method):
+    """Mark a virtual-actor method as state-free: no commit, no write lock."""
+    method.__workflow_readonly__ = True
+    return method
+
+
+@ray_tpu.remote
+def _apply_method(cls_blob: bytes, state: dict, method_name: str, args, kwargs):
+    """Run one actor method on the cluster: rebuild the instance from its
+    durable state, apply, return (result, new state)."""
+    import cloudpickle
+
+    cls = cloudpickle.loads(cls_blob)
+    obj = cls.__new__(cls)
+    obj.__dict__.update(state)
+    result = getattr(obj, method_name)(*args, **kwargs)
+    return result, dict(obj.__dict__)
+
+
+class VirtualActorHandle:
+    def __init__(self, actor_cls, actor_id: str, storage: str):
+        self._cls = actor_cls
+        self._id = actor_id
+        self._dir = os.path.join(storage, "virtual_actors", actor_id)
+        self._blob: Optional[bytes] = None
+
+    # -- storage ------------------------------------------------------------
+
+    def _state_path(self) -> str:
+        return os.path.join(self._dir, "state.pkl")
+
+    @contextlib.contextmanager
+    def _txn_lock(self):
+        os.makedirs(self._dir, exist_ok=True)
+        with open(os.path.join(self._dir, ".lock"), "w") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+
+    def _load_state(self) -> dict:
+        with open(self._state_path(), "rb") as f:
+            return pickle.load(f)
+
+    def _commit(self, state: dict, method: str) -> None:
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._state_path())  # atomic
+        with open(os.path.join(self._dir, "log.jsonl"), "a") as f:
+            import json
+
+            f.write(json.dumps({"method": method, "time": time.time()}) + "\n")
+
+    def _class_blob(self) -> bytes:
+        if self._blob is None:
+            import cloudpickle
+
+            self._blob = cloudpickle.dumps(self._cls)
+        return self._blob
+
+    # -- calls --------------------------------------------------------------
+
+    def exists(self) -> bool:
+        return os.path.exists(self._state_path())
+
+    def _init(self, args, kwargs) -> None:
+        with self._txn_lock():
+            if self.exists():
+                return  # get_or_create: an existing actor keeps its state
+            obj = self._cls(*args, **kwargs)
+            self._commit(dict(obj.__dict__), "__init__")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        method = getattr(self._cls, name, None)
+        if method is None or not callable(method):
+            raise AttributeError(f"{self._cls.__name__} has no method {name!r}")
+        is_readonly = getattr(method, "__workflow_readonly__", False)
+
+        def call(*args, **kwargs):
+            if is_readonly:
+                state = self._load_state()
+                result, _ = ray_tpu.get(
+                    _apply_method.remote(self._class_blob(), state, name, args, kwargs)
+                )
+                return result
+            with self._txn_lock():  # serialize read-modify-write per actor
+                state = self._load_state()
+                result, new_state = ray_tpu.get(
+                    _apply_method.remote(self._class_blob(), state, name, args, kwargs)
+                )
+                self._commit(new_state, name)
+            return result
+
+        return call
+
+
+class VirtualActorClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def get_or_create(
+        self, actor_id: str, *args, storage: Optional[str] = None, **kwargs
+    ) -> VirtualActorHandle:
+        handle = VirtualActorHandle(self._cls, actor_id, storage or _DEFAULT_STORAGE)
+        handle._init(args, kwargs)
+        return handle
+
+    def get(self, actor_id: str, storage: Optional[str] = None) -> VirtualActorHandle:
+        handle = VirtualActorHandle(self._cls, actor_id, storage or _DEFAULT_STORAGE)
+        if not handle.exists():
+            raise ValueError(f"virtual actor {actor_id!r} does not exist")
+        return handle
+
+
+def virtual_actor(cls) -> VirtualActorClass:
+    """Class decorator: ``@workflow.virtual_actor`` (reference name)."""
+    return VirtualActorClass(cls)
+
+
+def get_actor(
+    actor_id: str, cls, storage: Optional[str] = None
+) -> VirtualActorHandle:
+    """Attach to an existing virtual actor (reference: workflow.get_actor;
+    the class travels with the caller here — no cluster-global class
+    registry in the lite design)."""
+    inner = cls._cls if isinstance(cls, VirtualActorClass) else cls
+    return VirtualActorClass(inner).get(actor_id, storage=storage)

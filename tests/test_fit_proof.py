@@ -1,0 +1,19 @@
+"""AOT fit-proof machinery (BASELINE.md north star: GPT-J-6B on v5e-8).
+The 6B compile itself runs in bench.py's subprocess; here the same code
+path is proven on a tiny config against the virtual 8-device CPU mesh."""
+
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.fit_proof import fit_report
+
+
+def test_fit_report_tiny_config_compiles_with_memory_analysis():
+    cfg = GPTConfig(vocab_size=2048, seq_len=128, d_model=128, n_layers=2, n_heads=4)
+    rep = fit_report(cfg, n_devices=8, batch=8)
+    assert rep["compiles"] is True
+    assert rep["n_devices"] == 8
+    assert rep["model_params"] > 500_000
+    # memory analysis may be unavailable on some backends; when present the
+    # numbers must be sane (>0, args dominated by fp32 params + adam moments)
+    if "per_chip_bytes" in rep:
+        assert rep["per_chip_bytes"] > 0
+        assert rep["argument_bytes"] > rep["model_params"] * 12 / 8 * 0.5
