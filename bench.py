@@ -66,17 +66,20 @@ def main():
     on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
     if on_tpu:
         # 406M-param GPT, bf16, Pallas flash attention (1024x1024 blocks),
-        # fused blockwise cross-entropy (never materializes the ~6.6 GB of
-        # fp32 logits), remat policy "big" (keeps flash out+lse and the MLP
-        # hidden; recomputes the cheap rest). batch 16 x seq 1024 measured
-        # best on v5e under an honest host-transfer barrier: 0.407 MFU
-        # (round-2 full-remat/naive-CE config: 0.317). batch 24 "big" is
-        # within noise; batch 32 OOMs; "dots"/"full" are slower.
+        # fused blockwise cross-entropy with LANE-ALIGNED chunks (vocab
+        # 50304 -> 3 chunks of 16768; the old power-of-two auto-pick's
+        # 1572-wide chunks padded on the MXU, ~1% whole-step cost), remat
+        # policy "attn" (keeps only flash out+lse; at batch 24 the extra
+        # HBM of "big" loses to the larger batch). Round-4 sweep on v5e,
+        # honest host-transfer barrier, median-of-3: batch 24 attn 0.423 >
+        # 24 big 0.418 > 16 big 0.412 (round-3 config) > 24 dots 0.39;
+        # bwd blocks 512/256, scan unroll 2/4, XLA attention, bf16 adam
+        # moments, batches 28/32, and no-remat (OOM <= batch 8) all lose.
         cfg = GPTConfig(
             vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16,
-            remat_policy="big",
+            remat_policy="attn",
         )
-        batch = 16
+        batch = 24
         steps = 8
     else:  # smoke config for CPU-only environments
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128, n_layers=2, n_heads=4)

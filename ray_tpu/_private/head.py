@@ -753,7 +753,12 @@ class Head:
         readable ``special_fd`` (wake/progress pipe) is drained and ends
         the drain after the current event batch — the caller has a decision
         to make. Returns True when any worker message was handled."""
-        current = self._io_conns
+        # atomic C-level snapshot: _adopt_worker_conn inserts concurrently,
+        # and iterating the live dict across threads can raise "dictionary
+        # changed size during iteration" out of a user's ray_tpu.get(). A
+        # conn missed by this snapshot is picked up next round (its adopt
+        # writes the wake pipe, so the next select returns immediately).
+        current = dict(self._io_conns)
         if registered != current.keys():
             live = set(current)
             for c in registered - live:

@@ -48,6 +48,10 @@ class GPTConfig:
     #: and runs the lm-head matmuls in the activation dtype on the MXU.
     fused_loss: bool = True
     attn_impl: str = "auto"           # auto|xla|flash|ring (see ops/attention)
+    #: lax.scan unroll over the layer dimension: >1 lets XLA schedule across
+    #: block boundaries (overlap the next layer's weight loads with this
+    #: layer's math) at the cost of compile time ∝ unroll
+    scan_unroll: int = 1
     # Mixture-of-Experts (0 = dense MLP). Experts shard over the mesh's
     # ``ep`` axis; routing uses GShard/Switch-style dense dispatch einsums
     # (one-hot matmuls — static shapes, MXU-friendly, XLA inserts the
@@ -286,7 +290,7 @@ def gpt_hidden(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None):
             )
         policy = _REMAT_POLICIES[cfg.remat_policy]()
         block = jax.checkpoint(block, prevent_cse=False, policy=policy)
-    x, auxes = jax.lax.scan(block, x, params["blocks"])
+    x, auxes = jax.lax.scan(block, x, params["blocks"], unroll=cfg.scan_unroll)
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     return x, auxes.mean()

@@ -29,12 +29,30 @@ import jax.numpy as jnp
 
 
 def _pick_chunks(vocab: int, n_chunks: int | None) -> int:
+    if n_chunks is None:
+        import os
+
+        raw = os.environ.get("RAY_TPU_CE_CHUNKS")  # sweep knob
+        if raw:
+            n_chunks = int(raw)
     if n_chunks is not None:
         if vocab % n_chunks:
             raise ValueError(f"n_chunks={n_chunks} must divide vocab={vocab}")
         return n_chunks
-    # largest power-of-two chunking that divides the vocab and keeps chunks
-    # >= 1024 columns (wide enough for the MXU, small enough to bound HBM)
+    # Prefer the FINEST chunking whose chunks stay lane-ALIGNED (% 128) and
+    # >= 4096 columns: a misaligned chunk width (e.g. 50304/32 = 1572) pads
+    # on the MXU every step. Fall back to power-of-two chunking >= 1024
+    # when the vocab's 128-quotient has no usable divisors.
+    q, rem = divmod(vocab, 128)
+    if rem == 0:
+        best = 1
+        for k in range(1, 65):
+            if q % k == 0 and (vocab // k) % 128 == 0 and vocab // k >= 4096:
+                best = k
+        if best > 1 or vocab <= 8192:
+            return best
+        # q has no small divisors (prime-ish): one aligned chunk beats many
+        # padded ones only for small vocabs; otherwise chunk misaligned
     k = 1
     while k < 64 and vocab % (k * 2) == 0 and vocab // (k * 2) >= 1024:
         k *= 2

@@ -353,11 +353,14 @@ class EnvRunner:
             self._rollout(chunk)
             steps += chunk * self.vec.n
         s = self.episode_stats(clear=True)
-        return {
-            "episodes": s["episodes"],
-            "return_mean": s["episode_return_mean"] if s["episodes"] else 0.0,
-            "steps": steps,
-        }
+        if s["episodes"]:
+            ret = s["episode_return_mean"]
+        else:
+            # no episode finished within max_steps (non-terminating policy):
+            # report the PARTIAL accumulated return — a literal 0.0 would
+            # outrank every genuine direction in negative-reward envs
+            ret = float(np.mean(self._ep_ret))
+        return {"episodes": s["episodes"], "return_mean": ret, "steps": steps}
 
     def ping(self) -> bool:
         return True

@@ -262,6 +262,24 @@ def _execute_durable(
     for node in dag._collect_inputs():
         memo[id(node)] = inputs.pop(0) if inputs else None
 
+    # steps some OTHER node consumes: continuations are tail-position only
+    # (reference semantics) — a mid-DAG consumer is submitted eagerly with
+    # the producer's ref and would receive the raw Continuation object, so
+    # that shape must fail loudly, not compute garbage
+    steps_with_dependents: set[str] = set()
+    _seen_dep: set[int] = set()
+
+    def _mark_deps(node):
+        if not isinstance(node, DAGNode) or id(node) in _seen_dep:
+            return
+        _seen_dep.add(id(node))
+        for v in list(node._bound_args) + list(node._bound_kwargs.values()):
+            if isinstance(v, DAGNode):
+                steps_with_dependents.add(ids[id(v)])
+                _mark_deps(v)
+
+    _mark_deps(dag)
+
     def emit(event_type: str, step_id: str) -> None:
         event = {"type": event_type, "step_id": step_id, "time": time.time()}
         store.append_event(event)
@@ -346,6 +364,17 @@ def _execute_durable(
                         failure = e
                     continue
                 if isinstance(value, Continuation) and not best_effort:
+                    if step_id in steps_with_dependents:
+                        emit("step_failed", step_id)
+                        if failure is None:
+                            failure = TypeError(
+                                f"step {step_id!r} returned a continuation but "
+                                "has downstream consumers — continuations are "
+                                "tail-position only (its consumers were "
+                                "submitted eagerly and would receive the raw "
+                                "Continuation object)"
+                            )
+                        continue
                     # dynamic workflow: the step's "result" is a sub-DAG;
                     # execute it durably, namespaced under this step — the
                     # checkpoint below is the continuation's FINAL value

@@ -52,6 +52,20 @@ def test_continuation_resume_skips_done_rounds(ray_start_regular, tmp_path):
     assert marker.read_text() == "x"  # the inner step ran exactly once
 
 
+def test_continuation_mid_dag_fails_loudly(ray_start_regular, tmp_path):
+    """Continuations are tail-position only: a step with downstream
+    consumers returning one must fail the workflow with a clear error, not
+    feed the raw Continuation object onward."""
+
+    @ray_tpu.remote
+    def sneaky():
+        return workflow.continuation(add.bind(1, 2))
+
+    dag = add.bind(sneaky.bind(), 10)
+    with pytest.raises(Exception, match="tail-position|Continuation"):
+        workflow.run(dag, workflow_id="midc", storage=str(tmp_path))
+
+
 def test_wait_for_event_delivery(ray_start_regular, tmp_path):
     ev = workflow.wait_for_event("go", timeout_s=30)
     dag = add.bind(ev, 10)
