@@ -59,6 +59,7 @@ class ServeController:
         self._proxy = None
         self._proxies: dict[str, tuple] = {}  # node_id hex -> (actor, port)
         self._proxy_req_port: Optional[int] = None
+        self._grpc_proxy: Optional[tuple] = None  # (actor, port)
         # serializes _ensure_proxies: ensure_proxy (serve.run) racing the
         # reconcile thread once created TWO proxies for one node — the dict
         # overwrite dropped the first proxy's only handle, and the head
@@ -251,6 +252,26 @@ class ServeController:
         with self._lock:
             ports = [p for _, p in self._proxies.values()]
             return ports[0] if ports else None
+
+    def ensure_grpc_proxy(self, port: int = 0) -> int:
+        """ONE gRPC ingress for the cluster (reference runs a gRPC proxy
+        beside each HTTP proxy; the lite design runs a single instance —
+        gRPC clients hold long-lived channels, so per-node fan-out buys
+        little on the pod-scale clusters this targets)."""
+        import ray_tpu
+        from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+
+        with self._proxy_mutex:
+            if self._grpc_proxy is not None:
+                return self._grpc_proxy[1]
+            cls = ray_tpu.remote(num_cpus=0)(GrpcProxyActor)
+            actor = cls.options(max_concurrency=64).remote(port)
+            p = ray_tpu.get(actor.get_port.remote(), timeout=60)
+            self._grpc_proxy = (actor, p)
+            return p
+
+    def get_grpc_proxy_port(self) -> Optional[int]:
+        return self._grpc_proxy[1] if self._grpc_proxy is not None else None
 
     def get_proxy_ports(self) -> dict:
         """node_id hex -> port, one per alive node."""

@@ -1,0 +1,179 @@
+"""gRPC ingress for Serve applications.
+
+Reference: ``python/ray/serve/_private/proxy.py:542`` (``gRPCProxy``) — the
+reference mounts user-supplied grpc servicer functions and routes by the
+``application`` request metadata. Same routing contract here, behind a
+GENERIC service so no proto compilation is required on either side:
+
+* service: ``ray.serve.GenericService``
+* methods: ``Predict`` (unary-unary), ``PredictStream`` (unary-stream)
+* request/response payloads: raw bytes. If the request bytes are a pickle,
+  they are unpickled before reaching the deployment and the response is
+  pickled back; otherwise bytes pass through untouched (interop with
+  non-Python clients).
+* routing: ``application`` metadata key names the target app (its ingress
+  deployment, per the controller's record).
+
+A typed client stub can still be used against this surface by registering
+its serialized request bytes — the reference's typed-proto mode is a
+documented departure (COVERAGE.md): it needs user proto descriptors
+shipped to the proxy, which the lite design trades for zero codegen.
+"""
+
+from __future__ import annotations
+
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+SERVICE = "ray.serve.GenericService"
+
+
+def _maybe_unpickle(data: bytes):
+    try:
+        return pickle.loads(data)
+    except Exception:  # noqa: BLE001 - raw-bytes clients are legitimate
+        return data
+
+
+def _pack(value) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    return pickle.dumps(value)
+
+
+class GrpcProxyActor:
+    """gRPC server routing GenericService calls to deployment handles
+    (actor: lives in its own worker process, like the HTTP ProxyActor)."""
+
+    def __init__(self, port: int = 0):
+        import grpc
+
+        self._handles: dict[str, tuple] = {}
+        self._pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="grpc-proxy")
+        self._server = grpc.server(self._pool, options=[("grpc.so_reuseport", 0)])
+        self._server.add_generic_rpc_handlers((self._make_handler(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"gRPC proxy could not bind port {port}")
+        self._server.start()
+
+    # -- routing ------------------------------------------------------------
+
+    def _handle_for(self, app: str):
+        import ray_tpu
+        from ray_tpu.serve._private.common import CONTROLLER_NAME
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        ent = self._handles.get(app)
+        if ent is None:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            info = ray_tpu.get(controller.get_ingress_info.remote(app), timeout=30)
+            if info is None:
+                raise KeyError(f"no serve application {app!r}")
+            ent = (DeploymentHandle(info["deployment"]), bool(info["streaming"]))
+            self._handles[app] = ent
+        return ent
+
+    def _app_of(self, context) -> str:
+        md = dict(context.invocation_metadata())
+        app = md.get("application")
+        if not app:
+            import grpc
+
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "missing 'application' request metadata",
+            )
+        return app
+
+    # -- grpc plumbing -------------------------------------------------------
+
+    def _make_handler(self):
+        import grpc
+
+        actor = self
+
+        # NB: context.abort() raises to unwind — it must NOT sit inside a
+        # broad except, or every abort gets re-reported as INTERNAL
+
+        def _resolve(context):
+            app = actor._app_of(context)  # aborts INVALID_ARGUMENT itself
+            try:
+                return actor._handle_for(app)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+        def predict(request: bytes, context) -> bytes:
+            handle, streaming = _resolve(context)
+            if streaming:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "streaming app: call PredictStream",
+                )
+            try:
+                result = handle.remote(_maybe_unpickle(request)).result(timeout=120)
+            except Exception as e:  # noqa: BLE001 - deployment errors -> status
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+            return _pack(result)
+
+        def predict_stream(request: bytes, context):
+            handle, streaming = _resolve(context)
+            payload = _maybe_unpickle(request)
+            try:
+                if streaming:
+                    for item in handle.options(stream=True).remote(payload):
+                        yield _pack(item)
+                else:  # unary app: stream of one
+                    yield _pack(handle.remote(payload).result(timeout=120))
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        handlers = {
+            "Predict": grpc.unary_unary_rpc_method_handler(predict),
+            "PredictStream": grpc.unary_stream_rpc_method_handler(predict_stream),
+        }
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                _, _, method = call_details.method.rpartition("/")
+                svc = call_details.method.rsplit("/", 2)[-2] if call_details.method.count("/") >= 2 else ""
+                if svc != SERVICE:
+                    return None
+                return handlers.get(method)
+
+        return _Generic()
+
+    def get_port(self) -> int:
+        return self.port
+
+    def ready(self) -> bool:
+        return True
+
+    def shutdown(self) -> bool:
+        self._server.stop(grace=1.0).wait(timeout=5)
+        return True
+
+
+def grpc_channel_call(
+    address: str, app: str, payload, timeout_s: float = 30.0, stream: bool = False
+):
+    """Client-side convenience (tests + python callers without stubs):
+    one Predict/PredictStream call against a running gRPC proxy."""
+    import grpc
+
+    with grpc.insecure_channel(address) as channel:
+        md = (("application", app),)
+        if stream:
+            fn = channel.unary_stream(
+                f"/{SERVICE}/PredictStream",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            return [_maybe_unpickle(b) for b in fn(_pack(payload), metadata=md, timeout=timeout_s)]
+        fn = channel.unary_unary(
+            f"/{SERVICE}/Predict",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        return _maybe_unpickle(fn(_pack(payload), metadata=md, timeout=timeout_s))

@@ -193,13 +193,16 @@ def run(
     route_prefix: Optional[str] = None,
     http: bool = False,
     http_port: Optional[int] = None,
+    grpc: bool = False,
+    grpc_port: Optional[int] = None,
     _blocking: bool = True,
 ) -> DeploymentHandle:
     """Deploy an application; returns the ingress DeploymentHandle.
 
     Reference: ``serve/api.py:439``. ``http=True`` also ensures the HTTP
-    proxy ingress is up (``GET/POST /<name>`` with a JSON body).
-    """
+    proxy ingress is up (``GET/POST /<name>`` with a JSON body);
+    ``grpc=True`` the gRPC ingress (``ray.serve.GenericService/Predict``
+    with ``application`` metadata — see _private/grpc_proxy.py)."""
     import time
 
     controller = _get_or_start_controller()
@@ -209,6 +212,10 @@ def run(
         if http_port is None:
             http_port = _default_http_port()
         ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
+    if grpc:
+        ray_tpu.get(
+            controller.ensure_grpc_proxy.remote(int(grpc_port or 0)), timeout=120
+        )
     if _blocking:
         deadline = time.time() + 120
         while not ray_tpu.get(controller.ready.remote(), timeout=30):
