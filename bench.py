@@ -179,7 +179,13 @@ def _core_microbench() -> dict:
             if line.startswith("{"):
                 rec = json.loads(line)
                 if rec.get("metric") == "core_microbench":
-                    return rec.get("detail", {})
+                    detail = rec.get("detail", {})
+                    if rec.get("env"):
+                        # Contention context (cpu count, loadavg, spin
+                        # canary) so cross-round comparisons of the core
+                        # numbers are interpretable (VERDICT r4 #1a).
+                        detail["_env"] = rec["env"]
+                    return detail
         print(
             f"[bench] core microbench produced no metrics (rc={out.returncode}): "
             f"{out.stderr[-500:]}",

@@ -107,6 +107,12 @@ class Config:
     #: slot's work is failed (actor creation) or left to the scheduler
     #: (pool workers).
     worker_spawn_retries: int = 3
+    #: Fork new workers from a per-node warm template process
+    #: (worker_template.py) instead of cold interpreter boots: ~5-10ms per
+    #: worker vs ~300ms+, the forkserver analog of the reference's
+    #: pre-started worker pool (worker_pool.h:152). Containerised workers
+    #: always cold-spawn. Disable to debug spawn-path issues.
+    worker_forkserver_enabled: bool = True
 
     #: Pipeline up to this many plain tasks of identical scheduling
     #: signature onto one worker (followers ride the head task's resource
@@ -161,9 +167,10 @@ class Config:
 
     # -- control-plane internals ------------------------------------------
     #: Backstop flush period of the head's outbound-message queue; normal
-    #: sends flush immediately after the head lock releases — this only
-    #: bounds the tail when a flusher thread loses a race.
-    outbox_flush_backstop_s: float = 0.5
+    #: sends flush immediately after the head lock releases — this poll
+    #: bounds the tail when the enqueuing thread parks before flushing
+    #: (enqueue deliberately never wakes the backstop; see _enqueue_send).
+    outbox_flush_backstop_s: float = 0.05
     #: Task-event feed retention: when the in-memory feed exceeds this many
     #: records, the oldest half is dropped (reference:
     #: ``task_events_max_num_task_in_gcs``).

@@ -44,6 +44,7 @@ from ray_tpu import exceptions as rex
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import config as _cfg
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.proc_handles import ForkedProc, TemplateProc, spawn_template
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu._private.shm_store import ShmLocation, ShmOwner
 
@@ -116,6 +117,10 @@ class _WorkerProc:
             pass
 
 
+# forkserver process handles (ForkedProc / TemplateProc / spawn_template)
+# live in proc_handles.py — shared with node_agent for remote hosts
+
+
 class WorkerHandle:
     """A connected worker process (reference: raylet's WorkerInterface)."""
 
@@ -150,6 +155,9 @@ class WorkerHandle:
         # correlation that works for workers spawned on REMOTE hosts, where
         # the head never sees a pid
         self.token: Optional[str] = None
+        # spawned via the node's forkserver template: the pid (unknown until
+        # registration) becomes a ForkedProc so kill/join paths work
+        self.forked = False
         # which attempt of a spawn chain this handle is (0 = first); bounds
         # registration-timeout respawns (reference: worker_register_timeout_seconds)
         self.spawn_attempts = 0
@@ -218,6 +226,9 @@ class NodeState:
         self.idle_workers: list[WorkerHandle] = []
         self.all_workers: set[WorkerHandle] = set()
         self.spawning = 0
+        # forkserver template for this node (head-host nodes only; agent
+        # hosts run their own template) — see worker_template.py
+        self.template: Optional[TemplateProc] = None
         self.assigned: deque = deque()  # tasks waiting for a worker on this node
         # placement-group reservations: pg_id -> bundle_index -> avail dict
         self.pg_reserved: dict[bytes, dict[int, dict[str, float]]] = {}
@@ -1087,21 +1098,32 @@ class Head:
                 self._on_worker_dead(wh)
             return
 
+        if container is None and GLOBAL_CONFIG.worker_forkserver_enabled:
+            # fast path: fork from the node's warm template (~5-10ms) instead
+            # of a cold interpreter boot (reference: pre-started worker pool,
+            # worker_pool.h:152 — same goal, one warm process instead of N).
+            # The handle goes into all_workers BEFORE the fork request: the
+            # template's token->pid report races the fork and must find the
+            # handle, or a pre-registration wedge could never be killed.
+            tmpl = self._ensure_template(node)
+            if tmpl is not None:
+                wh = WorkerHandle(node, None)
+                wh.forked = True
+                wh.actor_id = actor_id
+                wh.token = token
+                wh.spawn_attempts = attempts
+                with self.lock:
+                    node.all_workers.add(wh)
+                if tmpl.fork(token):
+                    return
+                with self.lock:  # template died mid-request: cold-spawn
+                    node.all_workers.discard(wh)
+
         import subprocess
         import sys
 
-        import ray_tpu
-
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        if self.arena_name:
-            env["RAY_TPU_ARENA"] = self.arena_name
-        if self.tcp_address is not None:
-            # detached-actor workers reconnect here after a head restart —
-            # the unix socket dies with the old head process, the TCP
-            # address is what a restarted head rebinds
-            env["RAY_TPU_HEAD_TCP"] = f"{self.tcp_address[0]}:{self.tcp_address[1]}"
+        pkg_root = self._pkg_root()
+        env = self._worker_env(pkg_root)
         argv = [
             sys.executable,
             "-m",
@@ -1124,6 +1146,64 @@ class Head:
         with self.lock:
             node.all_workers.add(wh)
         # registration arrives on its own connection; matched in _on_register
+
+    def _pkg_root(self) -> str:
+        import ray_tpu
+
+        return os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+
+    def _worker_env(self, pkg_root: str) -> dict:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if self.arena_name:
+            env["RAY_TPU_ARENA"] = self.arena_name
+        if self.tcp_address is not None:
+            # detached-actor workers reconnect here after a head restart —
+            # the unix socket dies with the old head process, the TCP
+            # address is what a restarted head rebinds
+            env["RAY_TPU_HEAD_TCP"] = f"{self.tcp_address[0]}:{self.tcp_address[1]}"
+        return env
+
+    def _ensure_template(self, node: NodeState) -> Optional[TemplateProc]:
+        """Get (spawning if needed) the node's forkserver template. Returns
+        None when templates are unusable on this platform (no fork) — the
+        caller cold-spawns. A dead template (OOM-killed, crashed) is
+        replaced; spawn requests buffered in its stdin pipe die with it, but
+        those workers' registration timeouts already cover lost spawns."""
+        tmpl = node.template
+        if tmpl is not None and tmpl.alive():
+            return tmpl
+        # Popen OUTSIDE the head lock (it is multi-ms and the lock guards
+        # the scheduling hot path); the re-check under the lock keeps one
+        # template per node when two spawn threads race here.
+        ours = spawn_template(
+            self.socket_path,
+            self.authkey,
+            node.node_id.binary(),
+            self._worker_env(self._pkg_root()),
+            on_spawn=lambda token, proc: self._bind_forked_proc(node, token, proc),
+        )
+        if ours is None:
+            return None
+        with self.lock:
+            cur = node.template
+            if cur is not None and cur.alive():
+                loser = ours
+            else:
+                node.template, loser = ours, cur
+        if loser is not None:
+            loser.shutdown()
+        return node.template
+
+    def _bind_forked_proc(self, node: NodeState, token: str, proc: ForkedProc) -> None:
+        """Template reported a fork: give the pre-created handle a process
+        object NOW so registration-timeout kills work before the worker
+        ever connects (_on_register also binds, for the race where it wins)."""
+        with self.lock:
+            for wh in node.all_workers:
+                if wh.token == token and wh.proc is None:
+                    wh.proc = proc
+                    return
 
     def _on_register(self, conn, info, remote: bool = False) -> Optional[WorkerHandle]:
         node_id = info["node_id"]
@@ -1161,6 +1241,11 @@ class Head:
                 wh = WorkerHandle(node, None)
                 node.all_workers.add(wh)
             wh.conn = conn
+            if wh.forked and wh.proc is None and not remote:
+                # template-forked worker: first time we learn its pid —
+                # kill/join paths need a process handle (head-host only;
+                # a remote host's pid is meaningless here)
+                wh.proc = ForkedProc(pid)
             claim = info.get("actor_id")
             if wh.actor_id is None and claim is None:
                 # not a reconnect claim: this registration consumes a spawn
@@ -1264,9 +1349,14 @@ class Head:
         conn thread behind each dispatch. The backstop thread catches any
         path that queued a send but parks before flushing (e.g. a driver
         get whose lineage reconstruction dispatched a rebuild, then blocked
-        on the very result)."""
+        on the very result).
+
+        Deliberately does NOT wake the backstop: Event.set with a waiter is
+        a futex wake (~50us measured on a busy 1-core box, paid on EVERY
+        dispatch), while every normal entry point already flushes in its
+        own finally — the backstop only exists for the rare parked-enqueuer
+        path, which its poll interval bounds."""
         self._outbox.append((wh, msg))
-        self._flush_event.set()
 
     def _flush_backstop_loop(self) -> None:
         while not self._shutdown:
@@ -1397,6 +1487,9 @@ class Head:
                 wh.alive = False
                 if wh.proc is not None and wh.proc.is_alive():
                     wh.proc.terminate()
+            if node.template is not None:
+                node.template.shutdown()
+                node.template = None
             for rec in assigned:
                 self._requeue_or_fail(rec, rex.WorkerCrashedError("node removed"))
             for wh in workers:
@@ -2328,11 +2421,32 @@ class Head:
         self.submit_task(spec)
 
     def _start_actor_on(self, rec, node: NodeState):
-        """Lock held. Actor creation got a node: spawn a dedicated worker."""
+        """Lock held. Actor creation got a node: adopt an idle pool worker
+        when the env allows it, else spawn a dedicated worker.
+
+        Adoption (reference: the raylet hands actor-creation leases to
+        already-started pool workers — workers are generic processes there
+        too) skips the whole spawn pipeline: the actor starts in one
+        dispatch instead of interpreter boot + registration. Only a
+        container env forces a dedicated cold spawn (the pool worker runs
+        outside the requested image); conda/pip/env_vars apply in-worker at
+        create time exactly as they would in a fresh process."""
         spec = rec["spec"]
         actor = self.actors[spec["actor_id"]]
         actor.node_id = node.node_id
         rec["state"] = "RUNNING"
+        if not (spec.get("runtime_env") or {}).get("container"):
+            while node.idle_workers:
+                wh = node.idle_workers.pop()
+                if (
+                    wh.alive
+                    and wh.conn is not None
+                    and wh.actor_id is None
+                    and not wh.queued_recs
+                ):
+                    wh.actor_id = spec["actor_id"]
+                    self._dispatch_to_worker(wh, rec)
+                    return
         # Keyed by actor id, NOT queued on node.assigned: only the dedicated
         # worker spawned for this actor may pick it up.
         self._actor_create_recs[spec["actor_id"]] = rec
@@ -2549,12 +2663,16 @@ class Head:
 
     # -------------------------------------------------------------- objects
 
-    def put_serialized(self, sv: ser.SerializedValue, is_error=False) -> bytes:
+    def put_serialized(
+        self, sv: ser.SerializedValue, is_error=False, take_ref=False
+    ) -> bytes:
         obj_id = ObjectID.for_put().binary()
-        self.put_at(obj_id, sv, is_error)
+        self.put_at(obj_id, sv, is_error, take_ref=take_ref)
         return obj_id
 
-    def put_at(self, obj_id: bytes, sv: ser.SerializedValue, is_error=False):
+    def put_at(
+        self, obj_id: bytes, sv: ser.SerializedValue, is_error=False, take_ref=False
+    ):
         if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
             locator = ("inline", sv.to_bytes(), is_error)
         else:
@@ -2563,6 +2681,8 @@ class Head:
             locator = ("shm", write_shm(sv), is_error)
         with self.lock:
             self._store_locator(obj_id, locator)
+            if take_ref:
+                self.objects[obj_id].refcount += 1
 
     def _pump_or_wait(self, t: float) -> None:
         """A getter with nothing to do yet either takes over the worker-IO
@@ -2612,7 +2732,11 @@ class Head:
         finally:
             with self._pump_count_lock:
                 self._pump_requests -= 1
-            self._io_resume.set()
+            # No _io_resume.set() here: waking the IO thread's waiter is a
+            # futex wake (~50us) paid once per get. The IO thread self-wakes
+            # from its 10ms park (_worker_io_loop), so the pump hand-back is
+            # bounded-latency instead of immediate — a sync get loop pumps
+            # its own completions and never needs the IO thread anyway.
 
     def get_locators(self, obj_ids: list[bytes], timeout: Optional[float]) -> list:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -3295,11 +3419,15 @@ class Head:
 
             _tb.print_exc()  # partial restore is better than none
 
-    def rpc_put(self, obj_id, small, shm, is_error=False):
+    def rpc_put(self, obj_id, small, shm, is_error=False, take_ref=False):
         locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
         locator = self._normalize_locator(locator)  # big memcpy outside lock
         with self.lock:
             self._store_locator(obj_id, locator)
+            if take_ref:
+                # the caller's ObjectRef refcount, folded into the put
+                # itself: one head round trip per ray.put, not two
+                self.objects[obj_id].refcount += 1
         return True
 
     def rpc_get(self, obj_ids, timeout=None):
@@ -3712,6 +3840,10 @@ class Head:
                 wh.send(("exit",))
             except Exception:
                 pass
+        for node in self.nodes.values():
+            if node.template is not None:
+                node.template.shutdown()
+                node.template = None
         deadline = time.monotonic() + 2.0
         for wh in workers:
             if wh.proc is not None:
@@ -3731,6 +3863,7 @@ class Head:
         except OSError:
             pass
         self._io_resume.set()
+        self._flush_event.set()  # backstop exits now, not at its next poll
         self._snapshot()
         self.shm_owner.shutdown()
         if self.arena_name:

@@ -59,6 +59,12 @@ class NodeAgent:
         self.labels = dict(labels or {})
         self._procs: list[subprocess.Popen] = []
         self._by_token: dict[str, subprocess.Popen] = {}
+        # template-forked workers: token -> ForkedProc (pidfd-pinned).
+        # Written by the template's report thread, read by kill/dump/
+        # shutdown paths — always under _forked_lock.
+        self._forked: dict[str, object] = {}
+        self._forked_lock = threading.Lock()
+        self._template = None
         self._stop = threading.Event()
         self.conn = connect_head(address, authkey)
         # This host's slice of the object plane: a local arena for workers'
@@ -150,9 +156,14 @@ class NodeAgent:
                     # registration-timeout path: the head gave up on this
                     # spawn; kill it here so a wedged interpreter doesn't
                     # leak on the host (head.py _respawn_timed_out)
-                    p = self._by_token.pop(msg[1].get("token", ""), None)
+                    tok = msg[1].get("token", "")
+                    p = self._by_token.pop(tok, None)
                     if p is not None and p.poll() is None:
                         p.terminate()
+                    with self._forked_lock:
+                        fp = self._forked.pop(tok, None)
+                    if fp is not None:
+                        fp.terminate()
                 elif msg[0] == "exit":
                     break
         finally:
@@ -162,7 +173,7 @@ class NodeAgent:
         threading.Thread(target=self.run, daemon=True).start()
         return self
 
-    def _spawn(self, info: dict) -> None:
+    def _worker_env(self) -> tuple[dict, str]:
         import ray_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
@@ -179,6 +190,43 @@ class NodeAgent:
         # over-arena-cap objects get dedicated segments tagged with this
         # agent's prefix, so shutdown can sweep any the head never freed
         env["RAY_TPU_SEG_PREFIX"] = self._seg_prefix
+        return env, pkg_root
+
+    def _ensure_template(self):
+        """This host's forkserver template (head._ensure_template analog;
+        shared spawn_template helper). Replaced if it died."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if not GLOBAL_CONFIG.worker_forkserver_enabled:
+            return None
+        tmpl = getattr(self, "_template", None)
+        if tmpl is not None and tmpl.alive():
+            return tmpl
+        from ray_tpu._private.proc_handles import spawn_template
+
+        env, _ = self._worker_env()
+        self._template = spawn_template(
+            self.address,
+            self.authkey,
+            self.node_id_bin,
+            env,
+            remote=True,
+            on_spawn=self._on_template_spawn,
+        )
+        return self._template
+
+    def _on_template_spawn(self, token: str, proc) -> None:
+        with self._forked_lock:
+            self._forked[token] = proc
+
+    def _spawn(self, info: dict) -> None:
+        token = info.get("token", "")
+        if not info.get("container"):
+            tmpl = self._ensure_template()
+            if tmpl is not None and tmpl.fork(token):
+                self._prune_forked()  # every spawn path sweeps, or the
+                return  # token->handle map (and its pidfds) grows forever
+        env, pkg_root = self._worker_env()
         argv = [
             sys.executable,
             "-m",
@@ -186,7 +234,7 @@ class NodeAgent:
             self.address,
             self.authkey.hex(),
             self.node_id_bin.hex(),
-            info.get("token", ""),
+            token,
             "--remote",
         ]
         if info.get("container"):
@@ -195,7 +243,6 @@ class NodeAgent:
             argv, env = container_wrap(argv, env, pkg_root, info["container"])
         popen = subprocess.Popen(argv, env=env)
         self._procs.append(popen)
-        token = info.get("token", "")
         if token:
             self._by_token[token] = popen
         from ray_tpu._private.reporter import reap_stack_file
@@ -205,11 +252,24 @@ class NodeAgent:
                 reap_stack_file(p.pid)
         self._procs = [p for p in self._procs if p.poll() is None]
         self._by_token = {t: p for t, p in self._by_token.items() if p.poll() is None}
+        self._prune_forked()
+
+    def _prune_forked(self) -> None:
+        from ray_tpu._private.reporter import reap_stack_file
+
+        with self._forked_lock:
+            dead = [t for t, fp in self._forked.items() if not fp.is_alive()]
+            for t in dead:
+                fp = self._forked.pop(t)
+                reap_stack_file(fp.pid)
+                fp.close()
 
     def _dump_workers(self, req_id: str) -> None:
         from ray_tpu._private.reporter import dump_pids
 
         pids = [p.pid for p in self._procs if p.poll() is None]
+        with self._forked_lock:
+            pids += [fp.pid for fp in self._forked.values() if fp.is_alive()]
         try:
             stacks = dump_pids(pids)
             with self._send_lock:
@@ -286,9 +346,16 @@ class NodeAgent:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self._template is not None:
+            self._template.shutdown()
+            self._template = None
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()
+        with self._forked_lock:
+            for fp in self._forked.values():
+                fp.terminate()
+            self._forked.clear()
         for p in self._procs:
             try:
                 p.wait(timeout=3)

@@ -321,13 +321,15 @@ class BaseContext:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
         sv = ser.serialize(value)
-        obj_id = self.put_serialized(sv)
-        # The returned ObjectRef holds one refcount; without this, a single
-        # use as a task arg would unpin and evict the object.
-        self.call("add_ref", obj_id=obj_id)
+        # take_ref: the returned ObjectRef holds one refcount, taken inside
+        # the put itself (one head round trip, not put + add_ref — without
+        # the count, a single use as a task arg would unpin and evict).
+        obj_id = self.put_serialized(sv, take_ref=True)
         return ObjectRef(obj_id, owned=True)
 
-    def put_serialized(self, sv: ser.SerializedValue, is_error=False) -> bytes:
+    def put_serialized(
+        self, sv: ser.SerializedValue, is_error=False, take_ref=False
+    ) -> bytes:
         raise NotImplementedError
 
     def get(self, refs: list[ObjectRef], timeout: Optional[float]) -> list[Any]:
@@ -598,9 +600,9 @@ class DriverContext(BaseContext):
             # this call queued (head.flush_outbox docstring)
             self.head.flush_outbox()
 
-    def put_serialized(self, sv, is_error=False) -> bytes:
+    def put_serialized(self, sv, is_error=False, take_ref=False) -> bytes:
         try:
-            return self.head.put_serialized(sv, is_error)
+            return self.head.put_serialized(sv, is_error, take_ref=take_ref)
         finally:
             self.head.flush_outbox()
 
@@ -670,13 +672,14 @@ class WorkerContext(BaseContext):
     def send_raw(self, msg):
         self._send(msg)
 
-    def put_serialized(self, sv, is_error=False) -> bytes:
+    def put_serialized(self, sv, is_error=False, take_ref=False) -> bytes:
         obj_id = ObjectID.for_put().binary()
         kind, payload, err = self.store_value(sv, is_error)
-        if kind == "inline":
-            self.call("put", obj_id=obj_id, small=payload, shm=None, is_error=err)
-        else:
-            self.call("put", obj_id=obj_id, small=None, shm=payload, is_error=err)
+        small, shm = (payload, None) if kind == "inline" else (None, payload)
+        self.call(
+            "put", obj_id=obj_id, small=small, shm=shm, is_error=err,
+            take_ref=take_ref,
+        )
         return obj_id
 
 

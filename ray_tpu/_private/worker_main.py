@@ -160,7 +160,32 @@ def main(
 
     recv = threading.Thread(target=_recv_loop, args=(conn, ctx, state), daemon=True)
     recv.start()
-    _exec_loop(state)
+    prof_dir = os.environ.get("RAY_TPU_WORKER_CPROFILE")
+    if prof_dir:
+        # debugging hook (reference: py-spy / memray endpoints in
+        # dashboard/modules/reporter/profile_manager.py): cProfile this
+        # worker's exec loop, dump stats on exit for offline analysis
+        import cProfile
+        import signal
+
+        pr = cProfile.Profile()
+
+        def _dump(*_a):
+            pr.disable()
+            pr.dump_stats(os.path.join(prof_dir, f"worker-{os.getpid()}.prof"))
+            os._exit(0)
+
+        global _prof_exit
+        _prof_exit = _dump
+        signal.signal(signal.SIGTERM, _dump)  # workers die by SIGTERM
+        pr.enable()
+        try:
+            _exec_loop(state)
+        finally:
+            _dump()
+    else:
+        _exec_loop(state)
+    os._exit(0)
 
 
 def _try_reconnect(state: WorkerState, ctx: WorkerContext):
@@ -243,10 +268,13 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
+            if _prof_exit is not None:
+                _prof_exit()
             os._exit(0)
 
 
 _profile_gate = threading.Lock()
+_prof_exit = None  # set by main() when RAY_TPU_WORKER_CPROFILE is on
 
 
 def _start_profile(ctx, req: dict) -> None:
@@ -323,7 +351,6 @@ def _exec_loop(state: WorkerState):
             state.actor_pool.submit(_run_spec, state, spec)
         else:
             _run_spec(state, spec)
-    os._exit(0)
 
 
 def _run_spec(state: WorkerState, spec: dict):

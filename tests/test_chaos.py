@@ -48,10 +48,16 @@ def test_actors_survive_worker_kills(chaos_cluster):
             return self.n
 
     actors = [Counter.remote() for _ in range(4)]
-    with ResourceKiller(interval_s=0.5, seed=3, max_kills=4) as killer:
+    with ResourceKiller(interval_s=0.2, seed=3, max_kills=4) as killer:
         results = []
-        for round_i in range(10):
+        # keep rounds coming until the killer has actually fired (the warm
+        # worker pool made actor creation+calls so fast that a fixed round
+        # count can outrun the first kill tick entirely)
+        deadline = time.monotonic() + 60
+        round_i = 0
+        while round_i < 10 or (not killer.kills and time.monotonic() < deadline):
             results.append(ray_tpu.get([a.bump.remote() for a in actors], timeout=120))
+            round_i += 1
     # counts are monotone per actor; restarts may reset state (fresh
     # __init__) but every CALL must succeed — the invariant is liveness +
     # per-round success, not cross-restart state (reference semantics)
