@@ -1204,6 +1204,12 @@ class Head:
                 if wh.token == token and wh.proc is None:
                     wh.proc = proc
                     return
+            revoked = token in self._revoked_tokens
+        if revoked:
+            # the head already gave up on this spawn (_respawn_timed_out ran
+            # before the pid report arrived, so it had nothing to kill) —
+            # this report IS the kill opportunity for the wedged interpreter
+            proc.terminate()
 
     def _on_register(self, conn, info, remote: bool = False) -> Optional[WorkerHandle]:
         node_id = info["node_id"]
@@ -3619,9 +3625,25 @@ class Head:
             ]
 
     def rpc_list_objects(self):
+        def where(e):
+            if e.small is not None:
+                return "inline"
+            if e.shm is not None:
+                return "shm"
+            if e.spill_path is not None:
+                return "spilled"
+            return "pending"
+
         with self.lock:
             return [
-                {"object_id": ObjectID(oid).hex(), "size": e.size, "ready": e.ready, "refcount": e.refcount, "pins": e.pins}
+                {
+                    "object_id": ObjectID(oid).hex(),
+                    "size": e.size,
+                    "ready": e.ready,
+                    "where": where(e),
+                    "refcount": e.refcount,
+                    "pins": e.pins,
+                }
                 for oid, e in self.objects.items()
             ]
 

@@ -23,16 +23,21 @@ class ForkedProc:
     it refers to this exact process forever, and polls readable once it
     exits. Raw-pid fallback only where pidfd_open is unavailable."""
 
-    __slots__ = ("pid", "_pidfd")
+    __slots__ = ("pid", "_pidfd", "_exited")
 
     def __init__(self, pid: int):
         self.pid = pid
         self._pidfd: Optional[int] = None
+        self._exited = False
         try:
             self._pidfd = os.pidfd_open(pid)
-        except (AttributeError, OSError):
-            # already reaped (dead) or platform without pidfd: raw fallback
+        except AttributeError:
+            self._pidfd = None  # platform without pidfd: raw fallback
+        except OSError:
+            # already reaped: the pid may ALREADY be recycled — never
+            # signal it
             self._pidfd = None
+            self._exited = True
 
     def _close(self) -> None:
         if self._pidfd is not None:
@@ -63,18 +68,24 @@ class ForkedProc:
             return True
 
     def is_alive(self) -> bool:
+        if self._exited:
+            return False
         if self._pidfd is not None:
             if self._poll_exit(0):  # pidfd readable = process exited
-                self._close()
+                self._exited = True  # pid may be recycled from here on:
+                self._close()  # terminate/join must become no-ops
                 return False
             return True
         try:
             os.kill(self.pid, 0)
             return True
         except OSError:
+            self._exited = True
             return False
 
     def terminate(self) -> None:
+        if self._exited:
+            return  # exit observed: the raw pid may belong to a stranger now
         if self._pidfd is not None:
             try:
                 signal.pidfd_send_signal(self._pidfd, signal.SIGTERM)
@@ -87,8 +98,11 @@ class ForkedProc:
             pass
 
     def join(self, timeout=None) -> None:
+        if self._exited:
+            return
         if self._pidfd is not None:
             if self._poll_exit(None if timeout is None else int(timeout * 1000)):
+                self._exited = True
                 self._close()
             return
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -133,6 +133,21 @@ def main(
     token: str = "",
     remote: bool = False,
 ):
+    # Fault injection for the registration-timeout path (tests): the FIRST
+    # process to claim the sentinel wedges pre-registration, like an
+    # interpreter that hangs at startup; respawns find the sentinel taken
+    # and come up normally. Lives HERE (not _cli_main) so template-forked
+    # workers are covered too — the wedge tests exercise the pidfd kill path.
+    wedge = os.environ.get("RAY_TPU_TEST_WEDGE_ONCE")
+    if wedge:
+        try:
+            fd = os.open(wedge, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            import time as _time
+
+            _time.sleep(600.0)
+        except FileExistsError:
+            pass
     _install_jax_platform_pin()
     try:
         conn = connect_head(socket_path, authkey)
@@ -831,20 +846,6 @@ def _cli_main():
     socket_path, authkey_hex, node_id_hex = sys.argv[1], sys.argv[2], sys.argv[3]
     token = sys.argv[4] if len(sys.argv) > 4 else ""
     remote = len(sys.argv) > 5 and sys.argv[5] == "--remote"
-    # Fault injection for the registration-timeout path (tests): the FIRST
-    # process to claim the sentinel wedges pre-registration, like an
-    # interpreter that hangs at startup; respawns find the sentinel taken
-    # and come up normally.
-    wedge = os.environ.get("RAY_TPU_TEST_WEDGE_ONCE")
-    if wedge:
-        try:
-            fd = os.open(wedge, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.close(fd)
-            import time as _time
-
-            _time.sleep(600.0)
-        except FileExistsError:
-            pass
     main(
         socket_path,
         bytes.fromhex(authkey_hex),

@@ -19,6 +19,7 @@ Usage::
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -72,117 +73,42 @@ def _payload(path: str):
             return [j if isinstance(j, dict) else j.__dict__ for j in list_jobs()]
         except Exception:
             return []
+    if path == "/api/logs":
+        # job log tail (reference: dashboard log endpoints serve the
+        # session log dir; here job supervisors capture entrypoint output)
+        job_id = (query.get("job_id") or [""])[0]
+        try:
+            tail = int((query.get("tail") or ["2000"])[0])
+        except ValueError:
+            tail = 2000  # malformed client value: default, not a 500
+        try:
+            from ray_tpu.job import get_job_logs
+
+            text = get_job_logs(job_id)
+        except Exception as e:
+            return {"job_id": job_id, "logs": f"(unavailable: {e})"}
+        lines = (text or "").splitlines()
+        return {"job_id": job_id, "logs": "\n".join(lines[-tail:])}
     if path == "/api/metrics":
         return um.collect()
+    if path == "/api/grafana":
+        from ray_tpu.util.grafana import dashboard_json
+
+        return dashboard_json()
     return None
 
 
-_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
-<meta charset="utf-8"><meta name="viewport" content="width=device-width,initial-scale=1">
-<style>
- :root{--ink:#1a1d21;--ink2:#5b6168;--line:#e3e6ea;--bg:#fafbfc;--card:#fff;
-       --accent:#2f6fde;--accent-soft:#dbe7fb;--good:#2e7d32;--warn:#b26a00;--bad:#c62828}
- @media(prefers-color-scheme:dark){
-  :root{--ink:#e7eaee;--ink2:#9aa1a9;--line:#32363c;--bg:#17191c;--card:#1f2226;
-        --accent:#6b9ef2;--accent-soft:#26395c;--good:#7cc47f;--warn:#e0a84f;--bad:#ef8c8c}}
- body{font-family:system-ui,sans-serif;margin:0;color:var(--ink);background:var(--bg)}
- header{display:flex;align-items:baseline;gap:1rem;padding:.9rem 1.4rem;border-bottom:1px solid var(--line)}
- header h1{font-size:1.05rem;margin:0} header .sub{color:var(--ink2);font-size:.8rem}
- main{padding:1rem 1.4rem;max-width:72rem}
- .tiles{display:flex;flex-wrap:wrap;gap:.7rem;margin:.4rem 0 1rem}
- .tile{background:var(--card);border:1px solid var(--line);border-radius:8px;padding:.55rem .9rem;min-width:7.5rem}
- .tile .v{font-size:1.35rem;font-weight:600} .tile .k{color:var(--ink2);font-size:.72rem;text-transform:uppercase;letter-spacing:.04em}
- .meter{margin:.35rem 0}.meter .lbl{display:flex;justify-content:space-between;font-size:.8rem;color:var(--ink2)}
- .meter .bar{height:8px;background:var(--accent-soft);border-radius:4px;overflow:hidden;margin-top:2px}
- .meter .bar i{display:block;height:100%;background:var(--accent);border-radius:4px}
- nav{display:flex;gap:.15rem;margin:1rem 0 .6rem;border-bottom:1px solid var(--line)}
- nav button{border:0;background:none;color:var(--ink2);padding:.45rem .8rem;font-size:.85rem;cursor:pointer;border-bottom:2px solid transparent}
- nav button.on{color:var(--ink);border-color:var(--accent);font-weight:600}
- table{border-collapse:collapse;width:100%;background:var(--card);font-variant-numeric:tabular-nums}
- td,th{border:1px solid var(--line);padding:.3rem .6rem;font-size:.8rem;text-align:left;vertical-align:top}
- th{color:var(--ink2);font-weight:600;position:sticky;top:0;background:var(--card)}
- .st{display:inline-flex;align-items:center;gap:.3rem;font-size:.78rem}
- .st i{width:.55rem;height:.55rem;border-radius:50%;display:inline-block}
- pre{background:var(--card);border:1px solid var(--line);border-radius:6px;padding:.6rem;font-size:.75rem;overflow:auto}
- .muted{color:var(--ink2)} .counts span{margin-right:.9rem;font-size:.82rem}
-</style></head><body>
-<header><h1>ray_tpu</h1><span class="sub" id="meta">connecting…</span>
- <span style="flex:1"></span>
- <label class="sub"><input type="checkbox" id="auto" checked> auto-refresh</label></header>
-<main>
- <div class="tiles" id="tiles"></div>
- <div id="meters"></div>
- <div class="counts" id="taskcounts"></div>
- <nav id="tabs"></nav>
- <div id="view">loading…</div>
-</main>
-<script>
-const TABS=["nodes","actors","tasks","objects","placement_groups","jobs","metrics","worker_stacks"];
-let tab="nodes";
-const esc=s=>String(s).replace(/[&<>]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
-const fmt=v=>v===undefined||v===null?"<span class=muted>—</span>":
- typeof v==="object"?"<code>"+esc(JSON.stringify(v))+"</code>":esc(v);
-async function j(u){const r=await fetch(u);if(!r.ok)throw new Error(u+": "+r.status);return r.json()}
-const STATE_COLOR={ALIVE:"var(--good)",RUNNING:"var(--accent)",PENDING:"var(--warn)",
- RESTARTING:"var(--warn)",DEAD:"var(--bad)",FAILED:"var(--bad)",FINISHED:"var(--ink2)",
- WAITING_DEPS:"var(--warn)",ASSIGNED:"var(--accent)"};
-const stateCell=s=>`<span class=st><i style="background:${STATE_COLOR[s]||"var(--ink2)"}"></i>${esc(s)}</span>`;
-function table(rows,cols,stateCol){
- if(!rows||!rows.length) return "<p class=muted>none</p>";
- let h="<table><tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>";
- for(const r of rows.slice(0,200))
-  h+="<tr>"+cols.map(c=>`<td>${c===stateCol?stateCell(r[c]):fmt(r[c])}</td>`).join("")+"</tr>";
- h+="</table>";
- if(rows.length>200)h+=`<p class=muted>…and ${rows.length-200} more</p>`;
- return h;
+# The SPA now lives in _dashboard_static/ (index.html / app.js /
+# style.css) — hand-written, no build step; served by _Handler below.
+_STATIC_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_dashboard_static"
+)
+_STATIC = {
+    "/": ("index.html", "text/html; charset=utf-8"),
+    "/index.html": ("index.html", "text/html; charset=utf-8"),
+    "/app.js": ("app.js", "text/javascript; charset=utf-8"),
+    "/style.css": ("style.css", "text/css; charset=utf-8"),
 }
-function meters(res){
- const tot=res.total||{},avail=res.available||{};
- return Object.keys(tot).filter(k=>k!=="memory").sort().map(k=>{
-  const t=tot[k],u=t-(avail[k]??t),pct=t?Math.round(100*u/t):0;
-  return `<div class=meter><span class=lbl><span>${esc(k)}</span><span>${+u.toFixed(2)} / ${+t.toFixed(2)} used</span></span>
-   <span class=bar><i style="width:${pct}%"></i></span></div>`;}).join("");
-}
-const tile=(k,v)=>`<div class=tile><div class=v>${v}</div><div class=k>${esc(k)}</div></div>`;
-async function render(){
- try{
-  const [res,nodes,actors,summary]=await Promise.all([
-   j("/api/cluster_resources"),j("/api/nodes"),j("/api/actors"),j("/api/summary")]);
-  const tasks=(summary&&summary.tasks)||{};
-  document.getElementById("meta").textContent=new Date().toLocaleTimeString();
-  document.getElementById("tiles").innerHTML=
-   tile("nodes",nodes.filter(n=>n.alive!==false).length)+
-   tile("actors",actors.length)+
-   tile("running tasks",tasks.RUNNING||0)+
-   tile("pending tasks",(tasks.PENDING||0)+(tasks.WAITING_DEPS||0))+
-   tile("objects",(summary&&summary.objects&&summary.objects.count)??"—");
-  document.getElementById("meters").innerHTML=meters(res);
-  document.getElementById("taskcounts").innerHTML=Object.entries(tasks)
-   .map(([s,n])=>`<span>${stateCell(s)} ${n}</span>`).join("");
-  document.getElementById("view").innerHTML=await view(tab,{nodes,actors});
- }catch(e){document.getElementById("view").innerHTML="<p class=muted>"+esc(e)+"</p>"}
-}
-async function view(t,pre){
- if(t==="nodes") return table(pre.nodes,["node_id","alive","resources","labels"],"");
- if(t==="actors") return table(pre.actors,["actor_id","class_name","name","state","node_id","restarts"],"state");
- if(t==="tasks") return table(await j("/api/tasks"),["task_id","name","state","kind","node_id"],"state");
- if(t==="objects") return table(await j("/api/objects"),["object_id","size","where","refcount","pins"],"");
- if(t==="placement_groups") return table(await j("/api/placement_groups"),["pg_id","state","strategy","bundles"],"state");
- if(t==="jobs") return table(await j("/api/jobs"),["job_id","status","entrypoint"],"status");
- if(t==="metrics") return "<pre>"+esc(JSON.stringify(await j("/api/metrics"),null,1))+"</pre>"+
-   '<p class=muted>prometheus text at <a href="/metrics">/metrics</a></p>';
- if(t==="worker_stacks"){const s=await j("/api/worker_stacks");
-  return Object.entries(s).map(([node,per])=>Object.entries(per).map(([pid,txt])=>
-   `<h3 class=muted style="font-size:.85rem">node ${esc(node).slice(0,8)} · pid ${esc(pid)}</h3><pre>${esc(txt)}</pre>`
-  ).join("")).join("")||"<p class=muted>none</p>";}
- return "";
-}
-document.getElementById("tabs").innerHTML=TABS.map(t=>
- `<button id="tab-${t}" onclick="tab='${t}';sync();render()">${t.replace(/_/g," ")}</button>`).join("");
-function sync(){for(const t of TABS)document.getElementById("tab-"+t).className=t===tab?"on":""}
-sync();render();
-setInterval(()=>{if(document.getElementById("auto").checked)render()},3000);
-</script></body></html>"""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -191,9 +117,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
         try:
-            if self.path in ("/", "/index.html"):
-                body = _INDEX.encode()
-                ctype = "text/html; charset=utf-8"
+            if self.path.split("?")[0] in _STATIC:
+                fname, ctype = _STATIC[self.path.split("?")[0]]
+                with open(os.path.join(_STATIC_DIR, fname), "rb") as f:
+                    body = f.read()
             elif self.path == "/metrics":
                 from ray_tpu.util import metrics as um
 
@@ -237,6 +164,13 @@ def start(host: str = "127.0.0.1", port: Optional[int] = None) -> str:
     _server.daemon_threads = True
     _thread = threading.Thread(target=_server.serve_forever, name="dashboard", daemon=True)
     _thread.start()
+    try:
+        # live core series for /metrics + the generated Grafana board
+        from ray_tpu.util.metrics import start_core_metrics
+
+        start_core_metrics()
+    except Exception:
+        pass  # dashboard is usable without the sampler
     h, p = _server.server_address[:2]
     return f"http://{h}:{p}"
 

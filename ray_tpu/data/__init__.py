@@ -186,3 +186,147 @@ def read_bigquery(project_id: str, *, query: str = None, dataset: str = None, pa
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
     return _from_source(datasource, parallelism)
+
+
+# ---------------------------------------------------------------------------
+# long-tail sources (datasource_ext.py; reference datasource/ second tranche)
+# ---------------------------------------------------------------------------
+
+
+def read_avro(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Avro object-container files -> Dataset (reference:
+    ``ray.data.read_avro``). Hand-rolled OCF reader — no fastavro needed
+    (null + deflate codecs)."""
+    from ray_tpu.data.datasource_ext import AvroDatasource
+
+    return _from_source(AvroDatasource(paths, kwargs), parallelism)
+
+
+def read_orc(paths, *, parallelism: int = -1, columns: Optional[list] = None, **kwargs) -> Dataset:
+    """ORC files via pyarrow.orc (reference: arrow-backed ORC reads)."""
+    from ray_tpu.data.datasource_ext import ORCDatasource
+
+    kw = dict(kwargs)
+    if columns is not None:
+        kw["columns"] = columns
+    return _from_source(ORCDatasource(paths, kw), parallelism)
+
+
+def read_feather(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Arrow IPC / Feather v2 files (file or stream format)."""
+    from ray_tpu.data.datasource_ext import ArrowIPCDatasource
+
+    return _from_source(ArrowIPCDatasource(paths, kwargs), parallelism)
+
+
+read_arrow_ipc = read_feather
+
+
+def read_audio(paths, *, include_paths: bool = False, parallelism: int = -1) -> Dataset:
+    """Audio files -> rows of {amplitude, sample_rate} (reference:
+    ``ray.data.read_audio``). WAV decodes with the stdlib; other formats
+    need soundfile."""
+    from ray_tpu.data.datasource_ext import AudioDatasource
+
+    return _from_source(
+        AudioDatasource(paths, {"include_paths": include_paths}), parallelism
+    )
+
+
+def read_xml(paths, *, record_tag: str = None, parallelism: int = -1) -> Dataset:
+    """XML documents -> one row per record element (attributes + child
+    element text become columns)."""
+    from ray_tpu.data.datasource_ext import XMLDatasource
+
+    return _from_source(XMLDatasource(paths, {"record_tag": record_tag}), parallelism)
+
+
+def read_delta(table_path: str, *, parallelism: int = -1) -> Dataset:
+    """Delta Lake table -> Dataset by replaying the ``_delta_log`` JSON
+    commit actions to the live parquet file set (reference: the deltalake-
+    wrapped source; this tier needs no deltalake package)."""
+    from ray_tpu.data.datasource_ext import DeltaDatasource
+
+    return _from_source(DeltaDatasource(table_path), parallelism)
+
+
+def read_clickhouse(url: str, query: str, *, transport=None, parallelism: int = -1) -> Dataset:
+    """ClickHouse over its HTTP interface, ``FORMAT JSONEachRow``
+    (reference: ``ray.data.read_clickhouse``). ``transport`` is injectable
+    for tests / custom auth."""
+    from ray_tpu.data.datasource_ext import ClickHouseDatasource
+
+    return read_datasource(
+        ClickHouseDatasource(url, query, transport), parallelism=parallelism
+    )
+
+
+def read_databricks_tables(
+    *, host: str, token: str, warehouse_id: str, query: str, transport=None,
+    parallelism: int = -1,
+) -> Dataset:
+    """Databricks SQL warehouse statement-execution API (reference:
+    ``ray.data.read_databricks_tables``)."""
+    from ray_tpu.data.datasource_ext import DatabricksDatasource
+
+    return read_datasource(
+        DatabricksDatasource(host, token, warehouse_id, query, transport),
+        parallelism=parallelism,
+    )
+
+
+def read_snowflake(
+    query: str, *, connection_factory=None, connection_parameters: dict = None,
+    parallelism: int = 1, order_by: str = None,
+) -> Dataset:
+    """Snowflake -> Dataset (reference: ``ray.data.read_snowflake``): pass
+    ``connection_parameters`` with snowflake-connector installed, or any
+    DB-API ``connection_factory`` (shares read_sql's window machinery)."""
+    from ray_tpu.data.datasource_ext import snowflake_datasource
+
+    return _from_source(
+        snowflake_datasource(
+            query, connection_factory, connection_parameters,
+            parallelism_hint=parallelism, order_by=order_by,
+        ),
+        parallelism,
+    )
+
+
+def read_lance(uri: str, *, columns=None, parallelism: int = -1) -> Dataset:
+    """Lance datasets (reference: ``ray.data.read_lance``). Needs pylance."""
+    from ray_tpu.data.datasource_ext import LanceDatasource
+
+    return read_datasource(LanceDatasource(uri, columns), parallelism=parallelism)
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs: dict = None, parallelism: int = -1) -> Dataset:
+    """Iceberg tables (reference: ``ray.data.read_iceberg``). Needs pyiceberg."""
+    from ray_tpu.data.datasource_ext import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(table_identifier, catalog_kwargs), parallelism=parallelism
+    )
+
+
+def read_hudi(table_uri: str, *, parallelism: int = -1) -> Dataset:
+    """Hudi tables (reference: ``ray.data.read_hudi``). Needs the hudi package."""
+    from ray_tpu.data.datasource_ext import HudiDatasource
+
+    return read_datasource(HudiDatasource(table_uri), parallelism=parallelism)
+
+
+def read_parquet_bulk(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Many small parquet files without per-file metadata fetches
+    (reference: ``ray.data.read_parquet_bulk`` — same reader here, the
+    distinction is advisory)."""
+    return read_parquet(paths, parallelism=parallelism, **kwargs)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """An arrow-backed 🤗 ``datasets.Dataset`` -> Dataset (reference:
+    ``ray.data.from_huggingface``). Zero-copy: wraps the underlying arrow
+    table as blocks."""
+    from ray_tpu.data.datasource_ext import huggingface_blocks
+
+    return _from_source(BlocksDatasource(huggingface_blocks(hf_dataset)))

@@ -160,6 +160,21 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_grafana(args) -> int:
+    """Emit the generated Grafana dashboard JSON (util/grafana.py;
+    reference: grafana_dashboard_factory.py). No cluster needed."""
+    from ray_tpu.util.grafana import dashboard_json
+
+    text = json.dumps(dashboard_json(), indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {args.output}")
+    else:
+        print(text)
+    return 0
+
+
 def cmd_status(args) -> int:
     ray_tpu = _attached(args.address)
     print(json.dumps(
@@ -307,6 +322,10 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("--output", default="ray_tpu_timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("grafana", help="emit an importable Grafana dashboard JSON")
+    p.add_argument("-o", "--output", default=None, help="write to file instead of stdout")
+    p.set_defaults(fn=cmd_grafana)
 
     p = sub.add_parser("status", help="nodes + resource totals")
     p.add_argument("--address", required=True)

@@ -39,6 +39,13 @@ class DeploymentConfig:
     #: deadline passes) — reference: graceful_shutdown_timeout_s
     graceful_shutdown_timeout_s: float = 10.0
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    #: gRPC ingress payload contract for this deployment: "bytes" (default —
+    #: request/response bytes pass through VERBATIM; unpickling untrusted
+    #: ingress bytes is an RCE surface, so deserialization is opt-in),
+    #: "pickle" (trusted intra-cluster Python clients), or "json".
+    #: Reference: the reference proxy routes typed protos only
+    #: (serve/_private/proxy.py:542); this is the no-codegen analog.
+    grpc_codec: str = "bytes"
 
 
 @dataclasses.dataclass

@@ -287,6 +287,11 @@ class ServeController:
             return {
                 "deployment": name,
                 "streaming": bool(state and getattr(state.spec, "streaming", False)),
+                "codec": getattr(
+                    getattr(state.spec, "config", None), "grpc_codec", "bytes"
+                )
+                if state
+                else "bytes",
             }
 
     # -- reconciliation ----------------------------------------------------

@@ -7,10 +7,13 @@ GENERIC service so no proto compilation is required on either side:
 
 * service: ``ray.serve.GenericService``
 * methods: ``Predict`` (unary-unary), ``PredictStream`` (unary-stream)
-* request/response payloads: raw bytes. If the request bytes are a pickle,
-  they are unpickled before reaching the deployment and the response is
-  pickled back; otherwise bytes pass through untouched (interop with
-  non-Python clients).
+* request/response payloads: raw bytes by default — they reach the
+  deployment VERBATIM and the response must be bytes/str. Deserialization
+  is a per-deployment opt-in (``@serve.deployment(grpc_codec="pickle")``
+  for trusted intra-cluster Python clients, or ``"json"``): running
+  ``pickle.loads`` on whatever an untrusted client sends is an RCE
+  surface, so the proxy never probes payloads (the reference routes typed
+  protos only, ``serve/_private/proxy.py:542`` — same trust posture).
 * routing: ``application`` metadata key names the target app (its ingress
   deployment, per the controller's record).
 
@@ -22,24 +25,59 @@ shipped to the proxy, which the lite design trades for zero codegen.
 
 from __future__ import annotations
 
+import json
 import pickle
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 SERVICE = "ray.serve.GenericService"
+CODECS = ("bytes", "pickle", "json")
 
 
-def _maybe_unpickle(data: bytes):
-    try:
-        return pickle.loads(data)
-    except Exception:  # noqa: BLE001 - raw-bytes clients are legitimate
-        return data
+def _decode(data: bytes, codec: str, context):
+    """Request bytes -> deployment argument, per the app's declared codec.
+    Malformed opt-in payloads are the CLIENT's error (INVALID_ARGUMENT),
+    never silently passed through."""
+    import grpc
+
+    if codec == "pickle":
+        try:
+            return pickle.loads(data)
+        except Exception:  # noqa: BLE001
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "request is not a valid pickle"
+            )
+    if codec == "json":
+        try:
+            return json.loads(data.decode("utf-8"))
+        except Exception:  # noqa: BLE001
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "request is not valid JSON"
+            )
+    return data  # bytes: verbatim
 
 
-def _pack(value) -> bytes:
-    if isinstance(value, bytes):
-        return value
-    return pickle.dumps(value)
+def _encode(value, codec: str, context) -> bytes:
+    import grpc
+
+    if codec == "pickle":
+        return pickle.dumps(value)
+    if codec == "json":
+        try:
+            return json.dumps(value).encode("utf-8")
+        except (TypeError, ValueError) as e:
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"response not JSON-serializable: {e}"
+            )
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return bytes(value)
+    if isinstance(value, str):
+        return value.encode("utf-8")
+    context.abort(
+        grpc.StatusCode.INTERNAL,
+        f"bytes-codec deployment returned {type(value).__name__}; return "
+        f"bytes/str or declare grpc_codec='pickle'/'json' on the deployment",
+    )
 
 
 class GrpcProxyActor:
@@ -65,15 +103,45 @@ class GrpcProxyActor:
         from ray_tpu.serve._private.common import CONTROLLER_NAME
         from ray_tpu.serve.handle import DeploymentHandle
 
+        import time
+
+        ttl = 10.0
         ent = self._handles.get(app)
-        if ent is None:
+        now = time.monotonic()
+        if ent is not None and now < ent[3]:
+            return ent[:3]
+        # TTL refresh: a redeploy can CHANGE the codec/streaming contract —
+        # a forever-cache would keep unpickling after an operator hardened
+        # the app to bytes (the exact hole this codec design closes). One
+        # control RPC per app per window is noise.
+        try:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            info = ray_tpu.get(controller.get_ingress_info.remote(app), timeout=30)
-            if info is None:
-                raise KeyError(f"no serve application {app!r}")
-            ent = (DeploymentHandle(info["deployment"]), bool(info["streaming"]))
-            self._handles[app] = ent
-        return ent
+            info = ray_tpu.get(
+                controller.get_ingress_info.remote(app), timeout=10
+            )
+        except Exception:
+            if ent is not None:
+                # controller restarting: serve the STALE contract rather
+                # than failing healthy replicas (re-check next window)
+                self._handles[app] = (*ent[:3], now + ttl, ent[4])
+                return ent[:3]
+            raise
+        if info is None:
+            self._handles.pop(app, None)
+            raise KeyError(f"no serve application {app!r}")
+        if ent is not None and ent[4] == info["deployment"]:
+            handle = ent[0]  # same target: keep the warm handle/router
+        else:
+            handle = DeploymentHandle(info["deployment"])
+        ent = (
+            handle,
+            bool(info["streaming"]),
+            info.get("codec", "bytes"),
+            now + ttl,
+            info["deployment"],
+        )
+        self._handles[app] = ent
+        return ent[:3]
 
     def _app_of(self, context) -> str:
         md = dict(context.invocation_metadata())
@@ -105,29 +173,40 @@ class GrpcProxyActor:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
 
         def predict(request: bytes, context) -> bytes:
-            handle, streaming = _resolve(context)
+            handle, streaming, codec = _resolve(context)
             if streaming:
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     "streaming app: call PredictStream",
                 )
+            payload = _decode(request, codec, context)
             try:
-                result = handle.remote(_maybe_unpickle(request)).result(timeout=120)
+                result = handle.remote(payload).result(timeout=120)
             except Exception as e:  # noqa: BLE001 - deployment errors -> status
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
-            return _pack(result)
+            return _encode(result, codec, context)
 
         def predict_stream(request: bytes, context):
-            handle, streaming = _resolve(context)
-            payload = _maybe_unpickle(request)
-            try:
+            handle, streaming, codec = _resolve(context)
+            payload = _decode(request, codec, context)
+
+            def items():
                 if streaming:
-                    for item in handle.options(stream=True).remote(payload):
-                        yield _pack(item)
+                    yield from handle.options(stream=True).remote(payload)
                 else:  # unary app: stream of one
-                    yield _pack(handle.remote(payload).result(timeout=120))
-            except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                    yield handle.remote(payload).result(timeout=120)
+
+            it = items()
+            while True:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                except Exception as e:  # noqa: BLE001 - deployment errors
+                    context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                # encode OUTSIDE the except: its aborts must not be
+                # re-reported as INTERNAL deployment failures
+                yield _encode(item, codec, context)
 
         handlers = {
             "Predict": grpc.unary_unary_rpc_method_handler(predict),
@@ -155,25 +234,57 @@ class GrpcProxyActor:
         return True
 
 
+def _client_pack(payload, codec: str) -> bytes:
+    if codec == "pickle":
+        return pickle.dumps(payload)
+    if codec == "json":
+        return json.dumps(payload).encode("utf-8")
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return bytes(payload)
+    if isinstance(payload, str):
+        return payload.encode("utf-8")
+    raise TypeError(
+        f"bytes codec needs bytes/str payload, got {type(payload).__name__}"
+    )
+
+
+def _client_unpack(data: bytes, codec: str):
+    if codec == "pickle":
+        return pickle.loads(data)
+    if codec == "json":
+        return json.loads(data.decode("utf-8"))
+    return data
+
+
 def grpc_channel_call(
-    address: str, app: str, payload, timeout_s: float = 30.0, stream: bool = False
+    address: str,
+    app: str,
+    payload,
+    timeout_s: float = 30.0,
+    stream: bool = False,
+    codec: str = "bytes",
 ):
-    """Client-side convenience (tests + python callers without stubs):
-    one Predict/PredictStream call against a running gRPC proxy."""
+    """Client-side convenience (tests + python callers without stubs): one
+    Predict/PredictStream call against a running gRPC proxy. ``codec`` must
+    match the target deployment's ``grpc_codec`` declaration."""
     import grpc
 
     with grpc.insecure_channel(address) as channel:
         md = (("application", app),)
+        data = _client_pack(payload, codec)
         if stream:
             fn = channel.unary_stream(
                 f"/{SERVICE}/PredictStream",
                 request_serializer=None,
                 response_deserializer=None,
             )
-            return [_maybe_unpickle(b) for b in fn(_pack(payload), metadata=md, timeout=timeout_s)]
+            return [
+                _client_unpack(b, codec)
+                for b in fn(data, metadata=md, timeout=timeout_s)
+            ]
         fn = channel.unary_unary(
             f"/{SERVICE}/Predict",
             request_serializer=None,
             response_deserializer=None,
         )
-        return _maybe_unpickle(fn(_pack(payload), metadata=md, timeout=timeout_s))
+        return _client_unpack(fn(data, metadata=md, timeout=timeout_s), codec)

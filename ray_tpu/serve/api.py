@@ -92,9 +92,16 @@ def deployment(
     ray_actor_options: Optional[dict] = None,
     health_check_period_s: float = 1.0,
     graceful_shutdown_timeout_s: float = 10.0,
+    grpc_codec: str = "bytes",
 ) -> Union[Deployment, Callable[..., Deployment]]:
     """Reference: ``serve/api.py:246``. ``num_replicas="auto"`` enables
-    autoscaling with defaults."""
+    autoscaling with defaults. ``grpc_codec`` sets the gRPC ingress payload
+    contract: "bytes" (verbatim passthrough, default), "pickle" (opt-in for
+    trusted Python clients), or "json"."""
+    from ray_tpu.serve._private.grpc_proxy import CODECS
+
+    if grpc_codec not in CODECS:
+        raise ValueError(f"grpc_codec must be one of {CODECS}, got {grpc_codec!r}")
 
     def build(target) -> Deployment:
         cls = target if inspect.isclass(target) else _wrap_function(target)
@@ -114,6 +121,7 @@ def deployment(
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {},
+            grpc_codec=grpc_codec,
         )
         return Deployment(cls, name or getattr(target, "__name__", "deployment"), cfg)
 

@@ -1,0 +1,140 @@
+"""Grafana dashboard JSON generator.
+
+Reference: ``dashboard/modules/metrics/grafana_dashboard_factory.py`` — the
+reference generates its default Grafana boards (cluster utilization, task
+states, node metrics) from panel templates at dashboard startup. Here the
+generator builds one importable dashboard from (a) the core runtime series
+every cluster exports once ``start_core_metrics()`` runs (the dashboard
+server starts it) and (b) whatever user metrics are currently registered in
+``ray_tpu.util.metrics``. Output follows the modern schema (schemaVersion
+39, timeseries panels) and imports cleanly into Grafana 9/10/11.
+
+Usage::
+
+    python -m ray_tpu grafana > ray_tpu_dashboard.json
+    # or REST: GET /api/grafana on a running dashboard
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+# (title, promql expr, unit, description) — core series exported by
+# util.metrics.start_core_metrics(); names carry the ray_tpu_ prefix that
+# prometheus_text() adds.
+_CORE_PANELS = [
+    ("Tasks by state", 'ray_tpu_core_tasks{{state=~".+"}}', "short",
+     "Cluster task counts per scheduler state (PENDING/RUNNING/...)."),
+    ("Actors by state", 'ray_tpu_core_actors{{state=~".+"}}', "short",
+     "Actor FSM states (PENDING/ALIVE/RESTARTING/DEAD)."),
+    ("Alive nodes", "ray_tpu_core_nodes", "short",
+     "Nodes registered and alive in the cluster."),
+    ("Logical resource utilization", 'ray_tpu_core_resource_used{{resource=~".+"}}', "short",
+     "Used amount per logical resource (CPU/TPU/custom)."),
+    ("Object store objects", "ray_tpu_core_objects", "short",
+     "Objects tracked by the head directory."),
+    ("Object store bytes", "ray_tpu_core_object_bytes", "bytes",
+     "Total bytes of tracked objects (inline + shm)."),
+    ("Spilled bytes", "ray_tpu_core_spilled_bytes", "bytes",
+     "Bytes currently spilled to disk."),
+]
+
+
+def _panel(panel_id: int, title: str, expr: str, unit: str, desc: str, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "type": "timeseries",
+        "title": title,
+        "description": desc,
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": 12 * (panel_id % 2), "y": y},
+        "fieldConfig": {
+            "defaults": {
+                "unit": unit,
+                "custom": {"drawStyle": "line", "lineWidth": 1, "fillOpacity": 12},
+            },
+            "overrides": [],
+        },
+        "targets": [
+            {
+                "expr": expr.replace("{{", "{").replace("}}", "}"),
+                "legendFormat": "__auto",
+                "refId": "A",
+                "datasource": {"type": "prometheus", "uid": "${datasource}"},
+            }
+        ],
+    }
+
+
+def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
+    """Build the dashboard dict. ``extra_metric_names`` defaults to every
+    metric currently registered in this process's registry."""
+    from ray_tpu.util import metrics as um
+
+    kinds: dict[str, str] = {}
+    if extra_metric_names is None:
+        with um._registry_lock:
+            kinds = {
+                m.name: m.kind
+                for m in um._registry
+                if not m.name.startswith("core_")
+            }
+        names = sorted(kinds)
+    else:
+        names = list(extra_metric_names)
+    panels = []
+    y = 0
+    pid = 0
+    for title, expr, unit, desc in _CORE_PANELS:
+        panels.append(_panel(pid, title, expr, unit, desc, y))
+        pid += 1
+        if pid % 2 == 0:
+            y += 8
+    for name in names:
+        if kinds.get(name) == "histogram":
+            # the exporter emits _bucket/_sum/_count for histograms, never
+            # the bare name — a bare-name panel would be permanently empty
+            expr = (
+                f"histogram_quantile(0.99, "
+                f"rate(ray_tpu_{name}_bucket[5m]))"
+            )
+            title = f"{name} (p99)"
+        else:
+            expr = f"ray_tpu_{name}"
+            title = name
+        panels.append(
+            _panel(pid, title, expr, "short", f"User metric {name!r}.", y)
+        )
+        pid += 1
+        if pid % 2 == 0:
+            y += 8
+    return {
+        "title": "ray_tpu",
+        "uid": "ray-tpu-core",
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                    "current": {},
+                }
+            ]
+        },
+        "panels": panels,
+        "annotations": {"list": []},
+        "editable": True,
+    }
+
+
+def write_dashboard(path: str, **kw) -> str:
+    with open(path, "w") as f:
+        json.dump(dashboard_json(**kw), f, indent=2)
+    return path

@@ -262,3 +262,110 @@ def prometheus_text() -> str:
             else:
                 lines.append(f"ray_tpu_{name}{fmt()} {val}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# core runtime metrics (reference: src/ray/stats/metric_defs.cc — tasks by
+# state, actors, object store usage — exported by the C++ runtime; here a
+# lightweight sampler thread reads the head's state API into gauges so
+# Grafana boards generated by util.grafana have live core series)
+# ---------------------------------------------------------------------------
+
+_core_thread: Optional[threading.Thread] = None
+_core_stop = threading.Event()
+
+
+_core_gauges: Optional[dict] = None
+
+
+def _get_core_gauges() -> dict:
+    """The 8 core gauges, created ONCE per process: a start/stop/start cycle
+    must reuse them, or each restart would append duplicates to _registry
+    whose stale snapshots fight the live ones in collect()'s merge."""
+    global _core_gauges
+    if _core_gauges is None:
+        _core_gauges = {
+            "tasks": Gauge("core_tasks", "tasks by scheduler state", ("state",)),
+            "actors": Gauge("core_actors", "actors by FSM state", ("state",)),
+            "nodes": Gauge("core_nodes", "alive nodes"),
+            "res_used": Gauge("core_resource_used", "used logical resources", ("resource",)),
+            "res_total": Gauge("core_resource_total", "total logical resources", ("resource",)),
+            "objects": Gauge("core_objects", "objects tracked by the head"),
+            "object_bytes": Gauge("core_object_bytes", "bytes of tracked objects"),
+            "spilled": Gauge("core_spilled_bytes", "bytes spilled to disk"),
+        }
+    return _core_gauges
+
+
+def _set_tagged(gauge: "Gauge", tag_key: str, values: dict) -> None:
+    """Set every current tagged value and ZERO previously-seen tags that
+    vanished this sample — a state with no tasks reports 0, not its last
+    nonzero value forever."""
+    seen = getattr(gauge, "_core_seen", set())
+    for tag, v in values.items():
+        gauge.set(v, tags={tag_key: tag})
+    for tag in seen - set(values):
+        gauge.set(0, tags={tag_key: tag})
+    gauge._core_seen = seen | set(values)
+
+
+def start_core_metrics(interval_s: float = 5.0) -> None:
+    """Start (idempotently) the core-series sampler in this process. The
+    dashboard server calls this; drivers can too for headless scraping."""
+    global _core_thread
+    if _core_thread is not None and _core_thread.is_alive():
+        return
+    _core_stop.clear()
+    g = _get_core_gauges()
+
+    def _sample_once() -> None:
+        import ray_tpu
+        from ray_tpu.util import state as st
+
+        summary = st.summary()
+        _set_tagged(g["tasks"], "state", summary.get("tasks", {}).get("by_state") or {})
+        _set_tagged(g["actors"], "state", summary.get("actors", {}).get("by_state") or {})
+        g["nodes"].set(
+            len([n for n in st.list_nodes() if n.get("Alive", n.get("alive", True))])
+        )
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        _set_tagged(g["res_total"], "resource", total)
+        _set_tagged(
+            g["res_used"],
+            "resource",
+            {k: v - avail.get(k, 0.0) for k, v in total.items()},
+        )
+        objs = summary.get("objects", {})
+        g["objects"].set(objs.get("total", 0))
+        g["object_bytes"].set(objs.get("total_bytes", 0))
+        g["spilled"].set(objs.get("spilled_bytes", 0))
+
+    def _loop() -> None:
+        while not _core_stop.wait(interval_s):
+            try:
+                _sample_once()
+            except Exception:
+                # head shutting down / not initialized: keep polling; the
+                # sampler must never take the process down
+                pass
+
+    try:
+        _sample_once()
+    except Exception:
+        pass
+    _core_thread = threading.Thread(
+        target=_loop, name="core-metrics", daemon=True
+    )
+    _core_thread.start()
+
+
+def stop_core_metrics() -> None:
+    global _core_thread
+    t = _core_thread
+    _core_stop.set()
+    _core_thread = None
+    if t is not None:
+        # join before a restart can clear the event, or the old sampler
+        # (mid-sample when the flag flipped) keeps running alongside the new
+        t.join(timeout=10.0)

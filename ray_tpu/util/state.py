@@ -124,6 +124,9 @@ def summarize_objects() -> dict:
         "total_bytes": sum(o["size"] or 0 for o in objs),
         "ready": sum(1 for o in objs if o["ready"]),
         "pinned": sum(1 for o in objs if o["pins"]),
+        "spilled_bytes": sum(
+            o["size"] or 0 for o in objs if o.get("where") == "spilled"
+        ),
     }
 
 

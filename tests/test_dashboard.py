@@ -84,3 +84,79 @@ def test_unknown_route_404(dash):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(dash, "/api/nope")
     assert e.value.code == 404
+
+
+def test_static_spa_assets(dash):
+    """The SPA is served from _dashboard_static/ (hand-written, no build)."""
+    ctype, body = _get(dash, "/")
+    assert "text/html" in ctype and b"/app.js" in body
+    ctype, body = _get(dash, "/app.js")
+    assert "javascript" in ctype
+    # every state-API entity has a view in the app (VERDICT r4 #5)
+    for needle in (b"nodes", b"actors", b"tasks", b"objects", b"placement_groups",
+                   b"jobs", b"timeline", b"flamegraph", b"metrics", b"worker_stacks",
+                   b"filterState"):
+        assert needle in body, needle
+    ctype, body = _get(dash, "/style.css")
+    assert "css" in ctype and b"--accent" in body
+
+
+def test_core_metrics_sampled(dash):
+    """dashboard.start() launches the core-series sampler; /metrics then
+    carries the runtime gauges the Grafana board charts."""
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    from ray_tpu.util import metrics as um
+
+    um.start_core_metrics(interval_s=0.2)
+    import time
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        um.flush()
+        _, body = _get(dash, "/metrics")
+        if b"ray_tpu_core_nodes" in body and b"ray_tpu_core_resource_total" in body:
+            break
+        time.sleep(0.3)
+    assert b"ray_tpu_core_nodes" in body
+    assert b"ray_tpu_core_resource_total" in body
+
+
+def test_grafana_dashboard_json(dash):
+    """Generated board imports cleanly: valid JSON with schemaVersion,
+    templated prometheus datasource, and one panel per core series."""
+    _, body = _get(dash, "/api/grafana")
+    board = json.loads(body)
+    assert board["uid"] and board["schemaVersion"] >= 30
+    assert board["templating"]["list"][0]["type"] == "datasource"
+    titles = [p["title"] for p in board["panels"]]
+    assert "Tasks by state" in titles and "Alive nodes" in titles
+    for p in board["panels"]:
+        assert p["type"] == "timeseries"
+        assert p["targets"][0]["expr"].startswith("ray_tpu_")
+        assert "gridPos" in p and "id" in p
+
+    # CLI writer round-trips
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+        r = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "grafana", "-o", tf.name],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        with open(tf.name) as f:
+            assert json.load(f)["uid"] == board["uid"]
+
+
+def test_logs_endpoint_shape(dash):
+    _, body = _get(dash, "/api/logs?job_id=nope")
+    data = json.loads(body)
+    assert "logs" in data and data["job_id"] == "nope"
