@@ -66,20 +66,23 @@ def main():
     on_tpu = "tpu" in str(getattr(dev, "platform", "")).lower()
     if on_tpu:
         # 406M-param GPT, bf16, Pallas flash attention (1024x1024 blocks),
-        # fused blockwise cross-entropy with LANE-ALIGNED chunks (vocab
-        # 50304 -> 3 chunks of 16768; the old power-of-two auto-pick's
-        # 1572-wide chunks padded on the MXU, ~1% whole-step cost), remat
-        # policy "attn" (keeps only flash out+lse; at batch 24 the extra
-        # HBM of "big" loses to the larger batch). Round-4 sweep on v5e,
-        # honest host-transfer barrier, median-of-3: batch 24 attn 0.423 >
-        # 24 big 0.418 > 16 big 0.412 (round-3 config) > 24 dots 0.39;
-        # bwd blocks 512/256, scan unroll 2/4, XLA attention, bf16 adam
-        # moments, batches 28/32, and no-remat (OOM <= batch 8) all lose.
+        # fused cross-entropy with ONE full-width pass (ce_chunks=1: at
+        # this shape the (tokens,vocab) fp32 transient fits and beats the
+        # lane-aligned 3-chunk streaming by ~1 MFU point), remat policy
+        # "attn" (keeps only flash out+lse), batch 26. Round-5 sweep on
+        # v5e (honest host-transfer barrier, best-of-2 triage windows,
+        # winners confirmed median-of-3): 26/attn/ce1 0.431-0.435 >
+        # 24/attn/ce1 0.423-0.426 > 24/attn/ce3 0.410-0.414 (round-4
+        # config) > 27 or 28/ce1, big@16-20/ce1, attn_qkv (new policy —
+        # saving qkv LOSES, extra HBM reads beat the matmul saved),
+        # CE_SAVE_LOGITS (no win: XLA overlaps the recompute), fwd flash
+        # blocks 512, bwd 512, scan unroll 2, 6-step fused lax.scan loop
+        # (same as per-step dispatch: the tunnel pipeline isn't the gap).
         cfg = GPTConfig(
             vocab_size=50_304, seq_len=1024, d_model=1024, n_layers=24, n_heads=16,
-            remat_policy="attn",
+            remat_policy="attn", ce_chunks=1,
         )
-        batch = 24
+        batch = 26
         steps = 8
     else:  # smoke config for CPU-only environments
         cfg = GPTConfig(vocab_size=1024, seq_len=128, d_model=128, n_layers=2, n_heads=4)
@@ -144,6 +147,12 @@ def main():
     if fit:
         detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
         detail["gptj_6b_fit"] = fit
+    if on_tpu:
+        # free the 406M training state BEFORE the 6B forward needs its HBM
+        del state, tokens
+        silicon = _gptj_6b_silicon()
+        if silicon:
+            detail.update(silicon)
     print(
         json.dumps(
             {
@@ -194,6 +203,79 @@ def _core_microbench() -> dict:
         return {}
     except Exception as e:
         print(f"[bench] core microbench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _gptj_6b_silicon() -> dict:
+    """GPT-J-6B on the real chip (VERDICT r4 #4): a full bf16 forward at
+    seq 2048 and a short KV-cache greedy decode, with the true GPT-J
+    architecture (models/gptj.py — the HF-checkpoint-import target whose
+    conversion is logit-exact, test_train_integrations.py::TestGPTJ).
+    Weights are seeded-random AT THE 6B SHAPE, generated directly on
+    device in bf16 (12.1 GiB — real checkpoint bytes cannot enter this
+    zero-egress environment, and the arithmetic is weight-value-
+    independent). Failure costs only these fields, never the headline."""
+    import gc
+
+    gc.collect()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gptj import (
+            GPTJConfig,
+            gptj_decode,
+            gptj_forward,
+            gptj_init,
+        )
+
+        cfg = GPTJConfig(
+            vocab_size=50_432,  # HF 50400 padded to the MXU lane multiple
+            remat=False,  # inference: no backward to rematerialize for
+            dtype="bfloat16",
+        )
+
+        def init_bf16():
+            p = gptj_init(jax.random.PRNGKey(7), cfg)
+            return jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+
+        params = jax.jit(init_bf16)()  # generated on-device: no 24 GB host tree
+        jax.block_until_ready(params)
+        n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+        fwd = jax.jit(lambda p, t: gptj_forward(cfg, p, t))
+        tokens = jnp.asarray(
+            jax.random.randint(jax.random.PRNGKey(8), (1, 2048), 0, 50_400),
+            jnp.int32,
+        )
+        logits = fwd(params, tokens)
+        float(jnp.ravel(logits)[0])  # compile + transfer barrier
+        dts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            logits = fwd(params, tokens)
+            float(jnp.ravel(logits)[0])
+            dts.append(time.perf_counter() - t0)
+        fwd_tok_s = 2048 / sorted(dts)[1]
+
+        n_new = 16
+        dec = jax.jit(lambda p, t: gptj_decode(cfg, p, t, n_new))
+        prompt = tokens[:, :128]
+        out = dec(params, prompt)
+        int(out[0, -1])
+        t0 = time.perf_counter()
+        out = dec(params, prompt)
+        int(out[0, -1])
+        dec_tok_s = n_new / (time.perf_counter() - t0)
+        return {
+            "gptj_6b_params": n_params,
+            "gptj_6b_forward_tokens_per_sec": round(fwd_tok_s, 1),
+            "gptj_6b_decode_tokens_per_sec": round(dec_tok_s, 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        import sys
+
+        print(f"[bench] gptj 6b silicon failed: {e!r}", file=sys.stderr)
         return {}
 
 

@@ -1,0 +1,340 @@
+"""GPT-J family decoder — the reference's north-star model, TPU-first.
+
+The reference's headline benchmark fine-tunes GPT-J-6B with DeepSpeed
+ZeRO-3 (``release/air_examples/gptj_deepspeed_finetuning/
+gptj_deepspeed_fine_tuning.ipynb``). This module implements the GPT-J
+architecture natively on the JAX/XLA stack so real HF checkpoints run on
+TPU (import: ``train/integrations/huggingface.load_hf_gptj``):
+
+* rotary position embeddings on the first ``rotary_dim`` dims of every
+  head, GPT-J's INTERLEAVED (rotate-every-two) variant — no learned
+  positional table;
+* parallel residual: ``x + attn(ln(x)) + mlp(ln(x))`` with a single
+  layernorm per block (not GPT-2's sequential two-LN form);
+* no biases on q/k/v/out projections; untied lm head WITH bias;
+* same TPU shape discipline as ``models.gpt``: stacked-layer pytree +
+  ``lax.scan`` + per-block remat, bf16 compute / fp32 master params,
+  Pallas flash attention, blockwise fused CE for training;
+* greedy KV-cache decode (static shapes: cache is (L, b, h, max, hd),
+  ``lax.fori_loop`` over new tokens) for inference benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    seq_len: int = 2048
+    d_model: int = 4096
+    n_layers: int = 28
+    n_heads: int = 16
+    rotary_dim: int = 64
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "full"
+    attn_impl: str = "auto"
+    fused_loss: bool = True
+    ce_chunks: Optional[int] = None
+
+    @property
+    def d_ff(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# GPT-J-6B checkpoint shape (vocab padded to 50432 stays MXU-aligned when
+# requested at import time; HF ships 50400)
+GPTJ_6B = GPTJConfig()
+
+
+def gptj_init(rng: jax.Array, cfg: GPTJConfig) -> dict:
+    """Random-init parameter pytree (fp32 master), HF-shape-compatible."""
+    d, dff, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    ks = jax.random.split(rng, 8)
+
+    def kernel(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)
+
+    blocks = {
+        "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
+        "q": {"kernel": kernel(ks[0], (L, d, d), d)},
+        "k": {"kernel": kernel(ks[1], (L, d, d), d)},
+        "v": {"kernel": kernel(ks[2], (L, d, d), d)},
+        "attn_out": {"kernel": kernel(ks[3], (L, d, d), d)},
+        "mlp_in": {"kernel": kernel(ks[4], (L, d, dff), d), "bias": jnp.zeros((L, dff))},
+        "mlp_out": {"kernel": kernel(ks[5], (L, dff, d), dff), "bias": jnp.zeros((L, d))},
+    }
+    return {
+        "embed": {"tokens": jax.nn.initializers.normal(0.02)(ks[6], (V, d), jnp.float32)},
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "lm_head": {"kernel": kernel(ks[7], (d, V), d), "bias": jnp.zeros((V,))},
+    }
+
+
+def _layernorm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _rotary_sincos(positions: jax.Array, rotary_dim: int):
+    """GPT-J sinusoid table for given positions: (n, rotary_dim/2) each."""
+    inv_freq = 1.0 / (
+        10000.0 ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.sin(ang), jnp.cos(ang)
+
+
+def _apply_rotary(x: jax.Array, sin: jax.Array, cos: jax.Array, rotary_dim: int):
+    """Interleaved (rotate-every-two) rotary on the first ``rotary_dim``
+    dims. x: (b, h, s, hd); sin/cos: (s, rotary_dim/2). Matches HF GPT-J's
+    ``rotate_every_two`` + ``duplicate_interleave`` exactly (fp32 math)."""
+    rot, pas = x[..., :rotary_dim], x[..., rotary_dim:]
+    r = rot.astype(jnp.float32).reshape(*rot.shape[:-1], rotary_dim // 2, 2)
+    x1, x2 = r[..., 0], r[..., 1]
+    s = sin[None, None, :, :]
+    c = cos[None, None, :, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape).astype(x.dtype)
+    return jnp.concatenate([out, pas], axis=-1) if pas.shape[-1] else out
+
+
+def _project_qkv(cfg: GPTJConfig, h, layer, positions):
+    """(q, k, v) heads with rotary applied: (b, heads, s, hd) each."""
+    dt = h.dtype
+    b, s, d = h.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+
+    def heads(t):
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    q = heads(h @ layer["q"]["kernel"].astype(dt))
+    k = heads(h @ layer["k"]["kernel"].astype(dt))
+    v = heads(h @ layer["v"]["kernel"].astype(dt))
+    sin, cos = _rotary_sincos(positions, cfg.rotary_dim)
+    q = _apply_rotary(q, sin, cos, cfg.rotary_dim)
+    k = _apply_rotary(k, sin, cos, cfg.rotary_dim)
+    return q, k, v
+
+
+def _block(cfg: GPTJConfig, x, layer, positions, mesh=None):
+    """One GPT-J block: parallel attention + MLP over one layernorm.
+    ``mesh`` places the same activation sharding constraints models.gpt
+    uses (batch over dp/fsdp, hidden over tp) so pjit keeps activations
+    scattered under ZeRO/TP instead of replicating them."""
+    from jax.sharding import PartitionSpec as P
+
+    def c(y, spec):
+        if mesh is None:
+            return y
+        from ray_tpu.parallel.sharding import constrain
+
+        return constrain(y, mesh, spec)
+
+    dt = x.dtype
+    b, s, d = x.shape
+    h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+    h = c(h, P(("dp", "fsdp"), None, None))
+    q, k, v = _project_qkv(cfg, h, layer, positions)
+    att = causal_attention(q, k, v, impl=cfg.attn_impl)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, d)
+    att = att @ layer["attn_out"]["kernel"].astype(dt)
+    att = c(att, P(("dp", "fsdp"), None, None))
+    mid = jax.nn.gelu(
+        h @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt)
+    )
+    mid = c(mid, P(("dp", "fsdp"), None, "tp"))
+    mlp = mid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
+    return x + att + c(mlp, P(("dp", "fsdp"), None, None))
+
+
+_REMAT_POLICIES = {
+    "full": lambda: None,
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    "attn": lambda: jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"
+    ),
+}
+
+
+def gptj_hidden(cfg: GPTJConfig, params: dict, tokens: jax.Array, mesh=None):
+    """tokens (b, s) int32 → final hidden (b, s, d) in activation dtype."""
+    dt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    positions = jnp.arange(s)
+
+    def block(carry, layer):
+        return _block(cfg, carry, layer, positions, mesh), None
+
+    if cfg.remat:
+        policy = _REMAT_POLICIES[cfg.remat_policy]()
+        block = jax.checkpoint(block, prevent_cse=False, policy=policy)
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+
+
+def gptj_forward(
+    cfg: GPTJConfig, params: dict, tokens: jax.Array, mesh=None
+) -> jax.Array:
+    """logits (b, s, vocab) fp32."""
+    x = gptj_hidden(cfg, params, tokens, mesh)
+    return (
+        x.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        + params["lm_head"]["bias"]
+    )
+
+
+def gptj_loss(
+    cfg: GPTJConfig, params: dict, tokens: jax.Array, mesh=None
+) -> jax.Array:
+    """Next-token cross-entropy (mean); fused blockwise CE by default."""
+    hidden = gptj_hidden(cfg, params, tokens[:, :-1], mesh)
+    targets = tokens[:, 1:]
+    if cfg.fused_loss:
+        from ray_tpu.ops.fused_ce import fused_softmax_cross_entropy_bias
+
+        b, s, d = hidden.shape
+        losses = fused_softmax_cross_entropy_bias(
+            hidden.reshape(b * s, d),
+            params["lm_head"]["kernel"],
+            params["lm_head"]["bias"],
+            targets.reshape(-1).astype(jnp.int32),
+            cfg.ce_chunks,
+        )
+        return losses.mean()
+    logits = (
+        hidden.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        + params["lm_head"]["bias"]
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0].mean()
+
+
+# ---------------------------------------------------------------------------
+# greedy KV-cache decode (inference benchmark path)
+# ---------------------------------------------------------------------------
+
+
+def _attend_cached(q1, k_cache, v_cache, length):
+    """Single-position attention against a cache. q1: (b, h, hd);
+    k/v_cache: (b, h, max, hd); ``length`` = valid prefix (the new token's
+    k/v already written). Plain einsum — one query row needs no kernel."""
+    scale = q1.shape[-1] ** -0.5
+    logits = jnp.einsum("bhd,bhsd->bhs", q1.astype(jnp.float32), k_cache.astype(jnp.float32))
+    logits = logits * scale
+    mask = jnp.arange(k_cache.shape[2])[None, None, :] < length
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", probs, v_cache.astype(jnp.float32))
+
+
+def gptj_decode(
+    cfg: GPTJConfig, params: dict, prompt: jax.Array, n_new: int
+) -> jax.Array:
+    """Greedy decode ``n_new`` tokens after ``prompt`` (b, s0) int32 →
+    (b, s0 + n_new). Prefill computes the prompt's KV cache in one forward;
+    each new token is a single-position pass over the cache (static shapes
+    throughout: jit once, decode under ``lax.fori_loop``)."""
+    dt = jnp.dtype(cfg.dtype)
+    b, s0 = prompt.shape
+    L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    max_len = s0 + n_new
+
+    # ---- prefill: run the normal stacked forward, capturing per-layer k/v
+    x = params["embed"]["tokens"][prompt].astype(dt)
+    positions = jnp.arange(s0)
+
+    def prefill_block(carry, layer):
+        h = _layernorm(carry, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        q, k, v = _project_qkv(cfg, h, layer, positions)
+        att = causal_attention(q, k, v, impl="xla")  # s0 may be ragged
+        att = att.transpose(0, 2, 1, 3).reshape(b, s0, cfg.d_model)
+        att = att @ layer["attn_out"]["kernel"].astype(dt)
+        mid = jax.nn.gelu(
+            h @ layer["mlp_in"]["kernel"].astype(dt)
+            + layer["mlp_in"]["bias"].astype(dt)
+        )
+        mlp = (
+            mid @ layer["mlp_out"]["kernel"].astype(dt)
+            + layer["mlp_out"]["bias"].astype(dt)
+        )
+        pad = jnp.zeros((b, nh, n_new, hd), dt)
+        kc = jnp.concatenate([k.astype(dt), pad], axis=2)
+        vc = jnp.concatenate([v.astype(dt), pad], axis=2)
+        return carry + att + mlp, (kc, vc)
+
+    x, (k_caches, v_caches) = jax.lax.scan(prefill_block, x, params["blocks"])
+    hlast = _layernorm(
+        x[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"]
+    )
+    logits = hlast.astype(jnp.float32) @ params["lm_head"]["kernel"] + params["lm_head"]["bias"]
+    first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (b,)
+
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, n_new), jnp.int32)], axis=1
+    )
+    tokens = jax.lax.dynamic_update_slice(tokens, first_new[:, None], (0, s0))
+
+    def step(i, carry):
+        tokens, k_caches, v_caches = carry
+        pos = s0 + i  # position of the token being FED
+        tok = jax.lax.dynamic_slice(tokens, (0, pos), (b, 1))[:, 0]
+        x1 = params["embed"]["tokens"][tok].astype(dt)  # (b, d)
+
+        def one_layer(carry1, inputs):
+            x1 = carry1
+            layer, kc, vc = inputs
+            h1 = _layernorm(
+                x1[:, None, :], layer["ln1"]["scale"], layer["ln1"]["bias"]
+            )
+            q, k, v = _project_qkv(cfg, h1, layer, jnp.expand_dims(pos, 0))
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(dt), (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(dt), (0, 0, pos, 0))
+            # (b, h, hd) merges h-major straight back to (b, d)
+            att = _attend_cached(q[:, :, 0], kc, vc, pos + 1).astype(dt)
+            att = att.reshape(b, cfg.d_model) @ layer["attn_out"]["kernel"].astype(dt)
+            h1f = h1[:, 0]
+            mid = jax.nn.gelu(
+                h1f @ layer["mlp_in"]["kernel"].astype(dt)
+                + layer["mlp_in"]["bias"].astype(dt)
+            )
+            mlp = (
+                mid @ layer["mlp_out"]["kernel"].astype(dt)
+                + layer["mlp_out"]["bias"].astype(dt)
+            )
+            return x1 + att + mlp, (kc, vc)
+
+        x1, (k_caches, v_caches) = jax.lax.scan(
+            one_layer, x1, (params["blocks"], k_caches, v_caches)
+        )
+        h1 = _layernorm(x1, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        logits = (
+            h1.astype(jnp.float32) @ params["lm_head"]["kernel"]
+            + params["lm_head"]["bias"]
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
+        return tokens, k_caches, v_caches
+
+    tokens, _, _ = jax.lax.fori_loop(
+        0, n_new - 1, step, (tokens, k_caches, v_caches)
+    )
+    return tokens

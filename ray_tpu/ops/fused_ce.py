@@ -18,6 +18,12 @@ Residuals are just ``x`` and the ``(N,)`` logsumexp — the flash-attention
 trick applied to the classifier head (same decomposition as the reference's
 fused/chunked losses, e.g. megatron's vocab-parallel cross entropy; built
 here as a jittable lax.scan so XLA tiles the chunk matmuls onto the MXU).
+
+One shared implementation serves both public entry points:
+``fused_softmax_cross_entropy`` (GPT-2-family heads, no bias) and
+``fused_softmax_cross_entropy_bias`` (GPT-J's biased untied head) — the
+bias threads through as an optional static presence, so numeric fixes land
+once.
 """
 
 from __future__ import annotations
@@ -59,44 +65,42 @@ def _pick_chunks(vocab: int, n_chunks: int | None) -> int:
     return k
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_softmax_cross_entropy(x, w, targets, n_chunks=None):
-    """Per-token cross-entropy ``logsumexp(x@w) - (x@w)[target]``.
+def _save_logits() -> bool:
+    """Opt-in residual mode (RAY_TPU_CE_SAVE_LOGITS=1): keep the bf16
+    logits from the forward and skip the backward's recompute matmul — one
+    lm-head matmul fewer per step for one (N, V) activation-dtype tensor of
+    HBM (~2.7 GB at the 406M bench shape). Worth it only when the batch
+    leaves that much headroom; the default streams with O(N) residuals."""
+    import os
 
-    Args:
-      x: ``(N, d)`` activations (bf16 recommended; matmuls run in ``x.dtype``
-        with fp32 accumulation).
-      w: ``(d, V)`` classifier weights (cast to ``x.dtype`` for the matmul).
-      targets: ``(N,)`` int32 class ids.
-      n_chunks: vocab chunk count (must divide V); None = auto.
-
-    Returns:
-      ``(N,)`` fp32 per-token losses. ``jnp.mean`` of this equals the naive
-      ``-log_softmax(x @ w)[target]`` mean up to input-dtype rounding.
-    """
-    losses, _ = _forward(x, w, targets, _pick_chunks(w.shape[1], n_chunks))
-    return losses
+    return os.environ.get("RAY_TPU_CE_SAVE_LOGITS") == "1"
 
 
-def _chunk_logits(x, w, k, chunk):
-    """fp32 logits for vocab chunk k, computed in x.dtype on the MXU."""
+def _chunk_logits(x, w, k, chunk, b32):
+    """fp32 logits for vocab chunk k (plus optional bias slice), computed
+    in x.dtype on the MXU."""
     wc = jax.lax.dynamic_slice_in_dim(w, k * chunk, chunk, axis=1)
-    return jax.lax.dot_general(
+    logits = jax.lax.dot_general(
         x,
         wc.astype(x.dtype),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if b32 is not None:
+        logits = logits + jax.lax.dynamic_slice_in_dim(b32, k * chunk, chunk)[None, :]
+    return logits
 
 
-def _forward(x, w, targets, n_chunks):
+def _ce_forward(x, w, b, targets, n_chunks):
+    """Shared streaming forward. ``b`` may be None. Returns (losses, lse)."""
     n, d = x.shape
     v = w.shape[1]
     chunk = v // n_chunks
+    b32 = None if b is None else b.astype(jnp.float32)
 
     def body(carry, k):
         m, s, tl = carry
-        logits = _chunk_logits(x, w, k, chunk)            # (N, chunk) fp32
+        logits = _chunk_logits(x, w, k, chunk, b32)       # (N, chunk) fp32
         cmax = logits.max(axis=-1)
         m_new = jnp.maximum(m, cmax)
         s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
@@ -119,28 +123,63 @@ def _forward(x, w, targets, n_chunks):
     return lse - tl, lse
 
 
-def _fwd(x, w, targets, n_chunks):
-    losses, lse = _forward(x, w, targets, _pick_chunks(w.shape[1], n_chunks))
-    return losses, (x, w, targets, lse)
+def _ce_fwd(x, w, b, targets, n_chunks):
+    """Shared custom-VJP forward. Residual logits16 is non-None only in
+    save-logits mode (one (N, V) bf16 tensor buys the backward's matmul)."""
+    if _save_logits():
+        logits16 = jax.lax.dot_general(
+            x, w.astype(x.dtype), (((1,), (0,)), ((), ()))
+        )  # (N, V) in activation dtype
+        logits = logits16.astype(jnp.float32)
+        if b is not None:
+            logits = logits + b.astype(jnp.float32)[None, :]
+        m = logits.max(axis=-1)
+        lse = m + jnp.log(jnp.exp(logits - m[:, None]).sum(axis=-1))
+        tl = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+        return lse - tl, (x, w, b, targets, lse, logits16)
+    losses, lse = _ce_forward(x, w, b, targets, _pick_chunks(w.shape[1], n_chunks))
+    return losses, (x, w, b, targets, lse, None)
 
 
-def _bwd(n_chunks, res, g):
-    x, w, targets, lse = res
+def _ce_bwd(n_chunks, res, g):
+    """Shared backward: (dx, dw, db-or-None)."""
+    x, w, b, targets, lse, logits16 = res
     n, d = x.shape
     v = w.shape[1]
+    if logits16 is not None:
+        logits = logits16.astype(jnp.float32)
+        if b is not None:
+            logits = logits + b.astype(jnp.float32)[None, :]
+        p = jnp.exp(logits - lse[:, None])
+        onehot = jax.nn.one_hot(targets, v, dtype=jnp.float32)
+        dl32 = (p - onehot) * g[:, None]
+        dlogits = dl32.astype(x.dtype)
+        dx = jax.lax.dot_general(
+            dlogits, w.astype(x.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dw = jax.lax.dot_general(
+            x, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db = None if b is None else dl32.sum(axis=0).astype(b.dtype)
+        return dx.astype(x.dtype), dw.astype(w.dtype), db
     k_chunks = _pick_chunks(v, n_chunks)
     chunk = v // k_chunks
+    b32 = None if b is None else b.astype(jnp.float32)
+    with_bias = b is not None
 
     def body(carry, k):
-        dx, dw = carry
-        logits = _chunk_logits(x, w, k, chunk)            # recompute (N, chunk)
+        dx, dw, db = carry
+        logits = _chunk_logits(x, w, k, chunk, b32)       # recompute (N, chunk)
         p = jnp.exp(logits - lse[:, None])                # softmax chunk
         local = targets - k * chunk
         in_chunk = (local >= 0) & (local < chunk)
         onehot = (
             local[:, None] == jnp.arange(chunk, dtype=targets.dtype)[None, :]
         ) & in_chunk[:, None]
-        dlogits = ((p - onehot.astype(jnp.float32)) * g[:, None]).astype(x.dtype)
+        dl32 = (p - onehot.astype(jnp.float32)) * g[:, None]
+        dlogits = dl32.astype(x.dtype)
         wc = jax.lax.dynamic_slice_in_dim(w, k * chunk, chunk, axis=1)
         dx = dx + jax.lax.dot_general(
             dlogits,
@@ -155,11 +194,78 @@ def _bwd(n_chunks, res, g):
             preferred_element_type=jnp.float32,
         )
         dw = jax.lax.dynamic_update_slice_in_dim(dw, dwc, k * chunk, axis=1)
-        return (dx, dw), None
+        if with_bias:
+            db = jax.lax.dynamic_update_slice_in_dim(
+                db, dl32.sum(axis=0), k * chunk, axis=0
+            )
+        return (dx, dw, db), None
 
-    init = (jnp.zeros((n, d), jnp.float32), jnp.zeros((d, v), jnp.float32))
-    (dx, dw), _ = jax.lax.scan(body, init, jnp.arange(k_chunks))
-    return dx.astype(x.dtype), dw.astype(w.dtype), None
+    init = (
+        jnp.zeros((n, d), jnp.float32),
+        jnp.zeros((d, v), jnp.float32),
+        jnp.zeros((v,), jnp.float32),
+    )
+    (dx, dw, db), _ = jax.lax.scan(body, init, jnp.arange(k_chunks))
+    return (
+        dx.astype(x.dtype),
+        dw.astype(w.dtype),
+        db.astype(b.dtype) if with_bias else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# public entry points (two custom_vjps, one implementation)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_softmax_cross_entropy(x, w, targets, n_chunks=None):
+    """Per-token cross-entropy ``logsumexp(x@w) - (x@w)[target]``.
+
+    Args:
+      x: ``(N, d)`` activations (bf16 recommended; matmuls run in ``x.dtype``
+        with fp32 accumulation).
+      w: ``(d, V)`` classifier weights (cast to ``x.dtype`` for the matmul).
+      targets: ``(N,)`` int32 class ids.
+      n_chunks: vocab chunk count (must divide V); None = auto.
+
+    Returns:
+      ``(N,)`` fp32 per-token losses. ``jnp.mean`` of this equals the naive
+      ``-log_softmax(x @ w)[target]`` mean up to input-dtype rounding.
+    """
+    losses, _ = _ce_forward(x, w, None, targets, _pick_chunks(w.shape[1], n_chunks))
+    return losses
+
+
+def _fwd(x, w, targets, n_chunks):
+    losses, (x, w, _b, targets, lse, logits16) = _ce_fwd(x, w, None, targets, n_chunks)
+    return losses, (x, w, targets, lse, logits16)
+
+
+def _bwd(n_chunks, res, g):
+    x, w, targets, lse, logits16 = res
+    dx, dw, _db = _ce_bwd(n_chunks, (x, w, None, targets, lse, logits16), g)
+    return dx, dw, None
 
 
 fused_softmax_cross_entropy.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_softmax_cross_entropy_bias(x, w, b, targets, n_chunks=None):
+    """``fused_softmax_cross_entropy`` with a differentiable (V,) logit
+    bias (GPT-J's untied lm head): loss = logsumexp(x@w + b) - (x@w + b)[t]."""
+    losses, _ = _ce_forward(x, w, b, targets, _pick_chunks(w.shape[1], n_chunks))
+    return losses
+
+
+def _fwd_bias(x, w, b, targets, n_chunks):
+    return _ce_fwd(x, w, b, targets, n_chunks)
+
+
+def _bwd_bias(n_chunks, res, g):
+    dx, dw, db = _ce_bwd(n_chunks, res, g)
+    return dx, dw, db, None
+
+
+fused_softmax_cross_entropy_bias.defvjp(_fwd_bias, _bwd_bias)

@@ -22,32 +22,43 @@ import functools
 from typing import Optional
 
 
-def fit_report(cfg, n_devices: int = 8, batch: int = 8) -> dict:
+def fit_report(cfg, n_devices: int = 8, batch: int = 8, model: str = "gpt") -> dict:
     """AOT-compile ``cfg``'s train step under fsdp-``n_devices`` from
-    abstract values only; return XLA's per-device memory analysis."""
+    abstract values only; return XLA's per-device memory analysis.
+    ``model`` picks the architecture: "gpt" (models.gpt) or "gptj" — the
+    true GPT-J parallel-block/rotary tree that ``load_hf_gptj`` imports."""
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from ray_tpu.models.gpt import gpt_init, gpt_loss
     from ray_tpu.parallel.mesh import MeshConfig, make_mesh
     from ray_tpu.parallel.sharding import batch_spec, param_sharding_rules
     from ray_tpu.parallel.train_step import TrainState, _opt_shardings, build_train_step
+
+    if model == "gptj":
+        from ray_tpu.models.gptj import gptj_init as init_model
+        from ray_tpu.models.gptj import gptj_loss
+
+        def model_loss(cfg, params, tokens, mesh):
+            return gptj_loss(cfg, params, tokens, mesh)
+    else:
+        from ray_tpu.models.gpt import gpt_init as init_model
+        from ray_tpu.models.gpt import gpt_loss as model_loss
 
     mesh = make_mesh(MeshConfig(dp=1, fsdp=n_devices, tp=1, sp=1))
     optimizer = optax.adamw(1e-4)
 
     def loss_fn(params, tokens):
-        return gpt_loss(cfg, params, tokens, mesh)
+        return model_loss(cfg, params, tokens, mesh)
 
     _, step_fn = build_train_step(loss_fn, optimizer, mesh)
 
     # abstract state with the REAL shardings attached — eval_shape never
     # allocates the 24 GB of fp32 master weights
     params_abs = jax.eval_shape(
-        functools.partial(gpt_init, cfg=cfg), jax.random.PRNGKey(0)
+        functools.partial(init_model, cfg=cfg), jax.random.PRNGKey(0)
     )
     p_specs = param_sharding_rules(params_abs)
     params_sds = jax.tree_util.tree_map(
@@ -117,17 +128,19 @@ def gptj_6b_fit_report(
     remat_policy: str = "full",
     seq_len: int = 2048,
 ) -> dict:
-    from ray_tpu.models.gpt import GPTConfig
+    """Fit proof of the TRUE GPT-J-6B architecture (models.gptj — the tree
+    ``load_hf_gptj`` imports from a real HF checkpoint): rotary, parallel
+    residual, no-bias projections, untied biased head."""
+    from ray_tpu.models.gptj import GPTJConfig
 
-    cfg = GPTConfig(
+    cfg = GPTJConfig(
         vocab_size=50_432,  # GPT-J's 50400 padded to the lane multiple
         seq_len=seq_len,
-        d_model=4096,
-        n_layers=28,
-        n_heads=16,
         remat_policy=remat_policy,
     )
-    return fit_report(cfg, n_devices=n_devices, batch=batch)
+    out = fit_report(cfg, n_devices=n_devices, batch=batch, model="gptj")
+    out["architecture"] = "gptj"
+    return out
 
 
 def main() -> None:  # pragma: no cover - exercised via bench.py subprocess

@@ -41,6 +41,9 @@ _RULES = [
     (r"blocks/moe_in/kernel$", P(None, "ep", "fsdp", "tp")),   # (L, E, d, 4d)
     (r"blocks/moe_out/kernel$", P(None, "ep", "tp", "fsdp")),  # (L, E, 4d, d)
     (r"blocks/ln\d/(scale|bias)$", P(None, None)),
+    # GPT-J tree (models.gptj): separate no-bias q/k/v, biased lm head
+    (r"blocks/[qkv]/kernel$", P(None, "fsdp", "tp")),      # (L, d, d)
+    (r"lm_head/bias$", P("fsdp")),                # (vocab,)
     (r"ln_f/(scale|bias)$", P()),  # rank-1 (d,) — replicate
     (r"lm_head/kernel$", P("tp", "fsdp")),        # (d_model, vocab)
 ]

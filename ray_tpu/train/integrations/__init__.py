@@ -10,7 +10,9 @@ JAX training stack.
 
 from ray_tpu.train.integrations.huggingface import (  # noqa: F401
     gpt_config_from_hf,
+    gptj_config_from_hf,
     load_hf_gpt2,
+    load_hf_gptj,
 )
 from ray_tpu.train.integrations.orbax import (  # noqa: F401
     load_pytree_checkpoint,

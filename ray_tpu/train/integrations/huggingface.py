@@ -112,3 +112,110 @@ def load_hf_gpt2(
 
     params = jax.tree.map(jnp.asarray, params)
     return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# GPT-J (the reference's north-star model: release/air_examples/
+# gptj_deepspeed_finetuning). HF GPTJForCausalLM -> models.gptj pytree.
+# ---------------------------------------------------------------------------
+
+
+def gptj_config_from_hf(hf_config: Any, **overrides):
+    """Build a ``GPTJConfig`` from a ``transformers.GPTJConfig``."""
+    from ray_tpu.models.gptj import GPTJConfig
+
+    fields = dict(
+        vocab_size=int(hf_config.vocab_size),
+        seq_len=int(hf_config.n_positions),
+        d_model=int(hf_config.n_embd),
+        n_layers=int(hf_config.n_layer),
+        n_heads=int(hf_config.n_head),
+        # HF's fallback for rotary_dim=None is rotary over the FULL head —
+        # the per-head dim, never n_embd (which would crash _apply_rotary)
+        rotary_dim=int(
+            getattr(hf_config, "rotary_dim", None)
+            or hf_config.n_embd // hf_config.n_head
+        ),
+    )
+    fields.update(overrides)
+    return GPTJConfig(**fields)
+
+
+def load_hf_gptj(
+    model_or_state_dict: Any,
+    cfg=None,
+    pad_vocab_to_multiple: int = 1,
+):
+    """Convert a ``transformers`` GPT-J model (or state dict) into
+    ``(GPTJConfig, params)`` for ``ray_tpu.models.gptj``.
+
+    Orientation: HF GPT-J projections are ``nn.Linear`` storing (out, in) —
+    every kernel transposes to the (in, out) matmul layout here (GPT-2's
+    Conv1D did not need this). No q/k/v/out biases (GPT-J has none); the
+    untied lm_head keeps its bias. ``pad_vocab_to_multiple=128`` zero-pads
+    vocab rows for MXU lane alignment (50400 -> 50432); padded logits get a
+    -1e9 head bias so greedy decode can never emit a padded id.
+    """
+    if hasattr(model_or_state_dict, "state_dict"):
+        sd = model_or_state_dict.state_dict()
+        if cfg is None and hasattr(model_or_state_dict, "config"):
+            cfg = gptj_config_from_hf(model_or_state_dict.config)
+    else:
+        sd = dict(model_or_state_dict)
+    if cfg is None:
+        raise ValueError("pass cfg= or a model with .config")
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    def get(name):
+        return _np(sd[prefix + name])
+
+    wte = get("wte.weight")
+    vocab, d = wte.shape
+    lm_w = _np(sd["lm_head.weight"]).T          # (vocab, d) -> (d, vocab)
+    lm_b = _np(sd["lm_head.bias"]) if "lm_head.bias" in sd else np.zeros(
+        (vocab,), np.float32
+    )
+    if pad_vocab_to_multiple > 1:
+        target = -(-vocab // pad_vocab_to_multiple) * pad_vocab_to_multiple
+        if target != vocab:
+            import dataclasses
+
+            pad = target - vocab
+            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+            lm_w = np.concatenate([lm_w, np.zeros((d, pad), np.float32)], axis=1)
+            lm_b = np.concatenate([lm_b, np.full((pad,), -1e9, np.float32)])
+            cfg = dataclasses.replace(cfg, vocab_size=target)
+    L = cfg.n_layers
+
+    def stack(name, transpose=False):
+        mats = [_np(sd[f"{prefix}h.{i}.{name}"]) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return np.stack(mats)
+
+    blocks = {
+        "ln1": {"scale": stack("ln_1.weight"), "bias": stack("ln_1.bias")},
+        "q": {"kernel": stack("attn.q_proj.weight", transpose=True)},
+        "k": {"kernel": stack("attn.k_proj.weight", transpose=True)},
+        "v": {"kernel": stack("attn.v_proj.weight", transpose=True)},
+        "attn_out": {"kernel": stack("attn.out_proj.weight", transpose=True)},
+        "mlp_in": {
+            "kernel": stack("mlp.fc_in.weight", transpose=True),
+            "bias": stack("mlp.fc_in.bias"),
+        },
+        "mlp_out": {
+            "kernel": stack("mlp.fc_out.weight", transpose=True),
+            "bias": stack("mlp.fc_out.bias"),
+        },
+    }
+    params = {
+        "embed": {"tokens": wte},
+        "blocks": blocks,
+        "ln_f": {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")},
+        "lm_head": {"kernel": lm_w, "bias": lm_b},
+    }
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree.map(jnp.asarray, params)
+    return cfg, params
