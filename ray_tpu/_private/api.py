@@ -74,13 +74,37 @@ def init(
 
         authkey = resolve_authkey()
         conn = connect_head(address, authkey)
-        conn.send(("register_driver", {}))
+        conn.send(
+            (
+                "register_driver",
+                {
+                    "namespace": namespace,
+                    "session_token": os.environ.get("RAY_TPU_SESSION_TOKEN"),
+                },
+            )
+        )
         kind, info = conn.recv()
         if kind != "driver_ack":
             raise rex.RayError(f"unexpected handshake reply {kind!r}")
         ctx = RemoteDriverContext(
-            conn, info["node_id"], authkey=authkey, head_host=address.rsplit(":", 1)[0]
+            conn,
+            info["node_id"],
+            authkey=authkey,
+            head_host=address.rsplit(":", 1)[0],
+            address=address,
+            session_token=info.get("session_token"),
         )
+        resumed_ns = info.get("namespace")
+        if namespace and resumed_ns and resumed_ns != namespace:
+            # a stale RAY_TPU_SESSION_TOKEN must not silently put the
+            # driver's named actors in the wrong namespace
+            ctx.shutdown()
+            raise rex.RayError(
+                f"session token resumed namespace {resumed_ns!r} but "
+                f"namespace={namespace!r} was requested; unset "
+                f"RAY_TPU_SESSION_TOKEN or drop the namespace argument"
+            )
+        ctx.namespace = resumed_ns or namespace or "default"
         runtime.set_ctx(ctx)
         atexit.register(_atexit_shutdown)
         return _context_info()
@@ -125,6 +149,8 @@ def init(
         res.setdefault("memory", _default_memory(object_store_memory))
         node_id = head.add_node(res, labels=labels)
     ctx = DriverContext(head, node_id.binary())
+    if namespace:
+        ctx.namespace = namespace
     runtime.set_ctx(ctx)
     _set_head(head)
     atexit.register(_atexit_shutdown)

@@ -139,6 +139,11 @@ class Config:
     #: restored detached actors for the same window before re-creating
     #: them fresh.
     head_reconnect_grace_s: float = 30.0
+    #: How long a disconnected ``ray://`` client session keeps its refs and
+    #: actors alive waiting for a reconnect-with-token before the head
+    #: releases them (reference: the client proxier's cleanup window,
+    #: ``util/client/server/proxier.py``).
+    client_reconnect_grace_s: float = 30.0
 
     # -- object data plane -------------------------------------------------
     #: Chunk size for node-to-node object transfers on the peer-to-peer

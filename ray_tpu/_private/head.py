@@ -277,12 +277,38 @@ class ActorState:
         self.restarts_left = create_spec.get("max_restarts", 0)
         self.max_task_retries = create_spec.get("max_task_retries", 0)
         self.name = create_spec.get("name")
+        # named actors are NAMESPACE-scoped (reference: ray namespaces —
+        # each ray:// client session gets an anonymous namespace unless it
+        # asks for one, so concurrent clients don't see each other's names)
+        self.namespace = create_spec.get("namespace") or "default"
         self.detached = create_spec.get("lifetime") == "detached"
         self.pending_calls: deque = deque()  # method specs queued while not ALIVE
         self.inflight: dict[bytes, dict] = {}  # task_id -> spec sent to worker
         self.num_handles = 1
         self.death_cause: Optional[str] = None
         self.alloc = None  # lifetime resource allocation (held until death)
+
+    @property
+    def named_key(self) -> Optional[str]:
+        return None if not self.name else f"{self.namespace}:{self.name}"
+
+
+class ClientSession:
+    """One ``ray://`` client's server-side state (reference: the client
+    proxier's per-client SpecificServer, ``util/client/server/proxier.py``).
+    Tracks what the client owns so a disconnect without reconnect releases
+    it: object refcounts taken on the client's behalf and actors it created.
+    ``disconnected_at`` arms the grace timer; a reconnect presenting the
+    session token disarms it and resumes with every ref intact."""
+
+    def __init__(self, token: str, namespace: str):
+        self.token = token
+        self.namespace = namespace
+        self.refs: dict[bytes, int] = {}
+        self.actors: set[bytes] = set()
+        self.conn = None
+        self.disconnected_at: Optional[float] = None
+        self.created_at = time.monotonic()
 
 
 # --------------------------------------------------------------------------
@@ -523,7 +549,11 @@ class Head:
         self.nodes: dict[bytes, NodeState] = {}
         self.node_order: list[bytes] = []
         self.actors: dict[bytes, ActorState] = {}
+        # named actors, keyed "namespace:name" (see ActorState.named_key)
         self.named_actors: dict[str, bytes] = {}
+        # ray:// client sessions by token (ClientSession); cleanup of a
+        # disconnected session happens in the health loop after the grace
+        self.client_sessions: dict[str, ClientSession] = {}
         self.placement_groups: dict[bytes, PlacementGroupState] = {}
         if self._snapshot_path:
             self._load_snapshot()  # after the tables above exist
@@ -690,6 +720,7 @@ class Head:
         measurably caps task throughput."""
         worker: Optional[WorkerHandle] = None
         agent_node: Optional[NodeID] = None
+        session: Optional[ClientSession] = None
         handover = False
         try:
             while not self._shutdown:
@@ -710,7 +741,7 @@ class Head:
                 elif kind == "register_agent":
                     agent_node = self._on_register_agent(conn, msg[1])
                 elif kind == "register_driver":
-                    conn.send(("driver_ack", {"node_id": self._any_node_id()}))
+                    session = self._on_register_driver(conn, msg[1])
                 elif kind == "agent_stats":
                     if agent_node is not None:
                         with self.lock:
@@ -721,6 +752,8 @@ class Head:
                     self._mailbox_post(msg[1]["req_id"], msg[1]["stacks"])
                 elif kind == "req":
                     _, seq, method, payload = msg
+                    if session is not None:
+                        self._session_track(session, method, payload)
                     self._dispatch_request(conn, worker, seq, method, payload, remote=remote)
         finally:
             # close OUR side whatever ended the loop (rejection, peer EOF,
@@ -730,6 +763,8 @@ class Head:
                 from ray_tpu._private.node_agent import shutdown_conn
 
                 shutdown_conn(conn)
+            if session is not None:
+                self._on_client_disconnect(session, conn)
             if worker is not None:
                 self._on_worker_disconnect(worker)
             if agent_node is not None:
@@ -902,6 +937,114 @@ class Head:
             while len(self._stacks_replies) > 64:
                 self._stacks_replies.pop(next(iter(self._stacks_replies)))
             self._stacks_cv.notify_all()
+
+    def _on_register_driver(self, conn, info: dict) -> ClientSession:
+        """A ``ray://`` client attached (reference: the proxier's per-client
+        server, ``util/client/server/proxier.py``). A presented session
+        token RESUMES that session — same namespace, every ref intact; a
+        fresh client gets a new token and an anonymous namespace unless it
+        asked for one (reference namespace semantics)."""
+        import uuid as _uuid
+
+        token = (info or {}).get("session_token")
+        with self.lock:
+            session = self.client_sessions.get(token) if token else None
+            if session is None:
+                token = _uuid.uuid4().hex
+                namespace = (info or {}).get("namespace") or f"anon-{token[:12]}"
+                session = ClientSession(token, namespace)
+                self.client_sessions[token] = session
+            session.conn = conn
+            session.disconnected_at = None  # reconnect disarms cleanup
+        conn.send(
+            (
+                "driver_ack",
+                {
+                    "node_id": self._any_node_id(),
+                    "session_token": session.token,
+                    "namespace": session.namespace,
+                },
+            )
+        )
+        return session
+
+    def _session_track(self, session: ClientSession, method: str, payload) -> None:
+        """Attribute ref/actor ownership to the client session so a dirty
+        disconnect can release exactly what the client held. Mirrors the
+        refcounts the handlers themselves will take — kept in the conn
+        thread, racing nothing (one thread per client conn)."""
+        try:
+            if method in ("submit_task", "submit_actor_task", "create_actor"):
+                spec = payload["spec"]
+                for rid in spec.get("return_ids", ()):
+                    session.refs[rid] = session.refs.get(rid, 0) + 1
+                if method == "create_actor":
+                    session.actors.add(spec["actor_id"])
+                    if not spec.get("namespace"):
+                        spec["namespace"] = (
+                            "default"
+                            if spec.get("lifetime") == "detached"
+                            else session.namespace
+                        )
+            elif method == "put" and payload.get("take_ref"):
+                session.refs[payload["obj_id"]] = (
+                    session.refs.get(payload["obj_id"], 0) + 1
+                )
+            elif method in ("add_ref",):
+                session.refs[payload["obj_id"]] = (
+                    session.refs.get(payload["obj_id"], 0) + 1
+                )
+            elif method in ("free_ref", "free_ref_async"):
+                oid = payload["obj_id"]
+                n = session.refs.get(oid, 0) - 1
+                if n <= 0:
+                    session.refs.pop(oid, None)
+                else:
+                    session.refs[oid] = n
+            elif method == "get_actor_named" and payload.get("namespace") is None:
+                # safety net: clients normally send their namespace, but a
+                # None (pre-handshake or legacy caller) defaults to the
+                # session's, not the cluster-wide "default"
+                payload["namespace"] = session.namespace
+        except Exception:
+            pass  # bookkeeping must never break the request path
+
+    def _on_client_disconnect(self, session: ClientSession, conn) -> None:
+        with self.lock:
+            if session.conn is conn:  # a reconnect may already own the session
+                session.conn = None
+                session.disconnected_at = time.monotonic()
+
+    def _reap_client_sessions(self) -> None:
+        """Health-loop tick: release what clients that never came back held
+        (reference: proxier cleanup when a client's channel dies)."""
+        grace = GLOBAL_CONFIG.client_reconnect_grace_s
+        now = time.monotonic()
+        with self.lock:
+            expired = [
+                s
+                for s in self.client_sessions.values()
+                if s.disconnected_at is not None and now - s.disconnected_at > grace
+            ]
+            for s in expired:
+                self.client_sessions.pop(s.token, None)
+        for s in expired:
+            for oid, count in s.refs.items():
+                for _ in range(count):
+                    self.remove_ref(oid)
+            s.refs.clear()
+            for aid in s.actors:
+                with self.lock:
+                    actor = self.actors.get(aid)
+                    leaked = (
+                        actor is not None
+                        and not actor.detached
+                        and actor.state != ACTOR_DEAD
+                    )
+                if leaked:
+                    self.kill_actor(aid, no_restart=True)
+            s.actors.clear()
+            self.flush_outbox()
 
     def _any_node_id(self) -> bytes:
         with self.lock:
@@ -2096,6 +2239,10 @@ class Head:
             if self._snapshot_path and time.monotonic() >= self._snapshot_due:
                 self._snapshot_due = time.monotonic() + GLOBAL_CONFIG.gcs_snapshot_interval_s
                 self._snapshot()
+            try:
+                self._reap_client_sessions()
+            except Exception:
+                pass  # session cleanup must never kill the health loop
             dead, reap, timed_out = [], [], []
             keep = GLOBAL_CONFIG.idle_worker_keep_alive_s
             reg_timeout = GLOBAL_CONFIG.worker_register_timeout_s
@@ -2415,15 +2562,18 @@ class Head:
 
     def create_actor(self, spec: dict) -> None:
         with self.lock:
-            name = spec.get("name")
-            if name and name in self.named_actors:
+            actor = ActorState(spec["actor_id"], spec)
+            key = actor.named_key
+            if key and key in self.named_actors:
                 # check BEFORE registering, so a duplicate name leaves no
                 # orphan PENDING actor behind
-                raise ValueError(f"Actor name {name!r} already taken")
-            actor = ActorState(spec["actor_id"], spec)
+                raise ValueError(
+                    f"Actor name {actor.name!r} already taken in namespace "
+                    f"{actor.namespace!r}"
+                )
             self.actors[spec["actor_id"]] = actor
-            if name:
-                self.named_actors[name] = spec["actor_id"]
+            if key:
+                self.named_actors[key] = spec["actor_id"]
         self.submit_task(spec)
 
     def _start_actor_on(self, rec, node: NodeState):
@@ -2632,8 +2782,8 @@ class Head:
             self._release_alloc(rec)
             for rid in actor.create_spec["return_ids"]:
                 self._store_error(rid, err)
-        if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
-            del self.named_actors[actor.name]
+        if actor.named_key and self.named_actors.get(actor.named_key) == actor.actor_id:
+            del self.named_actors[actor.named_key]
         wh = actor.worker
         if wh is not None:
             wh.actor_id = None
@@ -3382,8 +3532,8 @@ class Head:
                 actor.num_handles = rec.get("num_handles", 1)
                 actor.state = ACTOR_RESTARTING
                 self.actors[aid] = actor
-                if actor.name:
-                    self.named_actors[actor.name] = aid
+                if actor.named_key:
+                    self.named_actors[actor.named_key] = aid
                 self._restored_actors.add(aid)
             for pg_id, rec in data.get("placement_groups", {}).items():
                 pg = PlacementGroupState(
@@ -3469,15 +3619,27 @@ class Head:
     def rpc_get_function(self, func_id):
         return self.get_function(func_id)
 
-    def rpc_get_actor_named(self, name, timeout=0.0):
+    def rpc_get_actor_named(self, name, timeout=0.0, namespace=None):
+        """Namespace-scoped lookup. Falls back to the "default" namespace
+        ONLY for detached actors: detached = cluster-scoped services (serve
+        controller, job supervisors, collective stores) that every client
+        session must find, while regular named actors stay invisible across
+        session namespaces (reference: namespaces + detached lifetimes)."""
+        ns = namespace or "default"
         deadline = time.monotonic() + (timeout or 0.0)
         with self.lock:
             while True:
-                aid = self.named_actors.get(name)
+                aid = self.named_actors.get(f"{ns}:{name}")
+                if aid is None and ns != "default":
+                    cand = self.named_actors.get(f"default:{name}")
+                    if cand is not None and self.actors[cand].detached:
+                        aid = cand
                 if aid is not None:
                     return aid, self.actors[aid].create_spec.get("methods", {})
                 if time.monotonic() >= deadline:
-                    raise ValueError(f"Failed to look up actor with name '{name}'")
+                    raise ValueError(
+                        f"Failed to look up actor with name '{name}'"
+                    )
                 self.cv.wait(timeout=0.1)
 
     def rpc_actor_state(self, actor_id):

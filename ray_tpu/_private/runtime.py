@@ -232,6 +232,10 @@ class BaseContext:
         self.current_actor = None  # set in actor workers
         self.node_id_bin: Optional[bytes] = None
         self.task_depth = 0
+        # named-actor namespace this context creates/looks up in ("default"
+        # for local drivers and workers; ray:// clients get their session's
+        # — usually anonymous — namespace from the driver_ack handshake)
+        self.namespace: str = "default"
         # pubsub: channel -> local callbacks fed by head "pub" pushes
         # (reference: src/ray/pubsub subscriber channels)
         self._pub_sinks: dict[str, list] = {}
@@ -653,10 +657,26 @@ class WorkerContext(BaseContext):
             return None
         seq = next(self._seq)
         ev = threading.Event()
-        slot = [ev, None]
+        # slot[2] records the conn this call went out on: after a reconnect
+        # swap, slots tied to the OLD conn are failed retriably — a send
+        # into a dying socket can land in the kernel buffer without error,
+        # and without this the caller would wait forever for a reply the
+        # head never saw
+        slot = [ev, None, self.conn]
         with self._pending_lock:
             self._pending[seq] = slot
-        self._send(("req", seq, method, payload))
+        try:
+            self._send(("req", seq, method, payload))
+        except Exception as e:
+            # reap the slot (seqs never repeat — a leaked slot lives
+            # forever) and surface a retriable error: send failures are
+            # ROUTINE during a client reconnect window
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            raise rex.RayError(
+                f"connection to the cluster lost while sending {method!r}; "
+                f"retry the call ({e})"
+            ) from e
         ev.wait()
         with self._pending_lock:
             self._pending.pop(seq, None)
@@ -685,9 +705,17 @@ class WorkerContext(BaseContext):
 
 class RemoteDriverContext(WorkerContext):
     """A driver attached to a head in ANOTHER process/host over TCP
-    (reference: ``ray.init(address=...)`` connecting to a running cluster).
-    Same RPC surface as a worker, plus its own response pump (workers get
-    theirs from worker_main's recv loop)."""
+    (reference: ``ray.init(address=...)`` connecting to a running cluster;
+    with a session token this is the ``ray://`` client protocol —
+    reference ``util/client/``). Same RPC surface as a worker, plus its own
+    response pump (workers get theirs from worker_main's recv loop).
+
+    Reconnect-with-resume: on connection loss the pump redials the head
+    presenting ``session_token`` for up to the reconnect grace. The head
+    resumes the session (same namespace, refs intact — ClientSession in
+    head.py); calls in flight AT the drop fail with a retriable RayError
+    (resending them blindly could double-submit tasks), later calls ride
+    the new connection transparently."""
 
     def __init__(
         self,
@@ -695,19 +723,93 @@ class RemoteDriverContext(WorkerContext):
         node_id_bin: bytes,
         authkey: Optional[bytes] = None,
         head_host: Optional[str] = None,
+        address: Optional[str] = None,
+        session_token: Optional[str] = None,
     ):
         super().__init__(conn, node_id_bin, remote=True, authkey=authkey, head_host=head_host)
+        self.address = address
+        self.session_token = session_token
         self._pump = threading.Thread(
             target=self._pump_loop, name="driver-pump", daemon=True
         )
         self._pump.start()
+
+    def _fail_pending(self, not_on=None):
+        """Fail pending calls retriably. ``not_on``: spare slots already
+        sent on that (fresh) connection — used by the post-reconnect sweep
+        so a call that raced onto the new conn keeps waiting for its real
+        reply."""
+        with self._pending_lock:
+            doomed = [
+                (seq, s)
+                for seq, s in self._pending.items()
+                if not_on is None or s[2] is not not_on
+            ]
+            for seq, _ in doomed:
+                self._pending.pop(seq, None)
+        for _seq, slot in doomed:
+            slot[1] = (
+                False,
+                rex.RayError(
+                    "connection to the cluster was lost mid-call; the "
+                    "session was resumed — retry the call"
+                ),
+            )
+            slot[0].set()
+
+    def _try_reconnect(self) -> bool:
+        if self.address is None or self.session_token is None:
+            return False
+        import time as _time
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.worker_main import connect_head
+
+        deadline = _time.monotonic() + GLOBAL_CONFIG.client_reconnect_grace_s
+        while _time.monotonic() < deadline and not self.closed:
+            try:
+                conn = connect_head(self.address, self.authkey, retries=1)
+                conn.send(
+                    ("register_driver", {"session_token": self.session_token})
+                )
+                kind, info = conn.recv()
+                if kind != "driver_ack" or info.get("session_token") != self.session_token:
+                    raise OSError("session not resumed")
+                with self._send_lock:
+                    self.conn = conn
+                # calls that raced into the dying socket's kernel buffer
+                # produced no error yet got no reply: fail everything not
+                # already sent on the FRESH conn (they retry; a silent hang
+                # would be the alternative)
+                self._fail_pending(not_on=conn)
+                # head-side pubsub routing died with the old conn: re-send
+                # subscribes for every channel with live sinks. Raw seq-0
+                # requests — a blocking call() here would deadlock (this IS
+                # the pump thread that processes replies).
+                with self._pub_lock:
+                    channels = [c for c, sinks in self._pub_sinks.items() if sinks]
+                for channel in channels:
+                    try:
+                        self._send(("req", 0, "subscribe", {"channel": channel}))
+                    except Exception:
+                        break  # fresh conn died already: next loop retries
+                return True
+            except Exception:
+                _time.sleep(0.5)
+        return False
 
     def _pump_loop(self):
         while not self.closed:
             try:
                 msg = self.conn.recv()
             except (EOFError, OSError):
-                return
+                # fail in-flight calls FIRST (they will never get replies;
+                # failing after the swap could catch a call already sent on
+                # the fresh connection), then redial with the session token
+                self._fail_pending()
+                if self.closed or not self._try_reconnect():
+                    return
+                continue
             if msg[0] == "resp":
                 _, seq, ok, payload = msg
                 self.on_response(seq, ok, payload)

@@ -151,8 +151,11 @@ def main(
     _install_jax_platform_pin()
     try:
         conn = connect_head(socket_path, authkey)
-    except FileNotFoundError:
+    except (FileNotFoundError, ConnectionError, EOFError):
         # cluster shut down while this worker was spawning — exit quietly
+        # (a traceback here is pure teardown noise on every fast driver
+        # exit; the reference's worker teardown is silent by design).
+        # Other OSErrors (ENOSPC, EMFILE) stay loud: real faults.
         os._exit(0)
     head_host = socket_path.rsplit(":", 1)[0] if remote and ":" in socket_path else None
     ctx = WorkerContext(
@@ -169,9 +172,12 @@ def main(
     from ray_tpu._private.reporter import arm_stack_dumps
 
     arm_stack_dumps()
-    ctx.send_raw(
-        ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
-    )
+    try:
+        ctx.send_raw(
+            ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
+        )
+    except (ConnectionError, EOFError):
+        os._exit(0)  # head died between connect and register: quiet exit
 
     recv = threading.Thread(target=_recv_loop, args=(conn, ctx, state), daemon=True)
     recv.start()
@@ -346,26 +352,35 @@ def _exec_loop(state: WorkerState):
         spec = state.task_queue.get()
         if spec is None:
             break
-        if spec["kind"] == "actor_method" and state.async_loop is not None:
-            _dispatch_async(state, spec)
-        elif spec["kind"] == "actor_method" and state.group_pools:
-            group = spec.get("concurrency_group") or "_default"
-            pool = state.group_pools.get(group)
-            if pool is None:
-                err = rex.RayTaskError.from_exception(
-                    spec.get("name", "task"),
-                    ValueError(
-                        f"Unknown concurrency group {group!r}; declared: "
-                        f"{sorted(g for g in state.group_pools if g != '_default')}"
-                    ),
-                )
-                _finish_task(state, spec, err, is_error=True)
-            else:
-                pool.submit(_run_spec, state, spec)
-        elif spec["kind"] == "actor_method" and state.actor_pool is not None:
-            state.actor_pool.submit(_run_spec, state, spec)
+        try:
+            _exec_one(state, spec)
+        except (BrokenPipeError, ConnectionResetError, EOFError):
+            # the head vanished mid-result-send (driver exited): nothing
+            # left to report to — exit without a traceback
+            os._exit(0)
+
+
+def _exec_one(state: WorkerState, spec: dict):
+    if spec["kind"] == "actor_method" and state.async_loop is not None:
+        _dispatch_async(state, spec)
+    elif spec["kind"] == "actor_method" and state.group_pools:
+        group = spec.get("concurrency_group") or "_default"
+        pool = state.group_pools.get(group)
+        if pool is None:
+            err = rex.RayTaskError.from_exception(
+                spec.get("name", "task"),
+                ValueError(
+                    f"Unknown concurrency group {group!r}; declared: "
+                    f"{sorted(g for g in state.group_pools if g != '_default')}"
+                ),
+            )
+            _finish_task(state, spec, err, is_error=True)
         else:
-            _run_spec(state, spec)
+            pool.submit(_run_spec, state, spec)
+    elif spec["kind"] == "actor_method" and state.actor_pool is not None:
+        state.actor_pool.submit(_run_spec, state, spec)
+    else:
+        _run_spec(state, spec)
 
 
 def _run_spec(state: WorkerState, spec: dict):
@@ -541,6 +556,12 @@ def _run_task(state: WorkerState, spec: dict):
     task_id = spec["task_id"]
     state.current_task_id = task_id
     state.task_threads[task_id] = threading.get_ident()
+    if spec["kind"] != "actor_method":
+        # a plain task runs in its SUBMITTER's namespace (client sessions):
+        # named-actor ops inside the function resolve where the submitter's
+        # would. Actor methods keep the ACTOR's namespace (set at create) —
+        # reference semantics: an actor belongs to its job's namespace.
+        state.ctx.namespace = spec.get("namespace") or "default"
     is_error = False
     try:
         if task_id in state.cancel_requested:
@@ -871,6 +892,8 @@ def _run_actor_create(state: WorkerState, spec: dict):
         # reconnect window; gcs_actor_manager re-registration on failover)
         state.detached = spec.get("lifetime") == "detached"
         state.ctx.current_actor = spec["actor_id"].hex()  # for get_runtime_context()
+        # the actor lives in its namespace for good (worker is dedicated)
+        state.ctx.namespace = spec.get("namespace") or "default"
         _setup_actor_concurrency(state, spec)
         state.ctx.send_raw(("actor_ready", {"actor_id": spec["actor_id"], "error": None}))
     except BaseException as e:  # noqa: BLE001

@@ -101,6 +101,11 @@ def main(
                     worker_main.main(
                         socket_path, authkey, node_id, token, remote=remote
                     )
+                except (ConnectionError, EOFError, FileNotFoundError):
+                    # cluster died while this worker forked: quiet exit.
+                    # Deliberately NOT all OSError — ENOSPC/EMFILE are real
+                    # faults that must keep their traceback below.
+                    pass
                 except BaseException:  # noqa: BLE001 - worker must not fall
                     import traceback  # back into the template's read loop
 

@@ -221,6 +221,16 @@ class ActorClass:
             "concurrency_groups": options.get("concurrency_groups"),
             "name": options.get("name") or self._cls.__name__,
             "lifetime": options.get("lifetime"),
+            # detached actors are cluster-scoped services: they register in
+            # the shared "default" namespace so every client session can
+            # find them; regular named actors scope to the creator's
+            # session namespace (reference: namespaces + detached lifetime)
+            "namespace": options.get("namespace")
+            or (
+                "default"
+                if options.get("lifetime") == "detached"
+                else getattr(ctx, "namespace", "default")
+            ),
             "methods": methods,
         }
         if not options.get("name"):
@@ -248,9 +258,15 @@ class ActorClass:
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
-    """Look up a named actor (reference: ``ray.get_actor``)."""
+    """Look up a named actor (reference: ``ray.get_actor``). Scoped to the
+    caller's namespace; detached actors in "default" are cluster-visible."""
     ctx = get_ctx()
-    actor_id, methods = ctx.call("get_actor_named", name=name, timeout=0.0)
+    actor_id, methods = ctx.call(
+        "get_actor_named",
+        name=name,
+        namespace=namespace or getattr(ctx, "namespace", None),
+        timeout=0.0,
+    )
     spec_methods = methods or {}
     return ActorHandle(actor_id, spec_methods, name, owned=False)
 

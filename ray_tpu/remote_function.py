@@ -93,6 +93,12 @@ class RemoteFunction:
             "kwargs": s_kwargs,
             "return_ids": return_ids,
         }
+        ns = getattr(ctx, "namespace", "default")
+        if ns != "default":
+            # tasks inherit the submitter's namespace (reference: job-scoped
+            # namespaces): get_actor / named-actor creation inside the task
+            # resolves in the client session's namespace, not "default"
+            spec["namespace"] = ns
         if spec["max_retries"] is None:
             spec["max_retries"] = GLOBAL_CONFIG.default_max_retries
         if options.get("runtime_env"):

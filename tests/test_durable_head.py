@@ -109,7 +109,7 @@ def test_head_restart_restores_cluster(tmp_path, monkeypatch):
     with head_b.lock:
         assert head_b.kv.get("durable-k") == b"durable-v"
         assert pg_id in head_b.placement_groups
-        assert "ctr" in head_b.named_actors
+        assert "default:ctr" in head_b.named_actors  # keys are "namespace:name"
         assert oid in head_b.objects
 
     ray_tpu.init(address=addr)
