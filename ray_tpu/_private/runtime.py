@@ -657,16 +657,16 @@ class WorkerContext(BaseContext):
             return None
         seq = next(self._seq)
         ev = threading.Event()
-        # slot[2] records the conn this call went out on: after a reconnect
-        # swap, slots tied to the OLD conn are failed retriably — a send
-        # into a dying socket can land in the kernel buffer without error,
-        # and without this the caller would wait forever for a reply the
-        # head never saw
-        slot = [ev, None, self.conn]
+        # slot[2] records the conn this call actually went out on (set by
+        # _send UNDER the send lock): after a reconnect swap, slots tied to
+        # the OLD conn are failed retriably — a send into a dying socket
+        # can land in the kernel buffer without error, and without this the
+        # caller would wait forever for a reply the head never saw
+        slot = [ev, None, None]
         with self._pending_lock:
             self._pending[seq] = slot
         try:
-            self._send(("req", seq, method, payload))
+            self._send(("req", seq, method, payload), slot=slot)
         except Exception as e:
             # reap the slot (seqs never repeat — a leaked slot lives
             # forever) and surface a retriable error: send failures are
@@ -685,8 +685,18 @@ class WorkerContext(BaseContext):
             raise result
         return result
 
-    def _send(self, msg):
+    def _send(self, msg, slot=None):
         with self._send_lock:
+            if slot is not None:
+                if slot[1] is not None:
+                    # a reconnect sweep failed this call BEFORE its send:
+                    # transmitting now would execute a request whose caller
+                    # was already told "retry" (double-submit). Surface the
+                    # recorded error instead.
+                    ok, err = slot[1]
+                    if not ok:
+                        raise err
+                slot[2] = self.conn  # the conn the bytes actually ride
             self.conn.send(msg)
 
     def send_raw(self, msg):
@@ -738,23 +748,31 @@ class RemoteDriverContext(WorkerContext):
         """Fail pending calls retriably. ``not_on``: spare slots already
         sent on that (fresh) connection — used by the post-reconnect sweep
         so a call that raced onto the new conn keeps waiting for its real
-        reply."""
-        with self._pending_lock:
-            doomed = [
-                (seq, s)
-                for seq, s in self._pending.items()
-                if not_on is None or s[2] is not not_on
-            ]
-            for seq, _ in doomed:
-                self._pending.pop(seq, None)
+        reply.
+
+        The whole sweep holds ``_send_lock``: collection reads slot[2] and
+        writes slot[1], which _send's pre-send guard reads/writes under the
+        same lock — without it, a caller could pass the guard while the
+        sweep dooms its (unsent) slot, then transmit a request whose caller
+        was told to retry (double-submit)."""
+        with self._send_lock:
+            with self._pending_lock:
+                doomed = [
+                    (seq, s)
+                    for seq, s in self._pending.items()
+                    if not_on is None or s[2] is not not_on
+                ]
+                for seq, _ in doomed:
+                    self._pending.pop(seq, None)
+            for _seq, slot in doomed:
+                slot[1] = (
+                    False,
+                    rex.RayError(
+                        "connection to the cluster was lost mid-call; the "
+                        "session was resumed — retry the call"
+                    ),
+                )
         for _seq, slot in doomed:
-            slot[1] = (
-                False,
-                rex.RayError(
-                    "connection to the cluster was lost mid-call; the "
-                    "session was resumed — retry the call"
-                ),
-            )
             slot[0].set()
 
     def _try_reconnect(self) -> bool:

@@ -192,7 +192,13 @@ class ActorClass:
         name = options.get("name")
         if name and options.get("get_if_exists"):
             try:
-                return get_actor(name)
+                # look where the CREATE would register (explicit namespace,
+                # or "default" for detached) — the bare ctx namespace would
+                # miss and then collide in create_actor
+                ns = options.get("namespace") or (
+                    "default" if options.get("lifetime") == "detached" else None
+                )
+                return get_actor(name, namespace=ns)
             except ValueError:
                 pass
         if self._blob is None:

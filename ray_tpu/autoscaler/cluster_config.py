@@ -10,10 +10,11 @@ Schema (validated by :func:`load_cluster_config`)::
 
     cluster_name: demo                  # required
     provider:                           # required
-      type: gke_tpu | fake              # fake = in-process virtual nodes
-      project: my-project               # gke_tpu only
-      zone: us-central2-b               # gke_tpu only
-      cluster: my-gke-cluster           # gke_tpu only
+      type: gke_tpu | gce_tpu | fake    # fake = in-process virtual nodes
+      project: my-project               # gke_tpu / gce_tpu
+      zone: us-central2-b               # gke_tpu / gce_tpu
+      cluster: my-gke-cluster           # gke_tpu only (gce_tpu creates
+                                        # instances / TPU-VM nodes directly)
     head:                               # optional
       host: 127.0.0.1                   # TCP bind for agents/drivers
       port: 0                           # 0 = ephemeral
@@ -41,7 +42,14 @@ instance with the ray node it became (``v2._reconcile_ray_nodes``).
 from __future__ import annotations
 
 import time
+
 from typing import Any, Optional
+
+
+def _sanitize_label(v: str) -> str:
+    from ray_tpu.autoscaler.gce import _sanitize
+
+    return _sanitize(v)
 
 
 def load_cluster_config(path: str) -> dict:
@@ -66,12 +74,18 @@ def validate_cluster_config(cfg: Any) -> None:
     if unknown:
         raise ValueError(f"unknown cluster config key(s) {sorted(unknown)}")
     prov = cfg["provider"]
-    if not isinstance(prov, dict) or prov.get("type") not in ("gke_tpu", "fake"):
-        raise ValueError("provider.type must be 'gke_tpu' or 'fake'")
+    if not isinstance(prov, dict) or prov.get("type") not in (
+        "gke_tpu", "gce_tpu", "fake"
+    ):
+        raise ValueError("provider.type must be 'gke_tpu', 'gce_tpu' or 'fake'")
     if prov["type"] == "gke_tpu":
         for key in ("project", "zone", "cluster"):
             if not prov.get(key):
                 raise ValueError(f"provider.{key} is required for gke_tpu")
+    if prov["type"] == "gce_tpu":
+        for key in ("project", "zone"):
+            if not prov.get(key):
+                raise ValueError(f"provider.{key} is required for gce_tpu")
     if not isinstance(cfg["node_types"], dict) or not cfg["node_types"]:
         raise ValueError("node_types must be a non-empty mapping")
     for name, spec in cfg["node_types"].items():
@@ -79,6 +93,10 @@ def validate_cluster_config(cfg: Any) -> None:
             raise ValueError(f"node_types.{name}.resources is required")
         unknown_t = set(spec) - {
             "pool", "resources", "labels", "min_workers", "max_workers",
+            # gce_tpu launch config (autoscaler/gce.py)
+            "machine_type", "accelerator_type", "runtime_version",
+            "source_image", "disk_size_gb", "network", "internal_ip_only",
+            "startup_script",
         }
         if unknown_t:
             raise ValueError(f"unknown node_types.{name} key(s) {sorted(unknown_t)}")
@@ -94,6 +112,21 @@ def build_provider(cfg: dict, cluster=None, client=None):
         from ray_tpu.autoscaler.v2 import FakeAsyncProvider
 
         return FakeAsyncProvider(cluster=cluster, delay_polls=1)
+    if prov["type"] == "gce_tpu":
+        from ray_tpu.autoscaler.gce import GCEAsyncProvider
+
+        kwargs = {}
+        if client is not None:  # injected transport (tests)
+            kwargs = {"gce_client": client[0], "tpu_client": client[1]} if isinstance(
+                client, tuple
+            ) else {"gce_client": client}
+        return GCEAsyncProvider(
+            project=prov["project"],
+            zone=prov["zone"],
+            node_types=cfg["node_types"],
+            cluster_name=cfg.get("cluster_name", ""),
+            **kwargs,
+        )
     from ray_tpu.autoscaler.gke import GKEClient, GKETPUAsyncProvider
 
     pools = {
@@ -158,6 +191,34 @@ def teardown_cluster(cfg: dict, client=None) -> list[str]:
     prov = cfg["provider"]
     if prov["type"] == "fake":
         return []
+    if prov["type"] == "gce_tpu":
+        from ray_tpu.autoscaler.gce import GCEClient, TPUNodeClient
+
+        if isinstance(client, tuple):
+            gc, tc = client
+        elif client is not None:
+            # single injected client covers ONLY the compute sweep (the
+            # tuple form injects both) — never dial a real TPU API from
+            # under an injected fake
+            gc, tc = client, None
+        else:
+            gc = GCEClient(prov["project"], prov["zone"])
+            tc = TPUNodeClient(prov["project"], prov["zone"])
+        # the label VALUE was sanitized at create time (GCE label charset);
+        # the filter must compare the sanitized form or it matches nothing
+        cluster = _sanitize_label(cfg.get("cluster_name", ""))
+        gone = []
+        # both API families: plain compute VMs AND tpu.googleapis.com
+        # TPU-VM nodes (the expensive ones) carry the ray-cluster label
+        for inst in gc.list_instances(f"labels.ray-cluster={cluster}"):
+            gc.delete_instance(inst["name"])
+            gone.append(inst["name"])
+        for node in tc.list_nodes() if tc is not None else []:
+            if node.get("labels", {}).get("ray-cluster") == cluster:
+                name = node["name"].rsplit("/", 1)[-1]
+                tc.delete_node(name)
+                gone.append(name)
+        return gone
     from ray_tpu.autoscaler.gke import GKEClient
 
     client = client or GKEClient(prov["project"], prov["zone"], prov["cluster"])

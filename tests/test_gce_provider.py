@@ -1,0 +1,201 @@
+"""Raw GCE / TPU-VM provider (autoscaler/gce.py) — VERDICT r4 missing #7:
+bare-metal TPU pods without GKE.
+
+Reference: ``python/ray/autoscaler/_private/gcp/node_provider.py`` (direct
+instance management + TPU nodes). All tests run against fake transports —
+no network, ever.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.gce import (
+    GCEAsyncProvider,
+    GCEClient,
+    TPUNodeClient,
+    _sanitize,
+)
+from ray_tpu.autoscaler.v2 import ALLOCATED, ALLOCATION_FAILED, REQUESTED, Instance
+
+
+class FakeHTTP:
+    """Record requests; script responses per (method, url-substring)."""
+
+    def __init__(self):
+        self.calls = []
+        self.instances = {}  # name -> status dict
+        self.tpu_nodes = {}
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url, body))
+        if "tpu.googleapis.com" in url:
+            return self._tpu(method, url, body)
+        return self._gce(method, url, body)
+
+    def _gce(self, method, url, body):
+        if method == "POST" and url.endswith("/instances"):
+            self.instances[body["name"]] = {"name": body["name"], "status": "PROVISIONING", "body": body}
+            return {"name": "op-1"}
+        name = url.rsplit("/", 1)[-1].split("?")[0]
+        if method == "GET" and "/instances/" in url:
+            if name not in self.instances:
+                raise RuntimeError(f"GCP API GET {url} failed: 404 not found")
+            return self.instances[name]
+        if method == "DELETE":
+            if name not in self.instances:
+                raise RuntimeError(f"GCP API DELETE {url} failed: 404 not found")
+            del self.instances[name]
+            return {}
+        if method == "GET" and url.endswith("/instances") or "?filter=" in url:
+            return {"items": list(self.instances.values())}
+        raise AssertionError((method, url))
+
+    def _tpu(self, method, url, body):
+        if method == "POST" and "nodeId=" in url:
+            name = url.split("nodeId=")[-1]
+            self.tpu_nodes[name] = {"name": name, "state": "CREATING", "body": body}
+            return {"name": "op-tpu"}
+        name = url.rsplit("/", 1)[-1]
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": list(self.tpu_nodes.values())}
+        if method == "GET":
+            if name not in self.tpu_nodes:
+                raise RuntimeError(f"GCP API GET {url} failed: 404 not found")
+            return self.tpu_nodes[name]
+        if method == "DELETE":
+            self.tpu_nodes.pop(name, None)
+            return {}
+        raise AssertionError((method, url))
+
+
+@pytest.fixture
+def fake():
+    return FakeHTTP()
+
+
+def _provider(fake, node_types):
+    return GCEAsyncProvider(
+        node_types=node_types,
+        gce_client=GCEClient("proj", "us-central2-b", http=fake),
+        tpu_client=TPUNodeClient("proj", "us-central2-b", http=fake),
+    )
+
+
+def test_sanitize():
+    assert _sanitize("Ray_Worker.1") == "ray-worker-1"
+    assert len(_sanitize("x" * 100)) == 63
+
+
+def test_gce_instance_lifecycle(fake):
+    p = _provider(fake, {"cpu": {"machine_type": "n2-standard-4",
+                                 "startup_script": "join $RAY_TPU_NODE_ID"}})
+    inst = Instance(node_type="cpu")
+    p.request_create(inst, {"CPU": 4}, {"ray-cluster": "c1"})
+    assert inst.provider_id.startswith("ray-cpu-")
+    body = fake.instances[inst.provider_id]["body"]
+    assert "n2-standard-4" in body["machineType"]
+    assert body["labels"]["provider_node_id"] == inst.provider_id
+    # the startup script got the node id substituted for exact pairing
+    assert body["metadata"]["items"][0]["value"] == f"join {inst.provider_id}"
+
+    assert p.poll(inst) == REQUESTED  # PROVISIONING
+    fake.instances[inst.provider_id]["status"] = "RUNNING"
+    assert p.poll(inst) == ALLOCATED
+    p.terminate(inst)
+    assert inst.provider_id not in fake.instances
+
+
+def test_tpu_node_lifecycle(fake):
+    p = _provider(fake, {"v5e": {"accelerator_type": "v5litepod-8"}})
+    inst = Instance(node_type="v5e")
+    p.request_create(inst, {"TPU": 8}, {})
+    assert inst.provider_id in fake.tpu_nodes
+    assert fake.tpu_nodes[inst.provider_id]["body"]["acceleratorType"] == "v5litepod-8"
+
+    assert p.poll(inst) == REQUESTED  # CREATING
+    fake.tpu_nodes[inst.provider_id]["state"] = "READY"
+    assert p.poll(inst) == ALLOCATED
+    fake.tpu_nodes[inst.provider_id]["state"] = "PREEMPTED"
+    assert p.poll(inst) == ALLOCATION_FAILED
+    p.terminate(inst)
+    assert inst.provider_id not in fake.tpu_nodes
+
+
+def test_transient_errors_keep_polling(fake):
+    p = _provider(fake, {"cpu": {}})
+    inst = Instance(node_type="cpu")
+    p.request_create(inst, {}, {})
+
+    def boom(method, url, body):
+        raise RuntimeError("GCP API unreachable: 503")
+
+    p.gce._http = boom
+    assert p.poll(inst) == REQUESTED  # transient, not FAILED
+
+
+def test_cluster_config_gce(fake):
+    from ray_tpu.autoscaler.cluster_config import build_provider, validate_cluster_config
+
+    cfg = {
+        "cluster_name": "bare",
+        "provider": {"type": "gce_tpu", "project": "proj", "zone": "us-central2-b"},
+        "node_types": {
+            "v5e": {
+                "resources": {"TPU": 8},
+                "accelerator_type": "v5litepod-8",
+                "max_workers": 4,
+            }
+        },
+    }
+    validate_cluster_config(cfg)
+    gce = GCEClient("proj", "us-central2-b", http=fake)
+    tpu = TPUNodeClient("proj", "us-central2-b", http=fake)
+    p = build_provider(cfg, client=(gce, tpu))
+    inst = Instance(node_type="v5e")
+    p.request_create(inst, {"TPU": 8}, {})
+    assert inst.provider_id in fake.tpu_nodes
+
+    with pytest.raises(ValueError):
+        validate_cluster_config({**cfg, "provider": {"type": "gce_tpu", "project": "p"}})
+
+
+def test_json_bodies_are_serializable(fake):
+    """Every request body must survive the real urllib path's json.dumps."""
+    p = _provider(fake, {"cpu": {"machine_type": "n2-standard-4"}})
+    inst = Instance(node_type="cpu")
+    p.request_create(inst, {}, {"a": "B!"})
+    for _method, _url, body in fake.calls:
+        if body is not None:
+            json.dumps(body)
+
+
+def test_teardown_sweeps_both_apis(fake):
+    """'ray_tpu down' must find VMs AND tpu.googleapis.com nodes by the
+    ray-cluster label the launch path stamps — TPU pods are the expensive
+    leak."""
+    from ray_tpu.autoscaler.cluster_config import build_provider, teardown_cluster
+
+    cfg = {
+        "cluster_name": "bare",
+        "provider": {"type": "gce_tpu", "project": "proj", "zone": "z"},
+        "node_types": {
+            "v5e": {"resources": {"TPU": 8}, "accelerator_type": "v5litepod-8"},
+            "cpu": {"resources": {"CPU": 8}},
+        },
+    }
+    gce = GCEClient("proj", "z", http=fake)
+    tpu = TPUNodeClient("proj", "z", http=fake)
+    p = build_provider(cfg, client=(gce, tpu))
+    i1, i2 = Instance(node_type="v5e"), Instance(node_type="cpu")
+    p.request_create(i1, {"TPU": 8}, {})
+    p.request_create(i2, {"CPU": 8}, {})
+    # launch stamped the sweep label on both
+    assert fake.tpu_nodes[i1.provider_id]["body"]["labels"]["ray-cluster"] == "bare"
+    assert fake.instances[i2.provider_id]["body"]["labels"]["ray-cluster"] == "bare"
+    # fake list: expose labels like the real APIs do
+    for n in fake.tpu_nodes.values():
+        n["labels"] = n["body"]["labels"]
+    gone = teardown_cluster(cfg, client=(gce, tpu))
+    assert sorted(gone) == sorted([i1.provider_id, i2.provider_id])
+    assert not fake.tpu_nodes and not fake.instances
