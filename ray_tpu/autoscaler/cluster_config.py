@@ -117,9 +117,20 @@ def build_provider(cfg: dict, cluster=None, client=None):
 
         kwargs = {}
         if client is not None:  # injected transport (tests)
-            kwargs = {"gce_client": client[0], "tpu_client": client[1]} if isinstance(
-                client, tuple
-            ) else {"gce_client": client}
+            if isinstance(client, tuple):
+                kwargs = {"gce_client": client[0], "tpu_client": client[1]}
+            else:
+                # single-client injection covers ONLY the compute path; a
+                # TPU node type must fail loudly instead of falling back to
+                # a REAL tpu.googleapis.com client under a fake
+                class _RefuseTPU:
+                    def __getattr__(self, name):
+                        raise RuntimeError(
+                            "TPU node types need a tpu client: inject "
+                            "client=(gce_client, tpu_client)"
+                        )
+
+                kwargs = {"gce_client": client, "tpu_client": _RefuseTPU()}
         return GCEAsyncProvider(
             project=prov["project"],
             zone=prov["zone"],

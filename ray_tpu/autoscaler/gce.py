@@ -35,6 +35,13 @@ from ray_tpu.autoscaler.v2 import (
 )
 
 
+def _is_404(e: Exception) -> bool:
+    """Status-code-anchored not-found check: instance NAMES can contain
+    '404' (ray-cpu-i-000404), so a bare substring match would classify a
+    403/500 on such a name as not-found and swallow real failures."""
+    return "failed: 404" in str(e)
+
+
 def _sanitize(name: str) -> str:
     """GCE instance names: lowercase RFC-1035, <=63 chars."""
     out = re.sub(r"[^a-z0-9-]", "-", name.lower()).strip("-")
@@ -108,7 +115,7 @@ class GCEClient:
         try:
             return self._http("GET", f"{self._base()}/{name}", None)
         except RuntimeError as e:
-            if "404" in str(e):
+            if _is_404(e):
                 return None
             raise
 
@@ -116,16 +123,26 @@ class GCEClient:
         try:
             self._http("DELETE", f"{self._base()}/{name}", None)
         except RuntimeError as e:
-            if "404" not in str(e):
+            if not _is_404(e):
                 raise
 
     def list_instances(self, label_filter: Optional[str] = None) -> list[dict]:
-        url = self._base()
-        if label_filter:
-            from urllib.parse import quote
+        from urllib.parse import quote
 
-            url += f"?filter={quote(label_filter)}"
-        return self._http("GET", url, None).get("items", [])
+        out: list[dict] = []
+        token = None
+        while True:  # follow nextPageToken: a >1-page cluster must not
+            params = []  # silently truncate (teardown would leak VMs)
+            if label_filter:
+                params.append(f"filter={quote(label_filter)}")
+            if token:
+                params.append(f"pageToken={quote(token)}")
+            url = self._base() + ("?" + "&".join(params) if params else "")
+            resp = self._http("GET", url, None)
+            out.extend(resp.get("items", []))
+            token = resp.get("nextPageToken")
+            if not token:
+                return out
 
 
 class TPUNodeClient:
@@ -164,7 +181,7 @@ class TPUNodeClient:
         try:
             return self._http("GET", f"{self._base()}/{name}", None)
         except RuntimeError as e:
-            if "404" in str(e):
+            if _is_404(e):
                 return None
             raise
 
@@ -172,11 +189,21 @@ class TPUNodeClient:
         try:
             self._http("DELETE", f"{self._base()}/{name}", None)
         except RuntimeError as e:
-            if "404" not in str(e):
+            if not _is_404(e):
                 raise
 
     def list_nodes(self) -> list[dict]:
-        return self._http("GET", self._base(), None).get("nodes", [])
+        from urllib.parse import quote
+
+        out: list[dict] = []
+        token = None
+        while True:
+            url = self._base() + (f"?pageToken={quote(token)}" if token else "")
+            resp = self._http("GET", url, None)
+            out.extend(resp.get("nodes", []))
+            token = resp.get("nextPageToken")
+            if not token:
+                return out
 
 
 class GCEAsyncProvider(AsyncNodeProvider):

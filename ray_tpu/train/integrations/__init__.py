@@ -14,6 +14,10 @@ from ray_tpu.train.integrations.huggingface import (  # noqa: F401
     load_hf_gpt2,
     load_hf_gptj,
 )
+from ray_tpu.train.integrations.flax_bridge import (  # noqa: F401
+    build_flax_train_step,
+    flax_sharding_rules,
+)
 from ray_tpu.train.integrations.orbax import (  # noqa: F401
     load_pytree_checkpoint,
     save_pytree_checkpoint,

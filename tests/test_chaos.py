@@ -56,7 +56,10 @@ def test_actors_survive_worker_kills(chaos_cluster):
         deadline = time.monotonic() + 60
         round_i = 0
         while round_i < 10 or (not killer.kills and time.monotonic() < deadline):
-            results.append(ray_tpu.get([a.bump.remote() for a in actors], timeout=120))
+            # liveness bound, not latency: under full-suite CPU starvation a
+            # kill->respawn->retry cycle can legitimately take minutes on a
+            # 1-core box (observed once in 479 at timeout=120)
+            results.append(ray_tpu.get([a.bump.remote() for a in actors], timeout=240))
             round_i += 1
     # counts are monotone per actor; restarts may reset state (fresh
     # __init__) but every CALL must succeed — the invariant is liveness +

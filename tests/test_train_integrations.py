@@ -273,3 +273,67 @@ class TestGPTJ:
             jax.tree_util.tree_leaves(g_fused), jax.tree_util.tree_leaves(g_naive)
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
+
+
+class TestFlaxBridge:
+    """flax/linen bridge (round 5): any linen module trains on the sharded
+    stack (the JAX-ecosystem analog of the reference's Lightning/DeepSpeed
+    trainer integrations)."""
+
+    def _setup(self, overrides=None):
+        import flax.linen as nn
+        import optax
+
+        from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+        from ray_tpu.train.integrations.flax_bridge import build_flax_train_step
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, batch):
+                x = batch["x"]
+                x = nn.Dense(256)(x)
+                x = nn.relu(x)
+                return nn.Dense(8)(x)
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=4, tp=1, sp=1))
+
+        def loss_fn(apply_fn, params, batch):
+            logits = apply_fn({"params": params}, batch)
+            onehot = jax.nn.one_hot(batch["y"], 8)
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * onehot, axis=-1)
+            )
+
+        rs = np.random.RandomState(0)
+        batch = {
+            "x": rs.randn(16, 32).astype(np.float32),
+            "y": rs.randint(0, 8, 16).astype(np.int32),
+        }
+        init_fn, step_fn = build_flax_train_step(
+            MLP(), loss_fn, optax.adam(1e-2), mesh, batch,
+            min_shard_size=1024, sharding_overrides=overrides,
+        )
+        return init_fn, step_fn, batch, mesh
+
+    def test_flax_module_trains_sharded(self):
+        init_fn, step_fn, batch, mesh = self._setup()
+        state = init_fn()
+        # the big Dense kernels actually scattered over fsdp
+        kernel = state.params["Dense_0"]["kernel"]
+        spec = kernel.sharding.spec
+        assert "fsdp" in str(spec), spec
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        losses = []
+        for _ in range(12):
+            state, loss = step_fn(state, jb)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_sharding_overrides(self):
+        from jax.sharding import PartitionSpec as P
+
+        init_fn, _step, _batch, _mesh = self._setup(
+            overrides=[(r"Dense_1/kernel", P(None, None))]
+        )
+        state = init_fn()
+        assert state.params["Dense_1"]["kernel"].sharding.spec == P(None, None)
