@@ -551,6 +551,8 @@ class Head:
         self.actors: dict[bytes, ActorState] = {}
         # named actors, keyed "namespace:name" (see ActorState.named_key)
         self.named_actors: dict[str, bytes] = {}
+        # cluster-wide named mutexes: name -> (owner_token, lease_expiry)
+        self._named_mutexes: dict[str, tuple] = {}
         # ray:// client sessions by token (ClientSession); cleanup of a
         # disconnected session happens in the health loop after the grace
         self.client_sessions: dict[str, ClientSession] = {}
@@ -1096,7 +1098,7 @@ class Head:
             handler = self._rpc_get_remote
         blocking = method in (
             "get", "wait", "pg_ready", "get_actor_named", "stream_next",
-            "worker_stacks", "worker_profile",
+            "worker_stacks", "worker_profile", "mutex_acquire",
         )
         if blocking:
             # blocking RPCs park until objects/actors materialize; run them
@@ -2243,6 +2245,14 @@ class Head:
                 self._reap_client_sessions()
             except Exception:
                 pass  # session cleanup must never kill the health loop
+            with self.lock:
+                # prune expired named-mutex leases (crashed holders whose
+                # release never arrived) — unbounded growth otherwise
+                now_m = time.monotonic()
+                for mname in [
+                    n for n, (_o, exp) in self._named_mutexes.items() if exp <= now_m
+                ]:
+                    del self._named_mutexes[mname]
             dead, reap, timed_out = [], [], []
             keep = GLOBAL_CONFIG.idle_worker_keep_alive_s
             reg_timeout = GLOBAL_CONFIG.worker_register_timeout_s
@@ -3657,6 +3667,34 @@ class Head:
     def rpc_actor_dec_handle(self, actor_id):
         self.remove_actor_handle(actor_id)
         return True
+
+    def rpc_mutex_acquire(self, name, owner, timeout=None, lease_s=300.0):
+        """Cluster-wide named mutex with a LEASE (reference capability:
+        workflow storage coordination; here the primitive virtual actors
+        serialize their read-modify-write transactions on, replacing the
+        fcntl file lock that silently degrades on NFS/cloud storage).
+        A crashed holder's lease expires instead of wedging the name
+        forever; re-acquiring with the same owner token renews."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.lock:
+            while True:
+                now = time.monotonic()
+                cur = self._named_mutexes.get(name)
+                if cur is None or cur[1] <= now or cur[0] == owner:
+                    self._named_mutexes[name] = (owner, now + float(lease_s))
+                    return True
+                if deadline is not None and now >= deadline:
+                    return False
+                self.cv.wait(timeout=0.05)
+
+    def rpc_mutex_release(self, name, owner):
+        with self.lock:
+            cur = self._named_mutexes.get(name)
+            if cur is not None and cur[0] == owner:
+                del self._named_mutexes[name]
+                self.cv.notify_all()
+                return True
+            return False
 
     def rpc_kv_put(self, key, value):
         with self.lock:

@@ -41,6 +41,40 @@ def global_put(x, sharding) -> jax.Array:
     return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
+def shard_train_state(params, p_specs, optimizer, mesh) -> TrainState:
+    """Place a host param tree onto the mesh per ``p_specs`` and build the
+    matching sharded optimizer state (shared by build_train_step and the
+    flax bridge — ONE copy of the ZeRO placement wiring)."""
+    params = jax.tree_util.tree_map(
+        lambda x, s: global_put(x, NamedSharding(mesh, s)), params, p_specs
+    )
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=_opt_shardings(optimizer, params, p_specs, mesh),
+    )(params)
+    import jax.numpy as jnp
+
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_step_fn(loss_fn, optimizer, mesh):
+    """Jitted fwd+bwd+optimizer step with donated state and dp/fsdp-sharded
+    batches (the step half of ``build_train_step``, reusable with any
+    param-sharding source)."""
+
+    def step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return jax.jit(
+        step,
+        in_shardings=(None, NamedSharding(mesh, batch_spec())),
+        donate_argnums=(0,),
+    )
+
+
 def build_train_step(
     loss_fn: Callable[[Any, jax.Array], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -55,30 +89,9 @@ def build_train_step(
     """
 
     def init_fn(params) -> TrainState:
-        p_specs = param_sharding_rules(params)
-        params = jax.tree_util.tree_map(
-            lambda x, s: global_put(x, NamedSharding(mesh, s)), params, p_specs
-        )
-        opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=_opt_shardings(optimizer, params, p_specs, mesh),
-        )(params)
-        import jax.numpy as jnp
+        return shard_train_state(params, param_sharding_rules(params), optimizer, mesh)
 
-        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
-
-    def step(state: TrainState, batch: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
-
-    step_fn = jax.jit(
-        step,
-        in_shardings=(None, NamedSharding(mesh, batch_spec())),
-        donate_argnums=(0,),
-    )
-    return init_fn, step_fn
+    return init_fn, make_step_fn(loss_fn, optimizer, mesh)
 
 
 def _opt_shardings(optimizer, params, p_specs, mesh):

@@ -86,14 +86,11 @@ def build_flax_train_step(
       (jitted, donated, batch sharded over dp+fsdp).
     """
     import jax
-    import optax  # noqa: F401  (part of the contract)
-    from jax.sharding import NamedSharding
 
     from ray_tpu.parallel.train_step import (
         TrainState,
-        _opt_shardings,
-        batch_spec,
-        global_put,
+        make_step_fn,
+        shard_train_state,
     )
 
     def model_loss(params, batch):
@@ -109,28 +106,8 @@ def build_flax_train_step(
             params, min_shard_size=min_shard_size,
             overrides=sharding_overrides,
         )
-        params = jax.tree_util.tree_map(
-            lambda x, s: global_put(x, NamedSharding(mesh, s)), params, p_specs
-        )
-        opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=_opt_shardings(optimizer, params, p_specs, mesh),
-        )(params)
-        import jax.numpy as jnp
+        # placement + step wiring are the SAME code build_train_step uses —
+        # only the sharding-rule source differs
+        return shard_train_state(params, p_specs, optimizer, mesh)
 
-        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
-
-    def step(state: TrainState, batch):
-        import optax as _optax
-
-        loss, grads = jax.value_and_grad(model_loss)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = _optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
-
-    step_fn = jax.jit(
-        step,
-        in_shardings=(None, NamedSharding(mesh, batch_spec())),
-        donate_argnums=(0,),
-    )
-    return init_fn, step_fn
+    return init_fn, make_step_fn(model_loss, optimizer, mesh)

@@ -8,10 +8,13 @@ runs the method as a cluster task, and atomically commits (new state,
 result). A crashed caller re-issues the call; a committed call never
 re-runs (calls are keyed, like workflow steps).
 
-Lite by design: per-actor sequential consistency comes from an fcntl lock
-on the actor's storage directory (single-host storage; on NFS the lock
-degrades to advisory). Methods marked ``@readonly`` skip the commit and
-the lock's write side.
+Per-actor sequential consistency: with a cluster attached, transactions
+serialize on a HEAD-SIDE named mutex (``rpc_mutex_acquire`` — leased, so
+a crashed holder recovers instead of wedging the actor; works no matter
+where the storage directory lives, including NFS/cloud mounts where file
+locks degrade to advisory). Without a cluster the fcntl file lock remains
+as the single-host fallback. Methods marked ``@readonly`` skip the commit
+and the lock entirely.
 """
 
 from __future__ import annotations
@@ -48,11 +51,14 @@ def _apply_method(cls_blob: bytes, state: dict, method_name: str, args, kwargs):
 
 
 class VirtualActorHandle:
-    def __init__(self, actor_cls, actor_id: str, storage: str):
+    def __init__(
+        self, actor_cls, actor_id: str, storage: str, txn_lease_s: float = 300.0
+    ):
         self._cls = actor_cls
         self._id = actor_id
         self._dir = os.path.join(storage, "virtual_actors", actor_id)
         self._blob: Optional[bytes] = None
+        self._lease_s = float(txn_lease_s)
 
     # -- storage ------------------------------------------------------------
 
@@ -60,14 +66,44 @@ class VirtualActorHandle:
         return os.path.join(self._dir, "state.pkl")
 
     @contextlib.contextmanager
-    def _txn_lock(self):
-        os.makedirs(self._dir, exist_ok=True)
+    def _file_lock(self):
         with open(os.path.join(self._dir, ".lock"), "w") as f:
             fcntl.flock(f, fcntl.LOCK_EX)
             try:
                 yield
             finally:
                 fcntl.flock(f, fcntl.LOCK_UN)
+
+    @contextlib.contextmanager
+    def _txn_lock(self):
+        os.makedirs(self._dir, exist_ok=True)
+        if not ray_tpu.is_initialized():
+            with self._file_lock():
+                yield
+            return
+        # Head-side named mutex: correct across hosts and on any storage
+        # backend; the lease (handle's txn_lease_s) bounds crashed-holder
+        # recovery — pass a bigger one at get_or_create/get for
+        # transactions that can exceed it. The name keys on the REAL path
+        # so symlinked/relative spellings of one directory share a mutex,
+        # and the local file lock is held AS WELL, so a clusterless
+        # process on the same host still mutually excludes.
+        from ray_tpu._private.runtime import get_ctx
+
+        ctx = get_ctx()
+        name = f"va:{os.path.realpath(self._dir)}"
+        owner = os.urandom(8).hex()
+        ctx.call(
+            "mutex_acquire", name=name, owner=owner, lease_s=self._lease_s
+        )
+        try:
+            with self._file_lock():
+                yield
+        finally:
+            try:
+                ctx.call("mutex_release", name=name, owner=owner)
+            except Exception:
+                pass  # lease expiry reclaims it
 
     def _load_state(self) -> dict:
         with open(self._state_path(), "rb") as f:
@@ -133,14 +169,28 @@ class VirtualActorClass:
         self._cls = cls
 
     def get_or_create(
-        self, actor_id: str, *args, storage: Optional[str] = None, **kwargs
+        self,
+        actor_id: str,
+        *args,
+        storage: Optional[str] = None,
+        txn_lease_s: float = 300.0,
+        **kwargs,
     ) -> VirtualActorHandle:
-        handle = VirtualActorHandle(self._cls, actor_id, storage or _DEFAULT_STORAGE)
+        handle = VirtualActorHandle(
+            self._cls, actor_id, storage or _DEFAULT_STORAGE, txn_lease_s
+        )
         handle._init(args, kwargs)
         return handle
 
-    def get(self, actor_id: str, storage: Optional[str] = None) -> VirtualActorHandle:
-        handle = VirtualActorHandle(self._cls, actor_id, storage or _DEFAULT_STORAGE)
+    def get(
+        self,
+        actor_id: str,
+        storage: Optional[str] = None,
+        txn_lease_s: float = 300.0,
+    ) -> VirtualActorHandle:
+        handle = VirtualActorHandle(
+            self._cls, actor_id, storage or _DEFAULT_STORAGE, txn_lease_s
+        )
         if not handle.exists():
             raise ValueError(f"virtual actor {actor_id!r} does not exist")
         return handle
