@@ -89,6 +89,25 @@ def main():
         batch = 4
         steps = 2
 
+    tpu_canary = None
+    if on_tpu:
+        # Tunnel-health canary (the TPU analog of bench_core's spin canary):
+        # a fixed 8192^2 bf16 matmul chain measured before the training
+        # loop. The tunnel is shared/remote and its throughput can collapse
+        # ~20x under relay contention (observed live: an otherwise-identical
+        # bench run recorded MFU 0.018 vs 0.433 minutes apart) — without
+        # this number a reader cannot tell that apart from a regression.
+        x = jnp.ones((8192, 8192), jnp.bfloat16)
+        mm = jax.jit(lambda a: a @ a)
+        r = mm(x)
+        float(jnp.ravel(r)[0])
+        t0 = time.perf_counter()
+        for _ in range(10):
+            r = mm(r)
+        float(jnp.ravel(r)[0])
+        tpu_canary = round(10 * 2 * 8192**3 / (time.perf_counter() - t0) / 1e12, 1)
+        del x, r
+
     mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1), devices=[dev])
 
     def loss_fn(params, tokens):
@@ -143,6 +162,10 @@ def main():
         "peak_flops_assumed": peak_assumed,
         "loss": float(loss),
     }
+    if tpu_canary is not None:
+        # healthy v5e measures ~100 TFLOPs here; a collapsed tunnel shows
+        # single digits — read mfu in that light
+        detail["tpu_canary_matmul_tflops"] = tpu_canary
     detail["core"] = core
     if fit:
         detail["gptj_6b_compiles"] = bool(fit.get("compiles"))

@@ -74,31 +74,60 @@ class VirtualActorHandle:
             finally:
                 fcntl.flock(f, fcntl.LOCK_UN)
 
+    def _mutex_key(self) -> str:
+        """Storage-INDEPENDENT mutex identity: a UUID persisted inside the
+        actor directory (O_EXCL creation — first writer wins, racers read).
+        Two hosts mounting the same storage at different paths therefore
+        contend on the same head mutex; a path-derived name would not."""
+        path = os.path.join(self._dir, ".mutex_id")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(os.urandom(16).hex())
+        except FileExistsError:
+            pass
+        with open(path) as f:
+            return f"va:{f.read().strip()}"
+
     @contextlib.contextmanager
     def _txn_lock(self):
+        """Yields a ``verify()`` callable the write path MUST call before
+        committing: it re-asserts mutex ownership (same-owner acquire
+        renews; returns False if the lease expired and someone stole it),
+        turning a silently lost update into a loud error."""
         os.makedirs(self._dir, exist_ok=True)
         if not ray_tpu.is_initialized():
             with self._file_lock():
-                yield
+                yield lambda: True
             return
-        # Head-side named mutex: correct across hosts and on any storage
-        # backend; the lease (handle's txn_lease_s) bounds crashed-holder
-        # recovery — pass a bigger one at get_or_create/get for
-        # transactions that can exceed it. The name keys on the REAL path
-        # so symlinked/relative spellings of one directory share a mutex,
-        # and the local file lock is held AS WELL, so a clusterless
-        # process on the same host still mutually excludes.
+        # Head-side named mutex: correct across hosts and storage backends
+        # (identity from _mutex_key, not the caller's local path); the
+        # lease (txn_lease_s) bounds crashed-holder recovery. The local
+        # file lock is held AS WELL, so a clusterless process on the same
+        # host still mutually excludes.
         from ray_tpu._private.runtime import get_ctx
 
         ctx = get_ctx()
-        name = f"va:{os.path.realpath(self._dir)}"
+        name = self._mutex_key()
         owner = os.urandom(8).hex()
         ctx.call(
             "mutex_acquire", name=name, owner=owner, lease_s=self._lease_s
         )
+
+        def verify() -> bool:
+            return bool(
+                ctx.call(
+                    "mutex_acquire",
+                    name=name,
+                    owner=owner,
+                    timeout=0,
+                    lease_s=self._lease_s,
+                )
+            )
+
         try:
             with self._file_lock():
-                yield
+                yield verify
         finally:
             try:
                 ctx.call("mutex_release", name=name, owner=owner)
@@ -132,10 +161,15 @@ class VirtualActorHandle:
         return os.path.exists(self._state_path())
 
     def _init(self, args, kwargs) -> None:
-        with self._txn_lock():
+        with self._txn_lock() as verify:
             if self.exists():
                 return  # get_or_create: an existing actor keeps its state
             obj = self._cls(*args, **kwargs)
+            if not verify():
+                raise RuntimeError(
+                    f"virtual actor {self._id!r}: transaction lease expired "
+                    f"before commit (raise txn_lease_s for slow __init__)"
+                )
             self._commit(dict(obj.__dict__), "__init__")
 
     def __getattr__(self, name: str):
@@ -153,11 +187,21 @@ class VirtualActorHandle:
                     _apply_method.remote(self._class_blob(), state, name, args, kwargs)
                 )
                 return result
-            with self._txn_lock():  # serialize read-modify-write per actor
+            with self._txn_lock() as verify:  # serialize read-modify-write
                 state = self._load_state()
                 result, new_state = ray_tpu.get(
                     _apply_method.remote(self._class_blob(), state, name, args, kwargs)
                 )
+                if not verify():
+                    # the lease expired mid-transaction and another writer
+                    # took over: committing now would silently overwrite its
+                    # update — fail loudly instead (reference semantics: a
+                    # lost transaction is retried by the caller)
+                    raise RuntimeError(
+                        f"virtual actor {self._id!r}: transaction exceeded "
+                        f"its lease ({self._lease_s}s) and lost the mutex; "
+                        f"retry, or pass txn_lease_s= for long methods"
+                    )
                 self._commit(new_state, name)
             return result
 
@@ -202,10 +246,15 @@ def virtual_actor(cls) -> VirtualActorClass:
 
 
 def get_actor(
-    actor_id: str, cls, storage: Optional[str] = None
+    actor_id: str,
+    cls,
+    storage: Optional[str] = None,
+    txn_lease_s: float = 300.0,
 ) -> VirtualActorHandle:
     """Attach to an existing virtual actor (reference: workflow.get_actor;
     the class travels with the caller here — no cluster-global class
     registry in the lite design)."""
     inner = cls._cls if isinstance(cls, VirtualActorClass) else cls
-    return VirtualActorClass(inner).get(actor_id, storage=storage)
+    return VirtualActorClass(inner).get(
+        actor_id, storage=storage, txn_lease_s=txn_lease_s
+    )

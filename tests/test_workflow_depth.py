@@ -175,7 +175,7 @@ def test_virtual_actor_head_mutex(ray_start_regular, tmp_path):
 
     # crashed holder: acquire the actor's mutex with a short lease and
     # never release — the next transaction proceeds after expiry
-    name = f"va:{os.path.realpath(c._dir)}"
+    name = c._mutex_key()  # storage-independent UUID identity
     assert ctx.call("mutex_acquire", name=name, owner="dead-client", lease_s=0.5)
     t0 = time.monotonic()
     assert c.bump() == 6
