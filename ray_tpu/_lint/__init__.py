@@ -1,0 +1,30 @@
+"""raylint: AST-based distributed-correctness linter for ray_tpu.
+
+A Ray-class runtime fails in production through a small set of recurring
+programmer errors — nested blocking ``get()`` deadlocks, unserializable
+closure captures, blocking calls inside async actors — that runtime
+machinery only surfaces after deployment. raylint catches them ahead of
+time from the AST, with per-rule suppression comments and a baseline file
+so pre-existing violations can be burned down incrementally.
+
+Run it as ``python -m ray_tpu.lint [paths]``. Library entry points:
+
+    from ray_tpu._lint import run_paths, all_rules
+    violations = run_paths(["ray_tpu"])
+
+The package deliberately depends only on the stdlib (``ast``, ``tokenize``,
+``json``) plus the AST-level serializability tables in
+``ray_tpu.util.check_serialize`` (imported lazily with a fallback), so the
+linter runs in any environment that can parse the source — no jax, no
+cluster, no initialized runtime.
+"""
+
+from ray_tpu._lint.core import (  # noqa: F401
+    FileContext,
+    Rule,
+    Violation,
+    all_rules,
+    get_rule,
+    run_paths,
+)
+from ray_tpu._lint import rules  # noqa: F401  (imports register the rules)

@@ -1,0 +1,80 @@
+"""raylint baseline: recorded pre-existing violations.
+
+The baseline is a JSON map of fingerprint (``rule:path:symbol``) -> count.
+Fingerprints carry no line numbers, so edits that merely shift code do not
+churn the file; a new violation of a rule in a symbol that already has
+baselined ones only fires once the count grows. The intended workflow:
+
+    python -m ray_tpu.lint ray_tpu/ --write-baseline   # adopt current state
+    # ... burn entries down over time; the gate fails on anything new
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from ray_tpu._lint.core import Violation
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = "raylint-baseline.json"
+
+
+def default_baseline_path(scan_paths) -> Path:
+    """Nearest ``tools/raylint-baseline.json`` walking up from the first
+    scanned target, so linting a single nested file still finds the repo
+    baseline. Falls back to ``<parent of root>/tools/...`` (the write
+    location for ``python -m ray_tpu.lint ray_tpu/`` from the repo root)."""
+    root = Path(scan_paths[0]).resolve()
+    start = root if root.is_dir() else root.parent
+    for d in (start, *start.parents):
+        cand = d / "tools" / DEFAULT_BASELINE_NAME
+        if cand.is_file():
+            return cand
+    return (root.parent if root.is_dir() else start) / "tools" / DEFAULT_BASELINE_NAME
+
+
+def load(path: Path) -> Dict[str, int]:
+    data = json.loads(Path(path).read_text())
+    entries = data.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def write(path: Path, violations: List[Violation]) -> int:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.fingerprint()] = counts.get(v.fingerprint(), 0) + 1
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": "raylint baseline — burn down, do not grow. See LINTING.md.",
+        "entries": {k: counts[k] for k in sorted(counts)},
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    return len(violations)
+
+
+def apply(
+    violations: List[Violation], baseline: Dict[str, int]
+) -> Tuple[List[Violation], int, List[str]]:
+    """Filter baselined violations.
+
+    Returns ``(remaining, n_baselined, stale_fingerprints)``. An entry is
+    stale when any of its budget went unused — fully fixed or partially
+    burned down. Stale entries must be regenerated away (the self-host gate
+    enforces it): a count that stays at 3 after 2 of 3 violations were
+    fixed would silently allow the 2 to regrow, defeating the ratchet."""
+    budget = dict(baseline)
+    remaining: List[Violation] = []
+    n_baselined = 0
+    for v in violations:
+        fp = v.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            n_baselined += 1
+        else:
+            remaining.append(v)
+    stale = [fp for fp, left in sorted(budget.items()) if left > 0]
+    return remaining, n_baselined, stale

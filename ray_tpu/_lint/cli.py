@@ -1,0 +1,208 @@
+"""raylint CLI. ``python -m ray_tpu.lint [paths] [options]``.
+
+Exit codes: 0 clean, 1 violations / import problems found, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from ray_tpu._lint import baseline as baseline_mod
+from ray_tpu._lint.core import all_rules, display_path_for, run_paths
+from ray_tpu._lint.imports_check import check_imports
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.lint",
+        description="AST-based distributed-correctness linter for ray_tpu.",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: the ray_tpu package)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt",
+        help="output format",
+    )
+    p.add_argument(
+        "--baseline", metavar="PATH", default=None,
+        help="baseline file (default: <root>/tools/raylint-baseline.json if present)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file; report everything",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="record all current violations into the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--select", metavar="RULES", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore", metavar="RULES", default=None,
+        help="comma-separated rule ids to skip",
+    )
+    p.add_argument(
+        "--check-imports", action="store_true",
+        help="instead of linting, py_compile every module under the given "
+        "directories and fail on module-level import cycles",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    return p
+
+
+def _default_package_path() -> str:
+    # prefer the checkout we are running from
+    here = Path(__file__).resolve().parent.parent
+    return str(here)
+
+
+def main(argv: Optional[Sequence] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}")
+            print(f"       {rule.description}")
+        return 0
+
+    paths = args.paths or [_default_package_path()]
+    for raw in paths:
+        if not Path(raw).exists():
+            print(f"error: no such path: {raw}", file=sys.stderr)
+            return 2
+
+    if args.check_imports:
+        files = [p for p in paths if Path(p).is_file()]
+        if files:
+            # a file arg would silently widen to its parent directory and
+            # fail the run on unrelated sibling modules
+            print(
+                f"error: --check-imports scans directories, not files: {files[0]}",
+                file=sys.stderr,
+            )
+            return 2
+        problems = check_imports(paths)
+        if args.fmt == "json":
+            print(json.dumps({"problems": problems}, indent=2))
+        else:
+            for prob in problems:
+                print(prob)
+            n = len(problems)
+            print(f"check-imports: {n} problem{'s' if n != 1 else ''} found")
+        return 1 if problems else 0
+
+    if args.write_baseline and (args.select or args.ignore):
+        # a filtered run would rewrite the whole file and silently drop
+        # every entry for the rules that didn't run
+        print(
+            "error: --write-baseline cannot be combined with --select/--ignore",
+            file=sys.stderr,
+        )
+        return 2
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else baseline_mod.default_baseline_path(paths)
+    )
+    # With the tools/-convention baseline, anchor display paths at the repo
+    # root it implies, so `lint ray_tpu/rl` or an absolute file path
+    # fingerprints identically to the repo-root `lint ray_tpu/` run.
+    display_root = None
+    if baseline_path.is_file() and baseline_path.parent.name == "tools":
+        display_root = baseline_path.resolve().parent.parent
+        if any(display_path_for(Path(p), display_root) is None for p in paths):
+            display_root = None  # a target outside the repo: fall back
+
+    def scan_prefix(p: str) -> str:
+        d = display_path_for(Path(p), display_root)
+        if d is not None:
+            return d + "/" if Path(p).is_dir() else d
+        return (Path(p).resolve().name + "/") if Path(p).is_dir() else Path(p).as_posix()
+
+    try:
+        violations = run_paths(
+            paths, select=select, ignore=ignore, display_root=display_root
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if baseline_path.is_file():
+            # a partial scan must not silently drop entries for files the
+            # run never looked at (same hazard the --select guard covers)
+            prefixes = tuple(scan_prefix(p) for p in paths)
+            try:
+                existing = baseline_mod.load(baseline_path)
+            except (ValueError, OSError) as e:
+                print(
+                    f"error: unreadable baseline {baseline_path}: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+            orphaned = [
+                fp for fp in existing
+                if not fp.split(":", 2)[1].startswith(prefixes)
+            ]
+            if orphaned:
+                print(
+                    f"error: --write-baseline would drop {len(orphaned)} "
+                    "entr(y/ies) for paths outside this scan "
+                    f"(e.g. {orphaned[0]}); rerun over the full tree",
+                    file=sys.stderr,
+                )
+                return 2
+        n = baseline_mod.write(baseline_path, violations)
+        print(f"wrote {n} violation{'s' if n != 1 else ''} to {baseline_path}")
+        return 0
+
+    n_baselined = 0
+    stale: list = []
+    if not args.no_baseline and baseline_path.is_file():
+        try:
+            entries = baseline_mod.load(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"error: unreadable baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+        violations, n_baselined, stale = baseline_mod.apply(violations, entries)
+        # entries for files outside this scan are not stale, just unscanned
+        scan_prefixes = tuple(scan_prefix(p) for p in paths)
+        stale = [fp for fp in stale if fp.split(":", 2)[1].startswith(scan_prefixes)]
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "violations": [v.to_dict() for v in violations],
+                    "baselined": n_baselined,
+                    "stale_baseline_entries": stale,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for v in violations:
+            print(v.render())
+        summary = f"raylint: {len(violations)} violation{'s' if len(violations) != 1 else ''}"
+        if n_baselined:
+            summary += f" ({n_baselined} baselined)"
+        print(summary)
+        if stale:
+            print(
+                f"note: {len(stale)} stale baseline entr"
+                f"{'ies' if len(stale) != 1 else 'y'} no longer match; "
+                "regenerate with --write-baseline to shrink the baseline"
+            )
+    return 1 if violations else 0

@@ -1,0 +1,364 @@
+"""raylint core: violations, the rule registry, suppression comments and the
+file-walking runner.
+
+Design notes:
+
+- One :class:`FileContext` is built per file and shared by every rule, so
+  parse / parent-map / suppression work happens once per file, not once per
+  rule. Rules are pure functions of the context: ``check(ctx) -> Iterator``.
+- Suppression matches pylint/ruff conventions: a trailing
+  ``# raylint: disable=RL001`` silences its own line; the same comment alone
+  on a line silences the next line. ``disable=all`` silences every rule.
+- Baseline fingerprints are ``rule:path:symbol`` (no line numbers), so
+  unrelated edits that shift lines do not invalidate the baseline; see
+  ``baseline.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+RULE_ID_RE = re.compile(r"^RL\d{3}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # posix-style display path, stable across machines
+    line: int
+    col: int
+    message: str
+    symbol: str  # enclosing qualname, "<module>" at top level
+    # last line of the anchored construct's *header*: a trailing suppression
+    # comment anywhere in [line, end_line] silences the violation, so
+    # multiline calls can be suppressed on their closing-paren line
+    end_line: int = 0
+
+    def fingerprint(self) -> str:
+        """Baseline key. Deliberately excludes line/col so edits elsewhere in
+        the file don't churn the baseline."""
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.symbol}] {self.message}"
+
+
+class Rule:
+    """Base class; subclasses register themselves via :func:`register`."""
+
+    id: str = "RL000"
+    name: str = "abstract"
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Violation]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    rule = cls()
+    if not RULE_ID_RE.match(rule.id):
+        raise ValueError(f"bad rule id {rule.id!r}")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [r for _, r in sorted(_REGISTRY.items())]
+
+
+def get_rule(rule_id: str) -> Optional[Rule]:
+    return _REGISTRY.get(rule_id)
+
+
+# --------------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def parse_suppressions(source: str) -> dict[int, set]:
+    """Map line number -> set of rule ids (upper-cased; may contain "ALL")."""
+    lines = source.splitlines()
+    comments: list[tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Tolerate partially-tokenizable sources: fall back to a line scan.
+        for i, ln in enumerate(lines, 1):
+            if "#" in ln:
+                comments.append((i, ln[ln.index("#"):]))
+    out: dict[int, set] = {}
+    for lineno, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = {s.strip().upper() for s in m.group(1).split(",") if s.strip()}
+        line_text = lines[lineno - 1].strip() if lineno - 1 < len(lines) else ""
+        # standalone comment applies to the following line, trailing to its own
+        target = lineno + 1 if line_text.startswith("#") else lineno
+        out.setdefault(target, set()).update(ids)
+    return out
+
+
+# --------------------------------------------------------------- AST helpers
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_remote_decorator(dec: ast.AST) -> bool:
+    """Matches ``@remote``, ``@ray_tpu.remote``, ``@remote(...)`` and
+    ``@ray_tpu.remote(num_cpus=...)``."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    d = dotted_name(target)
+    return d is not None and (d == "remote" or d.endswith(".remote"))
+
+
+def is_remote_def(node: ast.AST) -> bool:
+    return isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ) and any(is_remote_decorator(d) for d in node.decorator_list)
+
+
+_ACTOR_CLASS_RE = re.compile(r"Actor$|Controller$|Replica$")
+
+
+def is_actor_class(node: ast.AST) -> bool:
+    """Heuristic: ``@remote``-decorated classes, plus the repo's naming
+    convention for classes wrapped at the call site
+    (``ray_tpu.remote(num_cpus=0)(ProxyActor)``)."""
+    if not isinstance(node, ast.ClassDef):
+        return False
+    if any(is_remote_decorator(d) for d in node.decorator_list):
+        return True
+    return bool(_ACTOR_CLASS_RE.search(node.name))
+
+
+class FileContext:
+    """Per-file shared state handed to every rule."""
+
+    def __init__(self, path: Path, display_path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.display_path = display_path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.suppressions = parse_suppressions(source)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # -- structure -------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted chain of enclosing class/function names, including ``node``
+        itself when it is a def/class. ``<module>`` at top level."""
+        parts: list[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def remote_scopes(self) -> List[ast.AST]:
+        """Defs whose bodies execute inside a worker: ``@remote`` functions
+        plus every method of an actor-ish class. Cached."""
+        cached = getattr(self, "_remote_scopes", None)
+        if cached is not None:
+            return cached
+        scopes: list[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if is_remote_def(node):
+                scopes.append(node)
+            elif is_actor_class(node):
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        if stmt not in scopes:
+                            scopes.append(stmt)
+        self._remote_scopes = scopes
+        return scopes
+
+    # -- emission --------------------------------------------------------
+
+    def violation(self, rule: Rule, node: ast.AST, message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        # suppression range: the construct's header only, not its body — a
+        # disable buried deep inside a with/except *block* must not count
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            end = max(
+                (it.context_expr.end_lineno or line for it in node.items), default=line
+            )
+        elif isinstance(node, ast.ExceptHandler):
+            end = (node.type.end_lineno or line) if node.type else line
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            end = node.body[0].lineno - 1 if node.body else line
+        else:
+            end = getattr(node, "end_lineno", None) or line
+        return Violation(
+            rule=rule.id,
+            path=self.display_path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=self.qualname(node),
+            end_line=max(end, line),
+        )
+
+    def is_suppressed(self, v: Violation) -> bool:
+        for line in range(v.line, max(v.end_line, v.line) + 1):
+            ids = self.suppressions.get(line, set())
+            if ids and (v.rule.upper() in ids or "ALL" in ids):
+                return True
+        return False
+
+
+# --------------------------------------------------------------- file runner
+
+_SKIP_DIRS = {"__pycache__", ".git", "_dashboard_static", "node_modules"}
+
+
+def display_path_for(path: Path, display_root: Optional[Path]) -> Optional[str]:
+    """Repo-root-relative display for ``path`` when it lives under
+    ``display_root``; None otherwise (caller falls back)."""
+    if display_root is None:
+        return None
+    try:
+        return path.resolve().relative_to(display_root).as_posix()
+    except ValueError:
+        return None
+
+
+def iter_python_files(paths: Sequence, display_root: Optional[Path] = None) -> List[tuple]:
+    """Expand files/dirs into ``(abs_path, display_path)`` pairs.
+
+    With ``display_root`` (the repo root inferred from the baseline
+    location), displays are root-relative — so scanning ``ray_tpu/rl`` or an
+    absolute file path fingerprints identically to scanning ``ray_tpu/``
+    from the repo root. Without it, directory inputs display as
+    ``<root_basename>/<relative>`` and files as given."""
+    out: list[tuple] = []
+    seen: set = set()  # overlapping args (`lint ray_tpu/rl ray_tpu/`) lint once
+
+    def add(abs_path: Path, display: str) -> None:
+        if abs_path not in seen:
+            seen.add(abs_path)
+            out.append((abs_path, display))
+
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file():
+            display = display_path_for(p, display_root)
+            if display is None:
+                display = p.as_posix()
+                if display.startswith("./"):
+                    display = display[2:]
+            add(p.resolve(), display)
+        elif p.is_dir():
+            root = p.resolve()
+            for f in sorted(root.rglob("*.py")):
+                if any(part in _SKIP_DIRS for part in f.parts):
+                    continue
+                display = display_path_for(f, display_root)
+                if display is None:
+                    display = (Path(root.name) / f.relative_to(root)).as_posix()
+                add(f, display)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {raw}")
+    return out
+
+
+def _selected_rules(select: Optional[Iterable], ignore: Optional[Iterable]) -> List[Rule]:
+    rules = all_rules()
+    known = {r.id for r in rules}
+    # a typo'd id must be an error, not a run that lints nothing and
+    # reports clean
+    unknown = [
+        s for s in list(select or []) + list(ignore or []) if s.upper() not in known
+    ]
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+    if select:
+        wanted = {s.upper() for s in select}
+        rules = [r for r in rules if r.id in wanted]
+    if ignore:
+        dropped = {s.upper() for s in ignore}
+        rules = [r for r in rules if r.id not in dropped]
+    return rules
+
+
+def run_paths(
+    paths: Sequence,
+    select: Optional[Iterable] = None,
+    ignore: Optional[Iterable] = None,
+    display_root: Optional[Path] = None,
+) -> List[Violation]:
+    """Lint every python file under ``paths``; returns violations that are not
+    suppressed by inline comments (baseline filtering is the caller's job)."""
+    rules = _selected_rules(select, ignore)
+    violations: list[Violation] = []
+    for abs_path, display in iter_python_files(paths, display_root=display_root):
+        try:
+            source = abs_path.read_text(encoding="utf-8", errors="replace")
+        except OSError as e:
+            violations.append(
+                Violation("RL000", display, 1, 0, f"unreadable file: {e}", "<module>")
+            )
+            continue
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            violations.append(
+                Violation(
+                    "RL000", display, e.lineno or 1, e.offset or 0,
+                    f"syntax error: {e.msg}", "<module>",
+                )
+            )
+            continue
+        ctx = FileContext(abs_path, display, source, tree)
+        for rule in rules:
+            for v in rule.check(ctx):
+                if not ctx.is_suppressed(v):
+                    violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations

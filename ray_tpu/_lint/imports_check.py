@@ -1,0 +1,199 @@
+"""``--check-imports``: compile smoke check + module-level import-cycle
+detection for a package tree.
+
+Python tolerates some module-level cycles by accident of import order; they
+then break the first time someone imports the modules in the other order
+(typically a worker subprocess with a different entry point). We therefore
+fail on *any* module-level cycle inside the scanned package. Imports inside
+functions are lazy and excluded — making an import function-local is the
+standard fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Sequence, Set
+
+
+def _module_name(root: Path, file: Path) -> str:
+    rel = file.relative_to(root)
+    parts = (root.name,) + rel.with_suffix("").parts
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _module_level_stmts(tree: ast.Module):
+    """Module-level statements, descending into if/try bodies (conditional
+    imports still run at import time) but never into defs/classes."""
+    stack = list(tree.body)
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield cur
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            for child in getattr(cur, field, []):
+                if isinstance(child, ast.ExceptHandler):
+                    stack.extend(child.body)
+                else:
+                    stack.append(child)
+
+
+def _resolve(name: str, modules: Set[str]) -> str:
+    """Longest known module prefix of a dotted name ('' if external)."""
+    parts = name.split(".")
+    while parts:
+        cand = ".".join(parts)
+        if cand in modules:
+            return cand
+        parts.pop()
+    return ""
+
+
+def build_import_graph(root: Path) -> Dict[str, Set[str]]:
+    files = {
+        f: _module_name(root, f)
+        for f in sorted(root.rglob("*.py"))
+        if "__pycache__" not in f.parts
+    }
+    modules = set(files.values())
+    pkg = root.name
+    graph: Dict[str, Set[str]] = {m: set() for m in modules}
+
+    def add_edge(mod: str, tgt: str) -> None:
+        """Edge mod -> tgt, plus edges to tgt's parent packages: importing
+        pkg.b.c executes pkg.b/__init__ first, so a cycle through that
+        __init__ is just as real. Parents that are a prefix of ``mod``'s own
+        path are skipped — a module's own ancestor packages are necessarily
+        already executing when it imports, so such edges only manufacture
+        false cycles out of the standard `from pkg import sibling` pattern."""
+        targets = {tgt}
+        parts = tgt.split(".")
+        while len(parts) > 1:
+            parts.pop()
+            targets.add(".".join(parts))
+        for t in targets:
+            if t in modules and t != mod and not (mod + ".").startswith(t + "."):
+                graph[mod].add(t)
+    for file, mod in files.items():
+        try:
+            tree = ast.parse(file.read_text(encoding="utf-8", errors="replace"))
+        except SyntaxError:
+            continue  # py_compile pass reports this
+        is_pkg_init = file.name == "__init__.py"
+        for stmt in _module_level_stmts(tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    tgt = _resolve(alias.name, modules)
+                    if tgt:
+                        add_edge(mod, tgt)
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level:
+                    # relative: strip `level` trailing components off this
+                    # module's package path
+                    base_parts = mod.split(".")
+                    if not is_pkg_init:
+                        base_parts = base_parts[:-1]
+                    base_parts = base_parts[: len(base_parts) - (stmt.level - 1)]
+                    base = ".".join(base_parts)
+                    src = f"{base}.{stmt.module}" if stmt.module else base
+                else:
+                    src = stmt.module or ""
+                if not src.startswith(pkg):
+                    continue
+                for alias in stmt.names:
+                    # `from X import y`: _resolve picks the submodule X.y when
+                    # it exists, else falls back to X itself — so importing a
+                    # sibling submodule through the package does not create a
+                    # false edge onto the package __init__
+                    tgt = _resolve(f"{src}.{alias.name}", modules)
+                    if tgt:
+                        add_edge(mod, tgt)
+    return graph
+
+
+def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components with >1 node (or a self-edge),
+    iterative Tarjan."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    cycles: List[List[str]] = []
+
+    for start in sorted(graph):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(graph[start])))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in graph:
+                    continue
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or node in graph.get(node, set()):
+                    cycles.append(sorted(comp))
+    return cycles
+
+
+def check_imports(paths: Sequence) -> List[str]:
+    """Returns a list of problems (empty means clean): compile failures
+    first, then import cycles."""
+    problems: List[str] = []
+    for raw in paths:
+        root = Path(raw).resolve()
+        if root.is_file():
+            root = root.parent
+        if not root.is_dir():
+            problems.append(f"no such directory: {raw}")
+            continue
+        for f in sorted(root.rglob("*.py")):
+            if "__pycache__" in f.parts:
+                continue
+            try:
+                # builtin compile(): full syntax + scope checks with no
+                # execution and, unlike py_compile, no __pycache__ writes
+                # into the scanned tree (which breaks on read-only checkouts)
+                compile(f.read_text(encoding="utf-8", errors="replace"), str(f), "exec")
+            except SyntaxError as e:
+                problems.append(f"compile error: {f}:{e.lineno}: {e.msg}")
+            except OSError as e:
+                problems.append(f"compile error: {f}: {e}")
+        graph = build_import_graph(root)
+        for comp in find_cycles(graph):
+            problems.append(
+                "module-level import cycle: " + " -> ".join(comp + [comp[0]])
+                + " (break it by moving one import inside a function)"
+            )
+    return problems

@@ -1,0 +1,535 @@
+"""raylint rules RL001-RL008.
+
+Every rule is a documented heuristic, not a proof: the goal is catching the
+recurring distributed-correctness mistakes of a Ray-class runtime at review
+time. Anything a rule gets wrong can be silenced inline with
+``# raylint: disable=RLxxx`` or recorded in the baseline — see LINTING.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from ray_tpu._lint.core import (
+    FileContext,
+    Rule,
+    Violation,
+    dotted_name,
+    is_actor_class,
+    is_remote_def,
+    register,
+)
+
+
+def _fallback_unserializable() -> dict:
+    # kept in sync with ray_tpu.util.check_serialize; used only if that
+    # module cannot be imported (e.g. linting a checkout with a broken
+    # runtime package)
+    return {
+        "threading.Lock": "holds OS lock state",
+        "threading.RLock": "holds OS lock state",
+        "socket.socket": "OS socket handle",
+        "open": "open file handle",
+        "subprocess.Popen": "live child process",
+    }
+
+
+def known_unserializable_calls() -> dict:
+    """dotted constructor name -> reason; shared with the runtime-side
+    serializability inspector so the two stay consistent."""
+    try:
+        from ray_tpu.util.check_serialize import KNOWN_UNSERIALIZABLE_CALLS
+
+        return dict(KNOWN_UNSERIALIZABLE_CALLS)
+    except Exception:
+        return _fallback_unserializable()
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a def body without descending into nested defs (they are their
+    own scopes and get visited separately when relevant)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+# --------------------------------------------------------------------- RL001
+
+
+@register
+class NestedBlockingGet(Rule):
+    id = "RL001"
+    name = "nested-blocking-get"
+    description = (
+        "Blocking ray_tpu.get()/ray.get() or Future.result() with no timeout "
+        "inside a @remote task or actor method. If the awaited task needs a "
+        "worker slot held by the caller, the cluster deadlocks (the classic "
+        "nested-get deadlock). Pass timeout=, restructure to return the ref, "
+        "or use ray_tpu.wait()."
+    )
+
+    _GET_NAMES = {"ray_tpu.get", "ray.get"}
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        # nested @remote defs appear both as their own scope and inside the
+        # enclosing scope's walk: dedupe per call node
+        reported: set = set()
+        for scope in ctx.remote_scopes():
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call) or id(node) in reported:
+                    continue
+                reported.add(id(node))
+                d = dotted_name(node.func)
+                if d in self._GET_NAMES and not _has_timeout(node):
+                    yield ctx.violation(
+                        self, node,
+                        f"blocking {d}() without timeout= inside a remote "
+                        "task/actor method risks a nested-get deadlock",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    yield ctx.violation(
+                        self, node,
+                        ".result() without timeout inside a remote task/actor "
+                        "method risks a nested-get deadlock",
+                    )
+
+
+# --------------------------------------------------------------------- RL002
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "RL002"
+    name = "blocking-call-in-async"
+    description = (
+        "Synchronous blocking call inside an async def. One blocked "
+        "coroutine stalls every request multiplexed onto the actor's event "
+        "loop. Use the asyncio equivalent or loop.run_in_executor()."
+    )
+
+    _BLOCKING = {
+        "time.sleep": "await asyncio.sleep(...)",
+        "subprocess.run": "asyncio.create_subprocess_exec(...)",
+        "subprocess.call": "asyncio.create_subprocess_exec(...)",
+        "subprocess.check_call": "asyncio.create_subprocess_exec(...)",
+        "subprocess.check_output": "asyncio.create_subprocess_exec(...)",
+        "socket.create_connection": "asyncio.open_connection(...)",
+        "urllib.request.urlopen": "an async HTTP client or run_in_executor",
+        "requests.get": "an async HTTP client or run_in_executor",
+        "requests.post": "an async HTTP client or run_in_executor",
+        "requests.request": "an async HTTP client or run_in_executor",
+        "os.system": "asyncio.create_subprocess_shell(...)",
+        "ray_tpu.get": "await the ref or run_in_executor",
+        "ray.get": "await the ref or run_in_executor",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            # nested async defs are walked on their own; nested SYNC defs
+            # are skipped — the rule's own remedy is to move the blocking
+            # call into a sync helper handed to loop.run_in_executor, and
+            # that fix must lint clean
+            for cur in _walk_scope(node):
+                if not isinstance(cur, ast.Call):
+                    continue
+                d = dotted_name(cur.func)
+                if d in self._BLOCKING:
+                    yield ctx.violation(
+                        self, cur,
+                        f"blocking {d}() inside async def {node.name}; "
+                        f"use {self._BLOCKING[d]}",
+                    )
+
+
+# --------------------------------------------------------------------- RL003
+
+
+@register
+class UnserializableCapture(Rule):
+    id = "RL003"
+    name = "unserializable-closure-capture"
+    description = (
+        "A @remote function closes over a name bound to a known-"
+        "unserializable constructor (lock, socket, file handle, ...). "
+        "Submission will fail in cloudpickle with an opaque error; create "
+        "the resource inside the task or move it to an actor."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        ctors = known_unserializable_calls()
+
+        def unserializable_bindings(scope: ast.AST) -> dict:
+            """name -> dotted ctor for ``name = threading.Lock()``-style
+            assignments directly in ``scope`` (not in nested defs)."""
+            out: dict = {}
+            body = scope.body if hasattr(scope, "body") else []
+            stack = list(body)
+            while stack:
+                cur = stack.pop()
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(cur, ast.Assign) and isinstance(cur.value, ast.Call):
+                    d = dotted_name(cur.value.func)
+                    if d in ctors:
+                        for tgt in cur.targets:
+                            if isinstance(tgt, ast.Name):
+                                out[tgt.id] = d
+                stack.extend(ast.iter_child_nodes(cur))
+            return out
+
+        for node in ast.walk(ctx.tree):
+            if not is_remote_def(node) or isinstance(node, ast.ClassDef):
+                continue
+            # enclosing lexical scopes, nearest first
+            enclosing = [
+                a for a in ctx.ancestors(node)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module))
+            ]
+            env: dict = {}
+            for scope in reversed(enclosing):  # outermost first; inner shadows
+                env.update(unserializable_bindings(scope))
+            if not env:
+                continue
+            local = {a.arg for a in node.args.args + node.args.kwonlyargs}
+            if node.args.vararg:
+                local.add(node.args.vararg.arg)
+            if node.args.kwarg:
+                local.add(node.args.kwarg.arg)
+            for cur in _walk_scope(node):
+                if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Store):
+                    local.add(cur.id)
+            for cur in _walk_scope(node):
+                if (
+                    isinstance(cur, ast.Name)
+                    and isinstance(cur.ctx, ast.Load)
+                    and cur.id not in local
+                    and cur.id in env
+                ):
+                    yield ctx.violation(
+                        self, cur,
+                        f"@remote function {node.name} captures {cur.id!r} "
+                        f"bound to {env[cur.id]}() "
+                        f"({ctors[env[cur.id]]}); it cannot be serialized",
+                    )
+
+
+# --------------------------------------------------------------------- RL004
+
+
+@register
+class MutableDefaultOnActorMethod(Rule):
+    id = "RL004"
+    name = "mutable-default-arg"
+    description = (
+        "Mutable default argument on an actor method or @remote function. "
+        "Actor methods are long-lived: the shared default accumulates state "
+        "across calls and across restarts inconsistently. Use None + init."
+    )
+
+    _CTOR_NAMES = {"list", "dict", "set"}
+
+    def _mutable_defaults(self, node) -> Iterator[ast.AST]:
+        defaults = list(node.args.defaults) + [d for d in node.args.kw_defaults if d]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield d
+            elif (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in self._CTOR_NAMES
+            ):
+                yield d
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        seen = set()
+        for scope in ctx.remote_scopes():
+            seen.add(scope)
+            for d in self._mutable_defaults(scope):
+                yield ctx.violation(
+                    self, d,
+                    f"mutable default argument on {ctx.qualname(scope)}; "
+                    "use None and initialize inside",
+                )
+        for node in ast.walk(ctx.tree):
+            if is_remote_def(node) and node not in seen:
+                for d in self._mutable_defaults(node):
+                    yield ctx.violation(
+                        self, d,
+                        f"mutable default argument on @remote {node.name}; "
+                        "use None and initialize inside",
+                    )
+
+
+# --------------------------------------------------------------------- RL005
+
+
+@register
+class InconsistentLockOrder(Rule):
+    id = "RL005"
+    name = "inconsistent-lock-order"
+    description = (
+        "Two methods of the same class acquire the same pair of locks in "
+        "opposite nesting order (via with-statements). Under concurrency "
+        "that is an ABBA deadlock. Pick one global order per class."
+    )
+
+    # anchored on a word start so 'clock'/'block'/'unlock' don't match
+    _LOCK_ATTR_RE = re.compile(r"(?:^|_)(lock|rlock|mutex|cv|cond)s?$", re.I)
+
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and self._LOCK_ATTR_RE.search(expr.attr):
+                return f"self.{expr.attr}"
+        elif isinstance(expr, ast.Name) and self._LOCK_ATTR_RE.search(expr.id):
+            return expr.id
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            # pair -> (method name, With node) of first sighting
+            order: dict = {}
+            reported = set()
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for pair, node in self._nested_pairs(meth):
+                    order.setdefault(pair, (meth.name, node))
+            for (outer, inner), (meth_name, node) in order.items():
+                rev = (inner, outer)
+                key = frozenset((outer, inner))
+                if rev in order and key not in reported:
+                    reported.add(key)
+                    other = order[rev][0]
+                    yield ctx.violation(
+                        self, node,
+                        f"{meth_name} acquires {outer} then {inner}, but "
+                        f"{other} acquires {inner} then {outer} "
+                        "(ABBA deadlock risk)",
+                    )
+
+    def _nested_pairs(self, meth) -> Iterator[tuple]:
+        """(outer, inner) lock-name pairs from nested with-statements,
+        depth-first with an explicit held-lock stack."""
+
+        def visit(node, held):
+            for cur in ast.iter_child_nodes(node):
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    acquired = []
+                    for item in cur.items:
+                        k = self._lock_key(item.context_expr)
+                        if k is not None:
+                            for h in held + acquired:
+                                if h != k:
+                                    pairs.append(((h, k), cur))
+                            acquired.append(k)
+                    visit(cur, held + acquired)
+                else:
+                    visit(cur, held)
+
+        pairs: list = []
+        visit(meth, [])
+        return iter(pairs)
+
+
+# --------------------------------------------------------------------- RL006
+
+
+@register
+class HostSyncInHotLoop(Rule):
+    id = "RL006"
+    name = "host-sync-in-hot-loop"
+    description = (
+        "Device-to-host synchronization (.block_until_ready(), "
+        "jax.device_get, np.asarray/np.array on device values) inside a "
+        "loop in a hot path (ops/, train/, rl/). Each call stalls the XLA "
+        "pipeline; hoist out of the loop or batch with jax.device_get on "
+        "the whole pytree once."
+    )
+
+    HOT_DIRS = ("ops", "train", "rl")
+    _SYNC_NAMES = {
+        "jax.device_get",
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+        "jax.block_until_ready",
+    }
+
+    def _in_hot_path(self, ctx: FileContext) -> bool:
+        parts = ctx.display_path.split("/")
+        return any(d in parts for d in self.HOT_DIRS)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if not self._in_hot_path(ctx):
+            return
+
+        rule = self
+        out: list = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+
+            def visit_For(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_While = visit_For
+
+            def visit_Call(self, node):
+                if rule._is_sync(node) and self.loop_depth > 0:
+                    out.append(
+                        ctx.violation(
+                            rule, node,
+                            f"host sync {rule._label(node)} inside a loop in "
+                            "a hot path; hoist it out or batch the transfer",
+                        )
+                    )
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        yield from out
+
+    def _is_sync(self, call: ast.Call) -> bool:
+        d = dotted_name(call.func)
+        if d in self._SYNC_NAMES:
+            return True
+        return isinstance(call.func, ast.Attribute) and call.func.attr == "block_until_ready"
+
+    def _label(self, call: ast.Call) -> str:
+        return dotted_name(call.func) or f".{call.func.attr}()"
+
+
+# --------------------------------------------------------------------- RL007
+
+
+@register
+class SwallowedExceptionInLoop(Rule):
+    id = "RL007"
+    name = "swallowed-exception-in-loop"
+    description = (
+        "except:/except Exception: with a body of only pass/continue inside "
+        "a loop. In a daemon loop this silently discards every failure "
+        "forever — the classic invisible-outage bug. Log the exception "
+        "(even throttled) before continuing."
+    )
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Name) and t.id in self._BROAD:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(isinstance(e, ast.Name) and e.id in self._BROAD for e in t.elts)
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node):
+                continue
+            if not all(isinstance(s, (ast.Pass, ast.Continue)) for s in node.body):
+                continue
+            in_loop = False
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.While, ast.For, ast.AsyncFor)):
+                    in_loop = True
+                    break
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    break  # the loop must be in the same scope
+            if in_loop:
+                yield ctx.violation(
+                    self, node,
+                    "broad except swallowing every error inside a loop; log "
+                    "the exception (throttled) before continuing",
+                )
+
+
+# --------------------------------------------------------------------- RL008
+
+
+@register
+class ActorInitIOWithoutTimeout(Rule):
+    id = "RL008"
+    name = "actor-init-io-without-timeout"
+    description = (
+        "Actor __init__ performs network / subprocess IO with no timeout. "
+        "Actor creation blocks the caller's first method call and holds a "
+        "worker slot; a hung dependency turns into a hung cluster. Add a "
+        "timeout or defer the IO to a ready() method."
+    )
+
+    _NEEDS_TIMEOUT = {
+        "socket.create_connection",
+        "urllib.request.urlopen",
+        "requests.get",
+        "requests.post",
+        "requests.request",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        for cls in ast.walk(ctx.tree):
+            if not is_actor_class(cls):
+                continue
+            init = next(
+                (
+                    s for s in cls.body
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and s.name == "__init__"
+                ),
+                None,
+            )
+            if init is None:
+                continue
+            for node in _walk_scope(init):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d in self._NEEDS_TIMEOUT:
+                    # socket.create_connection's 2nd positional is the timeout
+                    if _has_timeout(node) or (
+                        d == "socket.create_connection" and len(node.args) >= 2
+                    ):
+                        continue
+                    yield ctx.violation(
+                        self, node,
+                        f"{d}() in actor __init__ without timeout=; a hung "
+                        "peer blocks actor creation and pins a worker slot",
+                    )
+                elif isinstance(node.func, ast.Attribute) and node.func.attr == "connect":
+                    yield ctx.violation(
+                        self, node,
+                        ".connect() in actor __init__; set a socket timeout "
+                        "first or defer to a ready() method",
+                    )

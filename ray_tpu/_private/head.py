@@ -46,6 +46,7 @@ from ray_tpu._private import config as _cfg
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.proc_handles import ForkedProc, TemplateProc, spawn_template
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.shm_store import ShmLocation, ShmOwner
 
 # --------------------------------------------------------------------------
@@ -703,11 +704,12 @@ class Head:
                 conn = listener.accept()
             except (OSError, EOFError):
                 return
-            except Exception:
+            except Exception as e:
                 # A client that died mid-handshake (AuthenticationError) or
                 # sent garbage must not kill the accept loop — that would
                 # silently stop ALL future worker registration. Drop the
                 # connection and keep accepting.
+                warn_throttled("head accept loop", e)
                 continue
             t = threading.Thread(
                 target=self._serve_conn, args=(conn, remote), daemon=True
@@ -2243,8 +2245,9 @@ class Head:
                 self._snapshot()
             try:
                 self._reap_client_sessions()
-            except Exception:
-                pass  # session cleanup must never kill the health loop
+            except Exception as e:
+                # session cleanup must never kill the health loop
+                warn_throttled("health loop: client session reap", e)
             with self.lock:
                 # prune expired named-mutex leases (crashed holders whose
                 # release never arrived) — unbounded growth otherwise
@@ -2325,8 +2328,8 @@ class Head:
                     for n in self.nodes.values():
                         if n.agent is None:
                             n.stats = stats
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled("health loop: /proc stats refresh", e)
             # restored detached actors whose old workers never reconnected:
             # past the grace window, re-create them fresh (reference:
             # gcs_actor_manager restart of registered actors on failover)
@@ -2446,8 +2449,8 @@ class Head:
                     continue
                 self._kill_for_memory()
                 self.flush_outbox()  # requeued victims' redispatches
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled("memory monitor loop", e)
 
     def _kill_for_memory(self):
         with self.lock:
@@ -3429,8 +3432,8 @@ class Head:
                 if kind == "fn":
                     try:
                         sink(channel, payload)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        warn_throttled(f"publisher loop: subscriber on {channel}", e)
                     continue
                 try:
                     with self._conn_lock(sink):
@@ -4066,8 +4069,8 @@ class Head:
             wh.alive = False
             try:
                 wh.send(("exit",))
-            except Exception:
-                pass
+            except Exception:  # raylint: disable=RL007
+                pass  # best-effort teardown: the worker may already be gone
         for node in self.nodes.values():
             if node.template is not None:
                 node.template.shutdown()

@@ -139,12 +139,13 @@ class NodeAgent:
                 elif msg[0] == "free_shm":
                     # the head routed a free of an object living on THIS
                     # host (head._release_loc)
+                    from ray_tpu._private.log_util import warn_throttled
                     from ray_tpu._private.shm_store import free_location
 
                     try:
                         free_location(msg[1])
-                    except Exception:  # noqa: BLE001 - frees are best-effort
-                        pass
+                    except Exception as e:  # noqa: BLE001 - frees are best-effort
+                        warn_throttled("node agent: free_shm", e)
                 elif msg[0] == "dump_workers":
                     # on-demand stack dumps of THIS host's workers
                     # (reporter.py SIGUSR1 machinery) — off-thread, or the
@@ -285,6 +286,7 @@ class NodeAgent:
         from ray_tpu._private.reporter import node_stats
 
         from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.log_util import warn_throttled
 
         while not self._stop.is_set():
             _time.sleep(GLOBAL_CONFIG.node_stats_report_interval_s)
@@ -292,8 +294,9 @@ class NodeAgent:
                 stats = node_stats()
                 with self._send_lock:
                     self.conn.send(("agent_stats", stats))
-            except Exception:
-                pass  # conn mid-reconnect: next tick retries
+            except Exception as e:
+                # conn mid-reconnect: next tick retries
+                warn_throttled("node agent: stats report", e)
 
     def _reconnect(self) -> bool:
         import time

@@ -23,6 +23,7 @@ from ray_tpu import exceptions as rex
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.shm_store import ShmReader
 
 _ctx: Optional["BaseContext"] = None
@@ -284,8 +285,9 @@ class BaseContext:
                         t.start()
                     except RuntimeError:
                         payload()
-            except Exception:
-                pass  # best-effort: the process may be tearing down
+            except Exception as e:
+                # best-effort: the process may be tearing down
+                warn_throttled("gc drain loop", e)
 
     # -- transport: subclasses implement call() --------------------------------
     def call(self, method: str, **payload) -> Any:
@@ -298,8 +300,8 @@ class BaseContext:
         for fn in sinks:
             try:
                 fn(channel, payload)
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled(f"pubsub callback on {channel}", e)
 
     def pub_register(self, channel: str, fn) -> None:
         with self._pub_lock:

@@ -165,11 +165,13 @@ class Monitor:
         return self
 
     def _loop(self):
+        from ray_tpu._private.log_util import warn_throttled
+
         while not self._stop.is_set():
             try:
                 self.autoscaler.update()
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled("autoscaler monitor loop", e)
             self._stop.wait(self.interval_s)
 
     def stop(self):

@@ -1,0 +1,11 @@
+"""Public entry point: ``python -m ray_tpu.lint [paths]``.
+
+Thin shim over :mod:`ray_tpu._lint` so the implementation stays private
+(mirrors the ``_private``/public split used across the package). See
+LINTING.md for the rule catalog, suppression syntax and baseline workflow.
+"""
+
+from ray_tpu._lint.cli import main  # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,7 @@ import time
 import uuid
 from typing import Optional
 
+from ray_tpu._private.log_util import warn_throttled
 from ray_tpu.serve._private.common import (
     AutoscalingConfig,
     DeploymentSpec,
@@ -122,8 +123,8 @@ class ServeController:
         for r in victims:
             try:
                 ray_tpu.kill(r.actor)
-            except Exception:
-                pass
+            except Exception:  # raylint: disable=RL007
+                pass  # best-effort teardown: the replica may already be dead
 
     # -- queries (handles / proxy / status) --------------------------------
 
@@ -243,7 +244,11 @@ class ServeController:
                     scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True),
                 ).remote(want)
                 p = ray_tpu.get(actor.ready.remote(), timeout=60)
-            except Exception:
+            except Exception as e:
+                # the node may have died between listing and placement; the
+                # next reconcile tick retries — but say so, a node that can
+                # never host a proxy serves no traffic
+                warn_throttled(f"serve controller: proxy start on {nid}", e)
                 continue
             with self._lock:
                 self._proxies[nid] = (actor, p)
@@ -300,12 +305,12 @@ class ServeController:
         while not self._shutdown:
             try:
                 self._reconcile_once()
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled("serve controller: reconcile", e)
             try:
                 self._ensure_proxies()  # nodes come and go; proxies follow
-            except Exception:
-                pass
+            except Exception as e:
+                warn_throttled("serve controller: ensure proxies", e)
             time.sleep(RECONCILE_PERIOD_S)
 
     def _reconcile_once(self):
@@ -367,8 +372,8 @@ class ServeController:
             if done:
                 try:
                     ray_tpu.kill(victim.actor)
-                except Exception:
-                    pass
+                except Exception:  # raylint: disable=RL007
+                    pass  # best-effort teardown: the replica may already be dead
             else:
                 still.append((victim, deadline))
         with self._lock:
@@ -412,8 +417,10 @@ class ServeController:
             try:
                 m = ray_tpu.get(r.actor.get_metrics.remote(), timeout=5.0)
                 total_ongoing += m["num_ongoing_requests"]
-            except Exception:
-                pass
+            except Exception as e:
+                # count an unreachable replica as zero load, but surface it:
+                # persistently silent metrics skew autoscaling down
+                warn_throttled("serve controller: replica metrics", e)
         desired = max(
             cfg.min_replicas,
             min(
@@ -456,12 +463,12 @@ class ServeController:
         for actor, _port in proxies:
             try:
                 ray_tpu.get(actor.stop.remote(), timeout=5)
-            except Exception:
-                pass
+            except Exception:  # raylint: disable=RL007
+                pass  # best-effort teardown
             try:
                 ray_tpu.kill(actor)
-            except Exception:
-                pass
+            except Exception:  # raylint: disable=RL007
+                pass  # best-effort teardown
         return True
 
     def check_health(self) -> bool:

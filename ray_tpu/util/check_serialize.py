@@ -12,7 +12,36 @@ import dataclasses
 import inspect
 from typing import Any, List, Optional
 
-from ray_tpu._private import serialization as ser
+# AST-level table shared with raylint's RL003 (unserializable closure
+# capture): dotted constructor name -> why instances of it cannot cross a
+# task boundary. Kept here, next to the runtime-side inspector, so the two
+# views of "what cloudpickle chokes on" stay in one place. This module must
+# stay import-side-effect free (the serializer import is lazy, below) so the
+# linter can use it without dragging in the runtime.
+KNOWN_UNSERIALIZABLE_CALLS: dict[str, str] = {
+    "threading.Lock": "holds OS lock state",
+    "threading.RLock": "holds OS lock state",
+    "threading.Condition": "wraps an OS lock",
+    "threading.Event": "wraps an OS lock",
+    "threading.Semaphore": "wraps an OS lock",
+    "threading.BoundedSemaphore": "wraps an OS lock",
+    "threading.local": "thread-local storage",
+    "_thread.allocate_lock": "holds OS lock state",
+    "multiprocessing.Lock": "holds OS lock state",
+    "multiprocessing.Queue": "backed by an OS pipe",
+    "queue.Queue": "contains locks/conditions",
+    "queue.LifoQueue": "contains locks/conditions",
+    "queue.PriorityQueue": "contains locks/conditions",
+    "socket.socket": "OS socket handle",
+    "socket.create_connection": "OS socket handle",
+    "open": "open file handle",
+    "io.open": "open file handle",
+    "subprocess.Popen": "live child process",
+    "sqlite3.connect": "database connection handle",
+    "mmap.mmap": "memory-mapped OS handle",
+    "concurrent.futures.ThreadPoolExecutor": "live thread pool",
+    "concurrent.futures.ProcessPoolExecutor": "live process pool",
+}
 
 
 @dataclasses.dataclass
@@ -29,6 +58,10 @@ class FailureTuple:
 
 
 def _try_pickle(obj: Any) -> Optional[Exception]:
+    # Lazy so that importing this module (e.g. from the linter) never pulls
+    # in the runtime serializer and its cloudpickle dependency.
+    from ray_tpu._private import serialization as ser
+
     try:
         ser.dumps(obj)
         return None

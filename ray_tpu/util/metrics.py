@@ -345,9 +345,10 @@ def start_core_metrics(interval_s: float = 5.0) -> None:
         while not _core_stop.wait(interval_s):
             try:
                 _sample_once()
-            except Exception:
+            except Exception:  # raylint: disable=RL007
                 # head shutting down / not initialized: keep polling; the
-                # sampler must never take the process down
+                # sampler must never take the process down, and warning here
+                # would fire on every clean driver shutdown
                 pass
 
     try:

@@ -452,8 +452,10 @@ def _execute_with_retries(
             if on_event is not None:
                 try:
                     on_event(dict(event))
-                except Exception:
-                    pass
+                except Exception as e:
+                    from ray_tpu._private.log_util import warn_throttled
+
+                    warn_throttled("workflow on_event callback", e)
 
 
 def run(

@@ -1,0 +1,76 @@
+"""Self-hosting gate: raylint runs clean over ray_tpu itself.
+
+Every violation is either fixed, suppressed inline with a justification, or
+recorded in tools/raylint-baseline.json — so any NEW violation introduced by
+a PR fails this tier-1 test. Keeping the gate in pytest (not only CI yaml)
+means it runs everywhere the test suite runs.
+"""
+
+import functools
+from pathlib import Path
+
+import ray_tpu
+from ray_tpu._lint import baseline as baseline_mod
+from ray_tpu._lint import run_paths
+from ray_tpu._lint.imports_check import check_imports
+
+PACKAGE_ROOT = Path(ray_tpu.__file__).resolve().parent
+BASELINE = PACKAGE_ROOT.parent / "tools" / "raylint-baseline.json"
+
+
+@functools.lru_cache(maxsize=1)
+def _all_violations():
+    # one full-package lint shared by every test in this module
+    return tuple(run_paths([str(PACKAGE_ROOT)]))
+
+
+def _apply_baseline():
+    violations = list(_all_violations())
+    if BASELINE.is_file():
+        return baseline_mod.apply(violations, baseline_mod.load(BASELINE))
+    return violations, 0, []
+
+
+def test_no_new_lint_violations():
+    violations, _, _ = _apply_baseline()
+    assert violations == [], (
+        "new raylint violations (fix them, suppress with a justified "
+        "'# raylint: disable=RLxxx', or — for pre-existing debt only — "
+        "regenerate the baseline):\n"
+        + "\n".join(v.render() for v in violations)
+    )
+
+
+def test_daemon_loop_fixes_stay_fixed():
+    """The PR that introduced raylint fixed RL007 (silent exception
+    swallowing) in the head, runtime, node agent and serve controller daemon
+    loops. Those files must not regress into the baseline."""
+    if not BASELINE.is_file():
+        return
+    fixed_files = (
+        "ray_tpu/_private/head.py",
+        "ray_tpu/_private/runtime.py",
+        "ray_tpu/_private/node_agent.py",
+        "ray_tpu/serve/_private/controller.py",
+    )
+    entries = baseline_mod.load(BASELINE)
+    offenders = [
+        fp for fp in entries
+        if fp.startswith("RL007:") and any(f in fp for f in fixed_files)
+    ]
+    assert offenders == [], f"RL007 crept back into fixed files: {offenders}"
+
+
+def test_no_import_cycles():
+    problems = check_imports([str(PACKAGE_ROOT)])
+    assert problems == [], "\n".join(problems)
+
+
+def test_baseline_has_no_stale_entries():
+    """A baseline entry nothing matches anymore is finished burn-down work:
+    delete it (regenerate with --write-baseline) so it cannot mask a future
+    regression in the same symbol."""
+    if not BASELINE.is_file():
+        return
+    _, _, stale = _apply_baseline()
+    assert stale == [], f"stale baseline entries: {stale}"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Local pre-commit gate: what CI runs, runnable in one command.
+#   tools/check.sh          # lint + import check + tier-1 tests
+#   tools/check.sh --fast   # lint + import check only (seconds)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== raylint =="
+python -m ray_tpu.lint ray_tpu/
+
+echo "== import cycles / py_compile =="
+python -m ray_tpu.lint ray_tpu/ --check-imports
+
+if [[ "${1:-}" != "--fast" ]]; then
+    echo "== tier-1 tests =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo "OK"
