@@ -52,6 +52,7 @@ def main():
     # core microbench first: it is CPU-only and must not run while this
     # process holds the single-tenant TPU tunnel (import jax acquires it)
     core = _core_microbench()
+    llm = _llm_serving_bench()
     fit = _gptj_fit_proof()
 
     import jax
@@ -167,6 +168,11 @@ def main():
         # single digits — read mfu in that light
         detail["tpu_canary_matmul_tflops"] = tpu_canary
     detail["core"] = core
+    if llm:
+        # continuous-batching serving engine vs sequential static-batch
+        # decode under staggered arrivals (ray_tpu/llm/bench.py);
+        # vs_baseline there = continuous/static speedup
+        detail["llm_serving"] = llm
     if fit:
         detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
         detail["gptj_6b_fit"] = fit
@@ -226,6 +232,45 @@ def _core_microbench() -> dict:
         return {}
     except Exception as e:
         print(f"[bench] core microbench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _llm_serving_bench() -> dict:
+    """Continuous-batching vs static-batch decode throughput under
+    staggered arrivals (``python -m ray_tpu.llm.bench``). CPU-only
+    subprocess for the same reason as the core microbench: it must not
+    touch the TPU tunnel, and a failure costs only this field."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.llm.bench"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in reversed(out.stdout.splitlines()):
+            if line.startswith("{"):
+                rec = json.loads(line)
+                if rec.get("metric") == "llm_continuous_batching_tokens_per_sec":
+                    return {
+                        "continuous_tokens_per_sec": rec["value"],
+                        "speedup_vs_static": rec["vs_baseline"],
+                        **rec.get("detail", {}),
+                    }
+        print(
+            f"[bench] llm serving bench produced no metrics (rc={out.returncode}): "
+            f"{out.stderr[-500:]}",
+            file=sys.stderr,
+        )
+        return {}
+    except Exception as e:
+        print(f"[bench] llm serving bench failed: {e!r}", file=sys.stderr)
         return {}
 
 

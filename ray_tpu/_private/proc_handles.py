@@ -33,11 +33,18 @@ class ForkedProc:
             self._pidfd = os.pidfd_open(pid)
         except AttributeError:
             self._pidfd = None  # platform without pidfd: raw fallback
-        except OSError:
-            # already reaped: the pid may ALREADY be recycled — never
-            # signal it
+        except OSError as e:
+            import errno
+
             self._pidfd = None
-            self._exited = True
+            # ESRCH = already reaped (the pid may ALREADY be recycled —
+            # never signal it). Anything else (ENOSYS on pre-5.3 kernels,
+            # EPERM in sandboxes) means THIS PLATFORM can't pidfd at all:
+            # the process is fine, fall back to raw-pid liveness. Treating
+            # those as "exited" made every forked worker read as dead, so
+            # the health loop killed its actor at the first tick of any
+            # task longer than the health interval.
+            self._exited = e.errno == errno.ESRCH
 
     def _close(self) -> None:
         if self._pidfd is not None:
@@ -78,6 +85,12 @@ class ForkedProc:
             return True
         try:
             os.kill(self.pid, 0)
+            return True
+        except PermissionError:
+            # EPERM = the process EXISTS but we may not signal it (sandbox
+            # seccomp/LSM — the same environments that deny pidfd_open).
+            # Only ESRCH means gone; treating EPERM as death re-creates
+            # the kill-every-live-worker bug this path exists to avoid.
             return True
         except OSError:
             self._exited = True

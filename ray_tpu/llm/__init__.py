@@ -1,0 +1,23 @@
+"""ray_tpu.llm: continuous-batching LLM inference on the paged KV cache.
+
+The serving-side counterpart of the training stack (SURVEY §7 step 10):
+``models.gptj``/``models.gpt`` give the forward math, this package turns
+it into an *engine* — per-step admission of queued requests into fixed
+decode slots, chunked prefill interleaved with batched decode, paged KV
+blocks with preemption under pressure, per-request sampling params,
+streaming token delivery — and ``serve.llm`` wraps the engine in a
+deployment replica that streams tokens over the existing
+streaming-generator machinery.
+
+    from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+
+    engine = LLMEngine(model_cfg, params, EngineConfig(max_slots=8))
+    req = engine.submit(prompt_ids, SamplingParams(max_tokens=64,
+                                                   temperature=0.8))
+    for tok in engine.stream_tokens(req):   # a loop thread drives step()
+        ...
+"""
+
+from ray_tpu.llm.cache import CacheConfig, KVBlockPool  # noqa: F401
+from ray_tpu.llm.engine import EngineConfig, LLMEngine  # noqa: F401
+from ray_tpu.llm.scheduler import Request, SamplingParams, Scheduler  # noqa: F401

@@ -1,0 +1,175 @@
+"""Paged KV-cache block pool — static-shape JAX storage, host-side ledger.
+
+vLLM-style paging on the TPU shape discipline: the device side is two
+fixed arrays per model
+
+    k, v : (layers, num_blocks, heads, block_size, head_dim)
+
+allocated ONCE at engine start (no reallocation, no ragged shapes — the
+decode step jits once and every admission/eviction pattern reuses it).
+The host side is a free-list ledger mapping sequence ids to the physical
+blocks they own; block tables (logical→physical per sequence, padded
+with the reserved trash block) are plain int32 numpy rows the engine
+stacks into the decode step's ``(slots, tmax)`` operand.
+
+Block 0 is RESERVED as the trash block: inactive decode slots and
+padded prefill positions scatter their k/v there, so masked lanes never
+corrupt live cache and the jitted step needs no data-dependent control
+flow.  Eviction under pressure is mechanism here (``free`` returns a
+sequence's blocks), policy in ``llm.scheduler`` (preempt-youngest,
+recompute on re-admission).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Pool geometry. ``num_blocks`` INCLUDES the reserved trash block, so
+    usable capacity is ``num_blocks - 1`` blocks of ``block_size`` tokens.
+    ``max_blocks_per_seq`` fixes the block-table width (tmax) — it caps a
+    single sequence's length at ``max_blocks_per_seq * block_size``."""
+
+    num_blocks: int = 128
+    block_size: int = 16
+    max_blocks_per_seq: int = 32
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        if self.block_size < 1 or self.max_blocks_per_seq < 1:
+            raise ValueError("block_size and max_blocks_per_seq must be >= 1")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+
+class KVBlockPool:
+    """The pool: device arrays + thread-safe host ledger.
+
+    Device arrays are plain attributes (``k``, ``v``) the engine threads
+    through its jitted step functions and writes back — functional
+    updates, the pool object just holds the current version.
+    """
+
+    def __init__(
+        self,
+        cfg: CacheConfig,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        dtype="float32",
+    ):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        shape = (n_layers, cfg.num_blocks, n_heads, cfg.block_size, head_dim)
+        self.k = jnp.zeros(shape, jnp.dtype(dtype))
+        self.v = jnp.zeros(shape, jnp.dtype(dtype))
+        self._lock = threading.Lock()
+        # LIFO free list of physical block ids; 0 reserved (trash)
+        self._free = list(range(cfg.num_blocks - 1, 0, -1))
+        self._owned: dict[str, list[int]] = {}
+
+    # -- capacity ----------------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.cfg.block_size)
+
+    @property
+    def num_free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._owned.values())
+
+    def utilization(self) -> float:
+        """Fraction of usable (non-reserved) blocks currently owned."""
+        usable = self.cfg.num_blocks - 1
+        return self.num_used_blocks / max(usable, 1)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        need = self.blocks_for(n_tokens)
+        if need > self.cfg.max_blocks_per_seq:
+            return False
+        with self._lock:
+            return need <= len(self._free)
+
+    # -- ledger ------------------------------------------------------------
+
+    def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
+        """Claim enough blocks for ``n_tokens``; raises if the sequence
+        already owns blocks, exceeds the table width, or the pool is dry
+        (callers check ``can_allocate`` / preempt first)."""
+        need = self.blocks_for(n_tokens)
+        with self._lock:
+            if seq_id in self._owned:
+                raise ValueError(f"sequence {seq_id!r} already owns blocks")
+            if need > self.cfg.max_blocks_per_seq:
+                raise ValueError(
+                    f"{n_tokens} tokens need {need} blocks > "
+                    f"max_blocks_per_seq={self.cfg.max_blocks_per_seq}"
+                )
+            if need > len(self._free):
+                raise MemoryError(
+                    f"paged KV pool exhausted: need {need} blocks, "
+                    f"{len(self._free)} free"
+                )
+            blocks = [self._free.pop() for _ in range(need)]
+            self._owned[seq_id] = blocks
+            return list(blocks)
+
+    def grow_to(self, seq_id: str, n_tokens: int) -> bool:
+        """Ensure ``seq_id`` owns enough blocks for ``n_tokens``.  Returns
+        False (allocation unchanged) when the pool can't cover the growth —
+        the scheduler then evicts someone and retries."""
+        with self._lock:
+            blocks = self._owned.get(seq_id)
+            if blocks is None:
+                raise KeyError(f"unknown sequence {seq_id!r}")
+            need = self.blocks_for(n_tokens)
+            if need > self.cfg.max_blocks_per_seq:
+                return False
+            extra = need - len(blocks)
+            if extra <= 0:
+                return True
+            if extra > len(self._free):
+                return False
+            blocks.extend(self._free.pop() for _ in range(extra))
+            return True
+
+    def free(self, seq_id: str) -> int:
+        """Return a sequence's blocks to the pool (idempotent); returns the
+        number of blocks released."""
+        with self._lock:
+            blocks = self._owned.pop(seq_id, None)
+            if not blocks:
+                return 0
+            self._free.extend(reversed(blocks))
+            return len(blocks)
+
+    def owner_count(self) -> int:
+        with self._lock:
+            return len(self._owned)
+
+    def table_row(self, seq_id: Optional[str]) -> np.ndarray:
+        """(max_blocks_per_seq,) int32 block table, padded with the trash
+        block.  ``None`` (an inactive slot) is all-trash."""
+        row = np.zeros(self.cfg.max_blocks_per_seq, np.int32)
+        if seq_id is not None:
+            with self._lock:
+                blocks = self._owned.get(seq_id)
+                if blocks is None:
+                    raise KeyError(f"unknown sequence {seq_id!r}")
+                row[: len(blocks)] = blocks
+        return row

@@ -1,0 +1,390 @@
+"""LLMEngine: the continuous-batching step loop.
+
+One engine owns one model (GPT or GPT-J params), one paged KV pool, and
+one scheduler.  ``step()`` is the whole design:
+
+1. reap cancellations and blown deadlines;
+2. admit waiting requests into free decode slots (FIFO, memory-gated);
+3. run ONE chunked-prefill piece for the oldest still-prefilling
+   admission — interleaved with, never instead of, decode;
+4. run ONE batched decode step across every running slot (single jitted
+   call, static slot count), sample per-slot tokens (per-request
+   temperature/top-k/top-p/seed), stream them out, finish requests that
+   hit ``max_tokens``/stop tokens, preempting the youngest when the
+   block pool runs dry.
+
+Observability: every step is a ``util.tracing`` span; tokens/s, TTFT,
+inter-token latency, running/waiting counts, KV-block utilization and
+preemptions publish through ``util.metrics`` (the same surface the serve
+autoscaler and Grafana boards read).
+
+Threading: ``step()`` serializes on an internal lock — any number of
+submitter threads (serve replica handlers) can feed the engine while one
+driver thread (or several, harmlessly) turns the crank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.llm.cache import CacheConfig, KVBlockPool
+from ray_tpu.llm.model_runner import PagedModelRunner, _sample_rows
+from ray_tpu.llm.scheduler import (
+    FINISH_CANCELLED,
+    FINISH_DEADLINE,
+    FINISH_LENGTH,
+    FINISH_STOP,
+    PREFILL,
+    RUNNING,
+    Request,
+    SamplingParams,
+    Scheduler,
+)
+
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> dict:
+    """Engine metric set, created once per process (util.metrics registers
+    globally; duplicates would fight in collect())."""
+    global _METRICS
+    if _METRICS is not None:
+        return _METRICS  # lock-free fast path: called per token in _emit
+    with _METRICS_LOCK:
+        if _METRICS is not None:
+            return _METRICS
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _METRICS = {
+            "tokens": Counter("llm_generated_tokens", "tokens sampled by the engine"),
+            "steps": Counter("llm_engine_steps", "engine step-loop iterations"),
+            "preempt": Counter("llm_preemptions", "requests evicted under KV pressure"),
+            "running": Gauge("llm_running_requests", "requests holding decode slots"),
+            "waiting": Gauge("llm_waiting_requests", "requests queued for admission"),
+            "kv_util": Gauge("llm_kv_block_utilization", "fraction of KV blocks in use"),
+            "ttft": Histogram("llm_time_to_first_token_s", "submit → first token"),
+            "itl": Histogram(
+                "llm_inter_token_latency_s",
+                "gap between consecutive streamed tokens",
+                boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
+            ),
+        }
+    return _METRICS
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine geometry. ``num_blocks`` includes the reserved trash block;
+    ``max_blocks_per_seq * block_size`` caps a sequence (prompt + output),
+    additionally clamped by the model's positional table for GPT."""
+
+    max_slots: int = 4
+    num_blocks: int = 128
+    block_size: int = 16
+    max_blocks_per_seq: int = 32
+    prefill_chunk: int = 32
+    attn_impl: str = "auto"
+
+
+class LLMEngine:
+    def __init__(self, model_cfg, params: dict, engine_cfg: Optional[EngineConfig] = None):
+        self.cfg = engine_cfg or EngineConfig()
+        self.model_cfg = model_cfg
+        cache_cfg = CacheConfig(
+            num_blocks=self.cfg.num_blocks,
+            block_size=self.cfg.block_size,
+            max_blocks_per_seq=self.cfg.max_blocks_per_seq,
+        )
+        self.runner = PagedModelRunner(
+            model_cfg, params, self.cfg.block_size, attn_impl=self.cfg.attn_impl
+        )
+        self.pool = KVBlockPool(
+            cache_cfg,
+            n_layers=model_cfg.n_layers,
+            n_heads=model_cfg.n_heads,
+            head_dim=model_cfg.head_dim,
+            dtype=model_cfg.dtype,
+        )
+        self.scheduler = Scheduler(self.pool, self.cfg.max_slots)
+        self._lock = threading.Lock()
+        self._requests: dict[str, Request] = {}
+        self._step_n = 0
+        self._tokens_generated = 0
+        self._preemptions = 0
+        # model-length cap: paged table width, and the learned positional
+        # table for GPT (rotary GPT-J has no absolute cap of its own)
+        self.max_model_len = cache_cfg.max_seq_len
+        if self.runner.arch == "gpt":
+            self.max_model_len = min(self.max_model_len, model_cfg.seq_len)
+        import jax
+
+        self._sample1 = jax.jit(_sample_rows)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: list[int],
+        params: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Request:
+        """Queue a request; returns immediately (drive with ``step()`` or a
+        loop thread; consume with ``stream_tokens``)."""
+        params = params or SamplingParams()
+        if params.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        total = len(prompt) + params.max_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({params.max_tokens}) "
+                f"exceeds max model length {self.max_model_len}"
+            )
+        # the request must be able to COMPLETE with the pool to itself —
+        # admission's worst case is a re-admission one token before the end
+        # plus one block of headroom. Without this check an oversized
+        # request passes validation, can never be admitted, and livelocks
+        # the FIFO head (starving everything queued behind it).
+        worst = min(total - 1 + self.pool.cfg.block_size, self.pool.cfg.max_seq_len)
+        usable = self.pool.cfg.num_blocks - 1
+        if self.pool.blocks_for(worst) > usable:
+            raise ValueError(
+                f"request needs up to {self.pool.blocks_for(worst)} KV blocks "
+                f"but the pool has only {usable} usable blocks "
+                f"(num_blocks={self.pool.cfg.num_blocks}, block 0 reserved)"
+            )
+        deadline = time.time() + deadline_s if deadline_s is not None else None
+        req = Request(prompt, params, deadline=deadline)
+        with self._lock:
+            self._requests[req.id] = req
+            self.scheduler.add(req)
+        return req
+
+    def cancel(self, req_id: str) -> bool:
+        """Flag a request for cancellation; the next step reaps it (frees
+        its slot and blocks, ends its stream)."""
+        req = self._requests.get(req_id)
+        if req is None:
+            return False
+        req.cancelled.set()
+        return True
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.scheduler.has_work()
+
+    def stream_tokens(self, req: Request, timeout: float = 60.0) -> Iterator[int]:
+        """Yield the request's tokens as the engine produces them."""
+        import queue as _q
+
+        while True:
+            try:
+                kind, val = req.stream.get(timeout=timeout)
+            except _q.Empty:
+                raise TimeoutError(
+                    f"no token from {req.id} within {timeout}s "
+                    f"(state={req.state})"
+                ) from None
+            if kind == "token":
+                yield val
+            else:
+                return
+
+    def generate(
+        self,
+        prompt: list[int],
+        params: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+    ) -> list[int]:
+        """Blocking convenience: submit and drive until finished. Safe to
+        call while a loop thread is also stepping (steps serialize)."""
+        req = self.submit(prompt, params, deadline_s)
+        while not req.finished:
+            if not self.step():
+                time.sleep(0.001)
+        return list(req.out)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "running": self.scheduler.num_running,
+                "waiting": self.scheduler.num_waiting,
+                "queue_depth": self.scheduler.num_waiting,
+                "kv_utilization": self.pool.utilization(),
+                "free_blocks": self.pool.num_free_blocks,
+                "steps": self._step_n,
+                "tokens_generated": self._tokens_generated,
+                "preemptions": self._preemptions,
+            }
+
+    def run_loop(self, stop: threading.Event, idle_sleep_s: float = 0.002) -> None:
+        """Drive ``step()`` until ``stop`` is set (serve replicas run this
+        in a daemon thread)."""
+        while not stop.is_set():
+            if not self.step():
+                stop.wait(idle_sleep_s)
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration; returns True when any work was done."""
+        from ray_tpu.util import tracing
+
+        with self._lock:
+            sched = self.scheduler
+            if not sched.has_work():
+                self._publish_gauges()
+                return False
+            self._step_n += 1
+            m = _metrics()
+            m["steps"].inc()
+            with tracing.span(
+                "llm_engine_step",
+                step=self._step_n,
+                running=sched.num_running,
+                waiting=sched.num_waiting,
+            ):
+                self._reap()
+                sched.admit()
+                did = self._prefill_one()
+                did = self._decode_all() or did
+            # prune finished requests: the registry otherwise retains every
+            # Request (prompt, output, stream queue) for the replica's
+            # lifetime. Callers keep their own Request references; cancel()
+            # of a pruned id is a no-op, which is correct for finished work.
+            self._requests = {
+                k: r for k, r in self._requests.items() if not r.finished
+            }
+            self._publish_gauges()
+            return did or sched.has_work()
+
+    # -- internals (all called under the lock) -----------------------------
+
+    def _reap(self) -> None:
+        now = time.time()
+        for req in list(self.scheduler.waiting) + self.scheduler.running:
+            if req.cancelled.is_set():
+                self.scheduler.finish(req, FINISH_CANCELLED)
+            elif req.deadline is not None and now >= req.deadline:
+                self.scheduler.finish(req, FINISH_DEADLINE)
+
+    def _prefill_one(self) -> bool:
+        """One chunk for the oldest admission still prefilling."""
+        pre = [r for r in self.scheduler.slots if r is not None and r.state == PREFILL]
+        if not pre:
+            return False
+        req = min(pre, key=lambda r: self.scheduler._admitted_at.get(r.id, 0))
+        chunk = self.cfg.prefill_chunk
+        # a preempted request replays prompt + already-generated tokens to
+        # rebuild its cache; a fresh one just prefills its prompt
+        full = req.prompt + req.out
+        piece = full[req.prefill_pos : req.prefill_pos + chunk]
+        n_valid = len(piece)
+        tokens = np.zeros(chunk, np.int32)
+        tokens[:n_valid] = piece
+        table = self.pool.table_row(req.id)
+        k, v, last_logits = self.runner.prefill_chunk(
+            self.pool.k, self.pool.v, tokens, req.prefill_pos, n_valid, table
+        )
+        self.pool.k, self.pool.v = k, v
+        req.prefill_pos += n_valid
+        if req.prefill_pos >= len(full):
+            # final chunk: its last position's logits seed generation
+            p = req.params
+            tok = int(
+                self._sample1(
+                    last_logits[None, :],
+                    np.asarray([p.seed & 0xFFFFFFFF], np.uint32),
+                    np.asarray([len(req.out)], np.int32),
+                    np.asarray([p.temperature], np.float32),
+                    np.asarray([p.top_k], np.int32),
+                    np.asarray([p.top_p], np.float32),
+                )[0]
+            )
+            req.state = RUNNING
+            self._emit(req, tok)
+        return True
+
+    def _decode_all(self) -> bool:
+        """One batched decode step over every RUNNING slot."""
+        sched = self.scheduler
+        # memory first: every runner needs space for the token it is about
+        # to write; the youngest gets evicted when the pool is dry
+        for req in list(sched.running):
+            if req.state != RUNNING:
+                continue
+            before = sched.preempt_count
+            if not sched.grow_for_decode(req):
+                pass  # req itself was preempted; it re-prefills later
+            self._preemptions += sched.preempt_count - before
+            _metrics()["preempt"].inc(sched.preempt_count - before)
+        active = [
+            (i, r)
+            for i, r in enumerate(sched.slots)
+            if r is not None and r.state == RUNNING
+        ]
+        if not active:
+            return False
+        S = self.cfg.max_slots
+        tokens = np.zeros(S, np.int32)
+        positions = np.zeros(S, np.int32)
+        tables = np.zeros((S, self.pool.cfg.max_blocks_per_seq), np.int32)
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        seeds = np.zeros(S, np.uint32)
+        counters = np.zeros(S, np.int32)
+        for i, req in active:
+            tokens[i] = req.out[-1] if req.out else req.prompt[-1]
+            positions[i] = req.seq_len - 1  # the fed token's position
+            tables[i] = self.pool.table_row(req.id)
+            p = req.params
+            temp[i] = p.temperature
+            top_k[i] = p.top_k
+            top_p[i] = p.top_p
+            # mask, don't assign raw: a negative seed overflows a uint32
+            # cell on NumPy >= 2 and the OverflowError would kill the
+            # engine loop thread
+            seeds[i] = p.seed & 0xFFFFFFFF
+            counters[i] = len(req.out)
+        k, v, nxt = self.runner.decode_step(
+            self.pool.k, self.pool.v, tokens, positions, tables,
+            temp, top_k, top_p, seeds, counters,
+        )
+        self.pool.k, self.pool.v = k, v
+        nxt = np.asarray(nxt)  # ONE host sync for the whole batch
+        for i, req in active:
+            self._emit(req, int(nxt[i]))
+        return True
+
+    def _emit(self, req: Request, tok: int) -> None:
+        """Record one sampled token: stream it, update latency metrics,
+        finish on stop token / max_tokens / model-length cap."""
+        now = time.time()
+        m = _metrics()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            m["ttft"].observe(now - req.arrival_t)
+        elif req.last_token_t is not None:
+            m["itl"].observe(now - req.last_token_t)
+        req.last_token_t = now
+        req.out.append(tok)
+        req.stream.put(("token", tok))
+        self._tokens_generated += 1
+        m["tokens"].inc()
+        p = req.params
+        if tok in p.stop_token_ids:
+            self.scheduler.finish(req, FINISH_STOP)
+        elif len(req.out) >= p.max_tokens or req.seq_len >= self.max_model_len:
+            self.scheduler.finish(req, FINISH_LENGTH)
+
+    def _publish_gauges(self) -> None:
+        m = _metrics()
+        m["running"].set(self.scheduler.num_running)
+        m["waiting"].set(self.scheduler.num_waiting)
+        m["kv_util"].set(self.pool.utilization())

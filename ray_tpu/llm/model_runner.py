@@ -1,0 +1,293 @@
+"""Paged-cache model execution: jitted prefill-chunk and decode-step fns.
+
+Bridges the model zoo (``models.gpt``, ``models.gptj``) to the paged KV
+cache: where ``gptj_decode`` owns a dense per-call cache, these functions
+thread the SHARED block pool through every call — scatter the new
+positions' k/v into physical blocks, attend via ``ops.paged_attention``,
+and hand back the updated pool arrays (functional updates; the engine
+holds the current version).
+
+Two entry shapes, each jitted once per engine:
+
+* ``decode_step`` — (slots,) one token per running slot, batched across
+  heterogeneous sequences (different lengths, block tables, sampling
+  params).  Inactive slots carry position 0 and an all-trash block table;
+  their writes land in reserved block 0 and their sampled tokens are
+  discarded host-side.
+* ``prefill_chunk`` — (chunk,) tokens of ONE sequence at positions
+  ``start..start+chunk`` (tail-padded; padded positions scatter to the
+  trash block).  Returns the last valid position's logits so the final
+  chunk seeds the first generated token.
+
+Static shapes everywhere: slot count, chunk size, table width, and pool
+geometry are compile-time constants — admission, preemption, and
+completion never retrace.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt import GPTConfig, _layernorm
+from ray_tpu.models.gptj import GPTJConfig
+from ray_tpu.models.sampling import sample_tokens
+from ray_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_prefill_attention_xla,
+)
+
+
+def _rotary_rows(x: jax.Array, positions: jax.Array, rotary_dim: int) -> jax.Array:
+    """GPT-J interleaved rotary with PER-ROW positions. x: (n, heads, hd);
+    positions: (n,) int32.  (models.gptj applies one shared position vector
+    across the batch; decode slots each sit at a different position.)"""
+    inv_freq = 1.0 / (
+        10000.0 ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (n, r/2)
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    rot, pas = x[..., :rotary_dim], x[..., rotary_dim:]
+    r = rot.astype(jnp.float32).reshape(*rot.shape[:-1], rotary_dim // 2, 2)
+    x1, x2 = r[..., 0], r[..., 1]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape).astype(x.dtype)
+    return jnp.concatenate([out, pas], axis=-1) if pas.shape[-1] else out
+
+
+def _scatter_kv(pool_l: jax.Array, vals: jax.Array, phys: jax.Array, off: jax.Array):
+    """Write per-row k or v into physical blocks.  pool_l: (num_blocks,
+    heads, block, d); vals: (n, heads, d); phys/off: (n,) int32."""
+    n, heads, _ = vals.shape
+    return pool_l.at[
+        phys[:, None], jnp.arange(heads)[None, :], off[:, None], :
+    ].set(vals)
+
+
+def _sample_rows(logits, seeds, counters, temp, top_k, top_p):
+    """Per-row sampling with per-request determinism: row i's key derives
+    from (seeds[i], counters[i]) only, so a request draws the same tokens
+    no matter which slot or step it lands in."""
+    keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(
+        seeds, counters
+    )
+    one = lambda lg, k, t, kk, pp: sample_tokens(
+        lg[None, :], k, t[None], kk[None], pp[None]
+    )[0]
+    return jax.vmap(one)(logits, keys, temp, top_k, top_p)
+
+
+class PagedModelRunner:
+    """Owns the jitted step functions for one (config, params) pair."""
+
+    def __init__(self, cfg: Any, params: dict, block_size: int, attn_impl: str = "auto"):
+        if isinstance(cfg, GPTJConfig):
+            self.arch = "gptj"
+        elif isinstance(cfg, GPTConfig):
+            if cfg.n_experts > 0:
+                raise NotImplementedError("paged decode supports dense GPT only")
+            self.arch = "gpt"
+        else:
+            raise TypeError(f"unsupported model config {type(cfg).__name__}")
+        self.cfg = cfg
+        self.params = params
+        self.block_size = block_size
+        self.attn_impl = attn_impl
+        # donate the pool buffers: the scatter of each step's k/v updates
+        # in place instead of copying the whole pool every call (the pool
+        # is the biggest array in inference — a per-step copy would cost
+        # more than the step's math)
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._prefill = jax.jit(
+            self._prefill_impl, donate_argnums=(1, 2), static_argnames=("chunk",)
+        )
+
+    # -- shared layer math -------------------------------------------------
+
+    def _qkv_rows(self, layer, h, positions):
+        """h: (n, d) post-ln hidden → q/k/v (n, heads, hd), rotary applied
+        for gptj."""
+        cfg = self.cfg
+        dt = h.dtype
+        n = h.shape[0]
+        nh, hd = cfg.n_heads, cfg.head_dim
+        if self.arch == "gptj":
+            q = (h @ layer["q"]["kernel"].astype(dt)).reshape(n, nh, hd)
+            k = (h @ layer["k"]["kernel"].astype(dt)).reshape(n, nh, hd)
+            v = (h @ layer["v"]["kernel"].astype(dt)).reshape(n, nh, hd)
+            q = _rotary_rows(q, positions, cfg.rotary_dim)
+            k = _rotary_rows(k, positions, cfg.rotary_dim)
+        else:
+            qkv = h @ layer["attn_qkv"]["kernel"].astype(dt) + layer["attn_qkv"][
+                "bias"
+            ].astype(dt)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(n, nh, hd)
+            k = k.reshape(n, nh, hd)
+            v = v.reshape(n, nh, hd)
+        return q, k, v
+
+    def _mlp(self, layer, h):
+        dt = h.dtype
+        mid = jax.nn.gelu(
+            h @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt)
+        )
+        return mid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"][
+            "bias"
+        ].astype(dt)
+
+    def _attn_out(self, layer, att_flat):
+        dt = att_flat.dtype
+        out = att_flat @ layer["attn_out"]["kernel"].astype(dt)
+        if self.arch == "gpt":
+            out = out + layer["attn_out"]["bias"].astype(dt)
+        return out
+
+    def _embed(self, tokens, positions):
+        cfg, params = self.cfg, self.params
+        dt = jnp.dtype(cfg.dtype)
+        x = params["embed"]["tokens"][tokens].astype(dt)
+        if self.arch == "gpt":
+            # clamp: padded prefill-tail positions may run past the table
+            pos = jnp.minimum(positions, cfg.seq_len - 1)
+            x = x + params["embed"]["pos"][pos].astype(dt)
+        return x
+
+    def _lm_head(self, h):
+        params = self.params
+        h = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        logits = h.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        if self.arch == "gptj":
+            logits = logits + params["lm_head"]["bias"]
+        return logits
+
+    # -- decode step -------------------------------------------------------
+
+    def _decode_impl(
+        self,
+        params,
+        k_pool,      # (L, NB, H, BS, D)
+        v_pool,
+        tokens,      # (S,) int32 — the token being FED per slot
+        positions,   # (S,) int32 — its position (== cache length before it)
+        tables,      # (S, T) int32
+        temp,        # (S,) f32
+        top_k,       # (S,) i32
+        top_p,       # (S,) f32
+        seeds,       # (S,) u32 — per-request sampling seed
+        counters,    # (S,) i32 — index of the token being sampled
+    ):
+        cfg = self.cfg
+        bs = self.block_size
+        x = self._embed(tokens, positions)  # (S, d)
+        phys = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
+        off = positions % bs
+        lengths = positions + 1
+        runner = self
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+            if runner.arch == "gptj":
+                h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, h, positions)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_attention(
+                    q, k_l, v_l, tables, lengths, impl=runner.attn_impl
+                ).astype(x.dtype)
+                att = runner._attn_out(layer, att.reshape(x.shape[0], cfg.d_model))
+                out = x + att + runner._mlp(layer, h)  # parallel residual
+            else:
+                ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, ln1, positions)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_attention(
+                    q, k_l, v_l, tables, lengths, impl=runner.attn_impl
+                ).astype(x.dtype)
+                h = x + runner._attn_out(layer, att.reshape(x.shape[0], cfg.d_model))
+                ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+                out = h + runner._mlp(layer, ln2)
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        logits = self._lm_head(x)  # (S, V)
+        nxt = _sample_rows(logits, seeds, counters, temp, top_k, top_p)
+        return k_pool, v_pool, nxt
+
+    def decode_step(self, k_pool, v_pool, tokens, positions, tables,
+                    temp, top_k, top_p, seeds, counters):
+        return self._decode(
+            self.params, k_pool, v_pool, tokens, positions, tables,
+            temp, top_k, top_p, seeds, counters,
+        )
+
+    # -- prefill chunk -----------------------------------------------------
+
+    def _prefill_impl(
+        self,
+        params,
+        k_pool,
+        v_pool,
+        tokens,     # (chunk,) int32, tail-padded
+        start,      # scalar int32 — position of tokens[0]
+        n_valid,    # scalar int32 — valid tokens in this chunk
+        table,      # (T,) int32 — THIS sequence's block table
+        *,
+        chunk: int,
+    ):
+        cfg = self.cfg
+        bs = self.block_size
+        positions = start + jnp.arange(chunk, dtype=jnp.int32)
+        valid = jnp.arange(chunk) < n_valid
+        x = self._embed(tokens, positions)  # (chunk, d)
+        phys = jnp.where(valid, table[positions // bs], 0)  # padded → trash
+        off = positions % bs
+        runner = self
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+            if runner.arch == "gptj":
+                h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, h, positions)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_prefill_attention_xla(
+                    q, k_l, v_l, table, positions
+                ).astype(x.dtype)
+                att = runner._attn_out(layer, att.reshape(chunk, cfg.d_model))
+                out = x + att + runner._mlp(layer, h)
+            else:
+                ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, ln1, positions)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_prefill_attention_xla(
+                    q, k_l, v_l, table, positions
+                ).astype(x.dtype)
+                h = x + runner._attn_out(layer, att.reshape(chunk, cfg.d_model))
+                ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+                out = h + runner._mlp(layer, ln2)
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        last = x[jnp.maximum(n_valid - 1, 0)]  # (d,)
+        logits = self._lm_head(last[None, :])[0]  # (V,)
+        return k_pool, v_pool, logits
+
+    def prefill_chunk(self, k_pool, v_pool, tokens, start, n_valid, table):
+        return self._prefill(
+            self.params, k_pool, v_pool, tokens,
+            jnp.int32(start), jnp.int32(n_valid), table, chunk=len(tokens),
+        )

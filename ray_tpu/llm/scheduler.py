@@ -1,0 +1,223 @@
+"""Continuous-batching scheduler: request lifecycle + slot/block policy.
+
+Reference shape: vLLM's scheduler (waiting / running queues over a paged
+block pool) recast onto this repo's static-shape discipline — the engine
+has a FIXED number of decode slots (the jitted step's batch dimension);
+the scheduler's job is to keep those slots full:
+
+* **admission** — FIFO: a waiting request takes a free slot when the pool
+  can cover its prompt plus one generated block (headroom so a fresh
+  admission can't instantly deadlock on its first decode step).
+* **chunked prefill** — an admitted request prefills
+  ``prefill_chunk``-sized pieces, one chunk per engine step, interleaved
+  with decode for the already-running slots — long prompts never stall
+  in-flight generations (TTFT of running streams is protected).
+* **preemption** — when a running sequence needs a block and the pool is
+  dry, the YOUNGEST running request (latest admission) is evicted:
+  blocks freed, generated-so-far tokens folded into its prompt, request
+  requeued at the FRONT of the waiting queue (recompute-style preemption
+  — re-prefill is cheap next to stalling the whole batch, and
+  oldest-first survival preserves FIFO fairness).
+
+All state transitions happen under the engine's lock; this module holds
+no thread of its own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ray_tpu.llm.cache import KVBlockPool
+
+_req_counter = itertools.count()
+
+# request states
+WAITING = "waiting"
+PREFILL = "prefill"     # owns a slot + blocks; prompt partially processed
+RUNNING = "running"     # decode steps produce tokens
+FINISHED = "finished"
+
+# finish reasons
+FINISH_LENGTH = "length"
+FINISH_STOP = "stop"
+FINISH_CANCELLED = "cancelled"
+FINISH_DEADLINE = "deadline"
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (see ``models.sampling``).
+    ``temperature <= 0`` is greedy; ``stop_token_ids`` ends generation
+    AFTER emitting a listed token (the stop token is included in the
+    output, HF-eos style)."""
+
+    max_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token_ids: tuple = ()
+    seed: int = 0
+
+
+class Request:
+    """One generation request; carries its own stream queue so a serve
+    replica thread can iterate tokens while the engine thread steps."""
+
+    def __init__(
+        self,
+        prompt: list[int],
+        params: SamplingParams,
+        deadline: Optional[float] = None,  # absolute time.time() cutoff
+    ):
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        self.id = f"req-{next(_req_counter)}"
+        self.prompt = list(prompt)
+        self.params = params
+        self.deadline = deadline
+        self.arrival_t = time.time()
+        self.state = WAITING
+        self.finish_reason: Optional[str] = None
+        self.out: list[int] = []
+        self.prefill_pos = 0          # prompt tokens already in the cache
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.cancelled = threading.Event()
+        # stream events: ("token", id) ... ("done", reason)
+        self.stream: queue.SimpleQueue = queue.SimpleQueue()
+
+    @property
+    def seq_len(self) -> int:
+        """Tokens currently in (or destined for) the cache."""
+        return len(self.prompt) + len(self.out)
+
+    @property
+    def finished(self) -> bool:
+        return self.state == FINISHED
+
+
+class Scheduler:
+    """Slot + block bookkeeping. NOT thread-safe on its own — the engine
+    serializes access under its step lock."""
+
+    def __init__(self, pool: KVBlockPool, max_slots: int):
+        self.pool = pool
+        self.max_slots = max_slots
+        self.waiting: deque[Request] = deque()
+        self.slots: list[Optional[Request]] = [None] * max_slots
+        self._admit_seq = itertools.count()
+        self._admitted_at: dict[str, int] = {}  # request id -> admission tick
+        self.preempt_count = 0
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def running(self) -> list[Request]:
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.num_running > 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def add(self, req: Request) -> None:
+        self.waiting.append(req)
+
+    def admit(self) -> list[Request]:
+        """Move waiting → slots while a slot is free and the pool can cover
+        prompt + one generation block. Returns the newly admitted."""
+        admitted = []
+        while self.waiting:
+            free = [i for i, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            req = self.waiting[0]
+            # prompt (+ recomputed tokens after preempt) + one generation
+            # block of headroom, capped at the table width for sequences
+            # already near the model-length limit
+            need_tokens = min(
+                req.seq_len + self.pool.cfg.block_size, self.pool.cfg.max_seq_len
+            )
+            if not self.pool.can_allocate(need_tokens):
+                break  # FIFO head blocked on memory: don't starve it by skipping
+            self.waiting.popleft()
+            self.pool.allocate(req.id, need_tokens)
+            slot = free[0]
+            self.slots[slot] = req
+            req.state = PREFILL
+            req.prefill_pos = 0
+            self._admitted_at[req.id] = next(self._admit_seq)
+            admitted.append(req)
+        return admitted
+
+    def grow_for_decode(self, req: Request) -> bool:
+        """Ensure the token the next decode step writes (position
+        ``seq_len - 1``) has a cache slot, preempting younger requests if
+        the pool is dry. Returns False when ``req`` itself had to be
+        preempted (nobody younger to evict)."""
+        while not self.pool.grow_to(req.id, req.seq_len):
+            victim = self._youngest_running(exclude=req.id)
+            if victim is None:
+                self.preempt(req)
+                return False
+            self.preempt(victim)
+        return True
+
+    def _youngest_running(self, exclude: str) -> Optional[Request]:
+        cands = [
+            r for r in self.slots
+            if r is not None and r.id != exclude
+        ]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: self._admitted_at.get(r.id, -1))
+
+    def preempt(self, req: Request) -> None:
+        """Evict a running/prefilling request: free its blocks and requeue
+        it at the FRONT of the waiting queue. Recompute on re-admission:
+        ``req.out`` is untouched (already-streamed tokens stay delivered
+        and keep counting toward ``max_tokens``) — the re-prefill replays
+        prompt + out to rebuild the cache, then generation continues."""
+        slot = self._slot_of(req)
+        if slot is not None:
+            self.slots[slot] = None
+        self.pool.free(req.id)
+        self._admitted_at.pop(req.id, None)
+        self.preempt_count += 1
+        req.prefill_pos = 0
+        req.state = WAITING
+        self.waiting.appendleft(req)
+
+    def finish(self, req: Request, reason: str) -> None:
+        slot = self._slot_of(req)
+        if slot is not None:
+            self.slots[slot] = None
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+        self.pool.free(req.id)
+        self._admitted_at.pop(req.id, None)
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.stream.put(("done", reason))
+
+    def _slot_of(self, req: Request) -> Optional[int]:
+        for i, r in enumerate(self.slots):
+            if r is not None and r.id == req.id:
+                return i
+        return None

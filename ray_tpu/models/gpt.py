@@ -352,3 +352,129 @@ def gpt_loss(cfg: GPTConfig, params: dict, tokens: jax.Array, mesh=None) -> jax.
     if cfg.n_experts > 0:
         loss = loss + cfg.moe_aux_weight * aux
     return loss
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (inference path; sampling shared with models.sampling)
+# ---------------------------------------------------------------------------
+
+
+def gpt_decode(
+    cfg: GPTConfig,
+    params: dict,
+    prompt: jax.Array,
+    n_new: int,
+    *,
+    key: Optional[jax.Array] = None,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> jax.Array:
+    """Decode ``n_new`` tokens after ``prompt`` (b, s0) int32 →
+    (b, s0 + n_new), KV-cached with static shapes (same discipline as
+    ``models.gptj.gptj_decode``: one prefill forward capturing per-layer
+    k/v, then a ``lax.fori_loop`` of single-position steps). Greedy by
+    default; with a PRNG ``key``, per-token temperature/top-k/top-p via
+    ``models.sampling.sample_tokens`` (scalars or per-row arrays).
+
+    Dense blocks only (``n_experts == 0``); the learned positional table
+    caps ``s0 + n_new`` at ``cfg.seq_len``."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("gpt_decode supports dense (non-MoE) configs only")
+    dt = jnp.dtype(cfg.dtype)
+    b, s0 = prompt.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    max_len = s0 + n_new
+    if max_len > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({s0}) + n_new ({n_new}) exceeds the positional table "
+            f"(seq_len={cfg.seq_len})"
+        )
+
+    def pick(logits, step_idx):
+        if key is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        from ray_tpu.models.sampling import sample_tokens
+
+        return sample_tokens(
+            logits, jax.random.fold_in(key, step_idx), temperature, top_k, top_p
+        )
+
+    def heads(t, s):
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    # ---- prefill: normal stacked forward, capturing per-layer k/v
+    x = params["embed"]["tokens"][prompt].astype(dt)
+    x = x + params["embed"]["pos"][:s0].astype(dt)
+
+    def prefill_block(carry, layer):
+        ln1 = _layernorm(carry, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        qkv = ln1 @ layer["attn_qkv"]["kernel"].astype(dt) + layer["attn_qkv"]["bias"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = causal_attention(heads(q, s0), heads(k, s0), heads(v, s0), impl="xla")
+        att = att.transpose(0, 2, 1, 3).reshape(b, s0, cfg.d_model)
+        att = att @ layer["attn_out"]["kernel"].astype(dt) + layer["attn_out"]["bias"].astype(dt)
+        h = carry + att
+        ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+        mid = jax.nn.gelu(
+            ln2 @ layer["mlp_in"]["kernel"].astype(dt) + layer["mlp_in"]["bias"].astype(dt)
+        )
+        mlp = mid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
+        pad = jnp.zeros((b, nh, n_new, hd), dt)
+        kc = jnp.concatenate([heads(k, s0).astype(dt), pad], axis=2)
+        vc = jnp.concatenate([heads(v, s0).astype(dt), pad], axis=2)
+        return h + mlp, (kc, vc)
+
+    x, (k_caches, v_caches) = jax.lax.scan(prefill_block, x, params["blocks"])
+    hlast = _layernorm(x[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = hlast.astype(jnp.float32) @ params["lm_head"]["kernel"]
+    first_new = pick(logits, 0)  # (b,)
+
+    tokens = jnp.concatenate([prompt, jnp.zeros((b, n_new), jnp.int32)], axis=1)
+    tokens = jax.lax.dynamic_update_slice(tokens, first_new[:, None], (0, s0))
+
+    def step(i, carry):
+        tokens, k_caches, v_caches = carry
+        pos = s0 + i  # position of the token being FED
+        tok = jax.lax.dynamic_slice(tokens, (0, pos), (b, 1))[:, 0]
+        x1 = params["embed"]["tokens"][tok].astype(dt)  # (b, d)
+        x1 = x1 + jax.lax.dynamic_slice(
+            params["embed"]["pos"], (pos, 0), (1, cfg.d_model)
+        ).astype(dt)
+
+        def one_layer(carry1, inputs):
+            x1 = carry1
+            layer, kc, vc = inputs
+            ln1 = _layernorm(x1, layer["ln1"]["scale"], layer["ln1"]["bias"])
+            qkv = ln1 @ layer["attn_qkv"]["kernel"].astype(dt) + layer["attn_qkv"]["bias"].astype(dt)
+            q, k, v = jnp.split(qkv, 3, axis=-1)  # (b, d) each
+            q = q.reshape(b, nh, hd)
+            k = k.reshape(b, nh, 1, hd).astype(dt)
+            v = v.reshape(b, nh, 1, hd).astype(dt)
+            kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+            from ray_tpu.models.gptj import _attend_cached
+
+            att = _attend_cached(q, kc, vc, pos + 1).astype(dt)
+            att = att.reshape(b, cfg.d_model) @ layer["attn_out"]["kernel"].astype(dt)
+            att = att + layer["attn_out"]["bias"].astype(dt)
+            h = x1 + att
+            ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+            mid = jax.nn.gelu(
+                ln2 @ layer["mlp_in"]["kernel"].astype(dt)
+                + layer["mlp_in"]["bias"].astype(dt)
+            )
+            mlp = mid @ layer["mlp_out"]["kernel"].astype(dt) + layer["mlp_out"]["bias"].astype(dt)
+            return h + mlp, (kc, vc)
+
+        x1, (k_caches, v_caches) = jax.lax.scan(
+            one_layer, x1, (params["blocks"], k_caches, v_caches)
+        )
+        h1 = _layernorm(x1, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        logits = h1.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        nxt = pick(logits, i + 1)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
+        return tokens, k_caches, v_caches
+
+    tokens, _, _ = jax.lax.fori_loop(0, n_new - 1, step, (tokens, k_caches, v_caches))
+    return tokens

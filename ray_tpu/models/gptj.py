@@ -247,12 +247,26 @@ def _attend_cached(q1, k_cache, v_cache, length):
 
 
 def gptj_decode(
-    cfg: GPTJConfig, params: dict, prompt: jax.Array, n_new: int
+    cfg: GPTJConfig,
+    params: dict,
+    prompt: jax.Array,
+    n_new: int,
+    *,
+    key: Optional[jax.Array] = None,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
 ) -> jax.Array:
-    """Greedy decode ``n_new`` tokens after ``prompt`` (b, s0) int32 →
+    """Decode ``n_new`` tokens after ``prompt`` (b, s0) int32 →
     (b, s0 + n_new). Prefill computes the prompt's KV cache in one forward;
     each new token is a single-position pass over the cache (static shapes
-    throughout: jit once, decode under ``lax.fori_loop``)."""
+    throughout: jit once, decode under ``lax.fori_loop``).
+
+    Sampling: greedy by default (``key=None``). With a PRNG ``key``,
+    per-token temperature / top-k / top-p sampling via
+    ``models.sampling.sample_tokens`` (scalars or per-row arrays); step
+    ``i`` folds ``i`` into the key, so continuation from any prefix is
+    reproducible."""
     dt = jnp.dtype(cfg.dtype)
     b, s0 = prompt.shape
     L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
@@ -281,12 +295,21 @@ def gptj_decode(
         vc = jnp.concatenate([v.astype(dt), pad], axis=2)
         return carry + att + mlp, (kc, vc)
 
+    def pick(logits, step_idx):
+        if key is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        from ray_tpu.models.sampling import sample_tokens
+
+        return sample_tokens(
+            logits, jax.random.fold_in(key, step_idx), temperature, top_k, top_p
+        )
+
     x, (k_caches, v_caches) = jax.lax.scan(prefill_block, x, params["blocks"])
     hlast = _layernorm(
         x[:, -1], params["ln_f"]["scale"], params["ln_f"]["bias"]
     )
     logits = hlast.astype(jnp.float32) @ params["lm_head"]["kernel"] + params["lm_head"]["bias"]
-    first_new = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (b,)
+    first_new = pick(logits, 0)  # (b,)
 
     tokens = jnp.concatenate(
         [prompt, jnp.zeros((b, n_new), jnp.int32)], axis=1
@@ -330,7 +353,7 @@ def gptj_decode(
             h1.astype(jnp.float32) @ params["lm_head"]["kernel"]
             + params["lm_head"]["bias"]
         )
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = pick(logits, i + 1)
         tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
         return tokens, k_caches, v_caches
 

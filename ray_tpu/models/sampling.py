@@ -1,0 +1,68 @@
+"""Per-token sampling shared by the decode paths and the LLM engine.
+
+One helper — ``sample_tokens`` — implements greedy / temperature /
+top-k / top-p over a batch of next-token logit rows, with every knob
+accepted either as a scalar (whole batch) or as a per-row array (the
+continuous-batching engine mixes requests with different sampling params
+in one decode step). Everything is jit-safe with static shapes: dynamic
+per-row ``k`` is implemented by ranking a full descending sort rather
+than ``lax.top_k`` (whose k must be static), which also gives top-p its
+cumulative mass for free from the same sort.
+
+Convention: ``temperature <= 0`` means greedy (argmax) for that row —
+the PRNG key is still consumed uniformly so a batch mixing greedy and
+sampled rows stays deterministic per-row regardless of its neighbors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> jax.Array:
+    """Sample one token id per row: (batch, vocab) fp logits -> (batch,) int32.
+
+    ``temperature``/``top_k``/``top_p`` are scalars or (batch,) arrays.
+    ``top_k <= 0`` disables the k-truncation; ``top_p >= 1`` the nucleus
+    truncation; ``temperature <= 0`` selects greedy argmax for that row.
+    ``key`` is one PRNG key for the whole call — rows draw from
+    per-row splits so the same (key, row) pair always reproduces.
+    """
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_t = jnp.maximum(temp, 1e-6)[:, None]
+    scaled = logits / safe_t
+    # one descending sort serves both truncations: rank < k for top-k,
+    # exclusive cumulative mass < p for top-p (rank 0 always survives)
+    order = jnp.argsort(-scaled, axis=-1)
+    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
+    ranks = jnp.arange(v)[None, :]
+    probs = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (kk[:, None] <= 0) | (ranks < kk[:, None])
+    keep &= (cum - probs) < pp[:, None]
+    masked_sorted = jnp.where(keep, sorted_scaled, _NEG_INF)
+    # scatter the surviving logits back to vocab order
+    masked = (
+        jnp.full_like(scaled, _NEG_INF)
+        .at[jnp.arange(b)[:, None], order]
+        .set(masked_sorted)
+    )
+    keys = jax.random.split(key, b)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)

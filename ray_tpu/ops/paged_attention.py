@@ -1,0 +1,253 @@
+"""Paged single-position attention over a block-table KV cache.
+
+Generalizes ``models.gptj._attend_cached`` (one query row against a dense
+per-sequence cache) to the paged layout the ``ray_tpu.llm`` engine uses:
+the cluster-wide KV cache is a fixed pool of physical blocks
+
+    k_pool, v_pool : (num_blocks, heads, block_size, head_dim)
+
+and each decode slot owns a *block table* mapping its logical block index
+to a physical block id.  Static shapes throughout — the pool size, block
+size, and table width are compile-time constants; only the table CONTENTS
+and per-slot lengths are data — so the engine jits one decode step and
+reuses it for every admission/eviction pattern.
+
+Two interchangeable paths behind one signature (same contract as
+``ops.attention``):
+
+* ``xla``    — gather the table's blocks into a dense (slots, heads,
+  table*block, d) view, masked softmax.  The reference path; also what
+  multi-chip pjit partitions cleanly.
+* ``pallas`` — a scalar-prefetch Pallas kernel: grid (slot, logical
+  block), the block table is prefetched so each step DMAs exactly its
+  physical KV block from HBM, online-softmax accumulation across the
+  minor (block) grid dimension.  No (slots, table*block) score matrix
+  and no gathered cache copy ever materializes.  Runs interpreted
+  off-TPU so CPU CI exercises the same code path (parity test:
+  ``tests/test_llm_engine.py``).
+
+``auto`` picks the Pallas kernel on TPU when the shapes tile the MXU
+(block_size a multiple of 8, head_dim of 128), else XLA.
+
+Convention: table entries past a sequence's allocation MUST point at a
+valid physical block (the engine pads with block 0, its reserved trash
+block); masking by ``lengths`` makes their values irrelevant.  Slots with
+``length == 0`` produce finite garbage (big-negative masking, never NaN)
+— callers discard inactive slots.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_xla(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+) -> jax.Array:
+    """q: (slots, heads, d); pools: (num_blocks, heads, block, d);
+    block_tables: (slots, tmax) int32; lengths: (slots,) int32 — valid
+    cache positions per slot (new token's k/v already written).
+    Returns (slots, heads, d) in q.dtype, fp32 softmax accumulation."""
+    s, h, d = q.shape
+    scale = d**-0.5
+    k = k_pool[block_tables]  # (slots, tmax, heads, block, d)
+    v = v_pool[block_tables]
+    k = k.transpose(0, 2, 1, 3, 4).reshape(s, h, -1, d)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(s, h, -1, d)
+    logits = jnp.einsum(
+        "shd,shkd->shk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = jnp.arange(k.shape[2])[None, None, :] < lengths[:, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shk,shkd->shd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_prefill_attention_xla(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Chunked-prefill attention for ONE sequence: each chunk query at
+    ``positions[i]`` attends causally over the sequence's paged cache
+    (chunk k/v already scattered in).  q: (chunk, heads, d);
+    block_table: (tmax,) int32; positions: (chunk,) int32.  Returns
+    (chunk, heads, d)."""
+    c, h, d = q.shape
+    scale = d**-0.5
+    k = k_pool[block_table]  # (tmax, heads, block, d)
+    v = v_pool[block_table]
+    k = k.transpose(1, 0, 2, 3).reshape(h, -1, d)
+    v = v.transpose(1, 0, 2, 3).reshape(h, -1, d)
+    logits = jnp.einsum(
+        "chd,hkd->chk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = jnp.arange(k.shape[1])[None, None, :] <= positions[:, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("chk,hkd->chd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(
+    # scalar prefetch
+    tables_ref,   # (slots * tmax,) int32 — flattened block tables
+    lengths_ref,  # (slots,) int32
+    # blocked inputs
+    q_ref,        # (1, heads, d)
+    k_ref,        # (1, heads, block, d) — THE slot's j-th physical block
+    v_ref,
+    # blocked output
+    o_ref,        # (1, heads, d)
+    # scratch (carried across the minor grid dim)
+    acc_ref,      # (heads, d) f32
+    m_ref,        # (heads, 1) f32
+    l_ref,        # (heads, 1) f32
+    *,
+    block_size: int,
+    scale: float,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[s]
+
+    @pl.when(j * block_size < length)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)            # (heads, d)
+        k = k_ref[0].astype(jnp.float32)            # (heads, block, d)
+        v = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k,
+            (((1,), (2,)), ((0,), (0,))),           # contract d, batch heads
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # (heads, block)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        scores = jnp.where(pos < length, scores, NEG_INF)
+
+        m_prev = m_ref[...]                          # (heads, 1)
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)              # (heads, 1)
+        p = jnp.exp(scores - m_new)                  # (heads, block)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            (((1,), (1,)), ((0,), (0,))),            # contract block, batch heads
+            preferred_element_type=jnp.float32,
+        )                                            # (heads, d)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _flush():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pool, v_pool, block_tables, lengths):
+    slots, heads, d = q.shape
+    _, _, block_size, _ = k_pool.shape
+    tmax = block_tables.shape[1]
+    scale = d**-0.5
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        # minor (block) dimension executes sequentially on TPU, so the
+        # online-softmax scratch carries across a slot's kv blocks
+        grid=(slots, tmax),
+        in_specs=[
+            pl.BlockSpec((1, heads, d), lambda s, j, tbl, lens: (s, 0, 0)),
+            pl.BlockSpec(
+                (1, heads, block_size, d),
+                lambda s, j, tbl, lens: (tbl[s * tmax + j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, heads, block_size, d),
+                lambda s, j, tbl, lens: (tbl[s * tmax + j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, heads, d), lambda s, j, tbl, lens: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, d), jnp.float32),
+            pltpu.VMEM((heads, 1), jnp.float32),
+            pltpu.VMEM((heads, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, block_size=block_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, heads, d), q.dtype),
+        interpret=_interpret(),
+    )(block_tables.reshape(-1).astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    impl: str = "auto",
+) -> jax.Array:
+    """Single-position attention over a paged KV cache (see module doc).
+
+    q: (slots, heads, head_dim); k_pool/v_pool: (num_blocks, heads,
+    block_size, head_dim); block_tables: (slots, tmax) int32; lengths:
+    (slots,) int32.  ``impl``: auto | xla | pallas.
+    """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"unknown paged attention impl {impl!r}; expected 'auto', 'xla' "
+            "or 'pallas'"
+        )
+    if impl == "xla":
+        return paged_attention_xla(q, k_pool, v_pool, block_tables, lengths)
+    if impl == "auto":
+        _, _, block_size, d = k_pool.shape
+        # off-TPU the kernel would run interpreted (orders of magnitude
+        # slower than compiled XLA); on TPU it needs MXU-friendly tiling
+        if _interpret() or block_size % 8 or d % 128:
+            return paged_attention_xla(q, k_pool, v_pool, block_tables, lengths)
+    return _paged_pallas(q, k_pool, v_pool, block_tables, lengths)

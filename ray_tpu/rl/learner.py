@@ -91,12 +91,13 @@ class Learner:
         rows = batch.count
         dev_batch = self._device_batch(batch)
         grads, metrics = self._grads(self.params, dev_batch)
-        out = {}
-        for k, v in metrics.items():
-            a = np.asarray(v)
-            # same contract as update(): per-sample aux arrays (e.g. DQN
-            # |td| for prioritized replay) pass through, padding trimmed
-            out[k] = float(a) if a.ndim == 0 else a[:rows]
+        # ONE host transfer for the whole metrics pytree (not one sync per
+        # entry): same contract as update() — per-sample aux arrays (e.g.
+        # DQN |td| for prioritized replay) pass through, padding trimmed
+        host = jax.device_get(metrics)
+        out = {
+            k: (float(v) if np.ndim(v) == 0 else v[:rows]) for k, v in host.items()
+        }
         return jax.device_get(grads), out
 
     def apply_grads(self, grads) -> bool:
@@ -141,16 +142,19 @@ class Learner:
         return {k: jax.device_put(v) for k, v in arrays.items()}
 
     def update(self, batch: SampleBatch) -> dict:
+        import jax
+
         rows = batch.count
         dev_batch = self._device_batch(batch)
         self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, dev_batch)
-        out = {}
-        for k, v in metrics.items():
-            a = np.asarray(v)
-            # Per-sample aux outputs (e.g. DQN |td| for prioritized replay)
-            # pass through as arrays, trimmed of any data-axis padding rows.
-            out[k] = float(a) if a.ndim == 0 else a[:rows]
-        return out
+        # ONE host transfer for the whole metrics pytree — per-entry
+        # np.asarray would stall the XLA pipeline once per metric.
+        # Per-sample aux outputs (e.g. DQN |td| for prioritized replay)
+        # pass through as arrays, trimmed of any data-axis padding rows.
+        host = jax.device_get(metrics)
+        return {
+            k: (float(v) if np.ndim(v) == 0 else v[:rows]) for k, v in host.items()
+        }
 
     def get_weights(self):
         return self.params
@@ -249,12 +253,14 @@ class LearnerGroup:
         ray_tpu.get([a.apply_grads.remote(avg) for a in self._actors])
         metrics: dict = {}
         arrays: dict = {}
+        # compute_grads already device_get-s its metrics: everything here
+        # is host numpy, no per-entry device sync
         for w, (_g, m) in zip(weights, results):
             for key, v in m.items():
                 if np.ndim(v) == 0:
                     metrics[key] = metrics.get(key, 0.0) + w * float(v)
                 else:
-                    arrays.setdefault(key, []).append(np.asarray(v))
+                    arrays.setdefault(key, []).append(v)
         for key, parts in arrays.items():
             # per-sample aux (e.g. DQN |td|) re-assembles in shard order so
             # prioritized-replay priority updates keep working under DP

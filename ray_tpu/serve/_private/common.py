@@ -70,3 +70,12 @@ class ReplicaInfo:
     replica_id: str
     actor: Any                 # ray_tpu actor handle
     healthy: bool = True
+    #: False until the replica answers its first check_health — i.e. its
+    #: __init__ (model load, jit warmup) finished. Uninitialized replicas
+    #: are not routed to, not counted by ready(), and not health-checked
+    #: with the steady-state 5s timeout (a heavy model's init is MINUTES;
+    #: judging it against the ping timeout restart-looped every slow-init
+    #: replica).
+    initialized: bool = False
+    started_at: float = 0.0
+    init_ref: Any = None       # in-flight first check_health call

@@ -26,6 +26,9 @@ from ray_tpu.serve._private.common import (
 )
 
 RECONCILE_PERIOD_S = 0.25
+#: A replica that has not finished __init__ (answered its first health
+#: check) within this window is declared failed and replaced.
+REPLICA_INIT_TIMEOUT_S = 120.0
 
 
 class _DeploymentState:
@@ -141,7 +144,10 @@ class ServeController:
             return self._version, [], 1
         return (
             self._version,
-            [r.actor for r in state.replicas if r.healthy],
+            # only initialized replicas route: a request queued on a replica
+            # still loading its model would wait out the whole init inside
+            # the actor's task queue
+            [r.actor for r in state.replicas if r.healthy and r.initialized],
             max(state.spec.config.max_ongoing_requests, 1),
         )
 
@@ -189,10 +195,14 @@ class ServeController:
             }
 
     def ready(self) -> bool:
-        """True once every deployment has its target replica count healthy."""
+        """True once every deployment has its target replica count healthy
+        AND initialized (creation is async — counting replicas that are
+        still running __init__ would return "ready" before a single
+        request could be served)."""
         with self._lock:
             return all(
-                len([r for r in s.replicas if r.healthy]) >= s.target_replicas
+                len([r for r in s.replicas if r.healthy and r.initialized])
+                >= s.target_replicas
                 for s in self._deployments.values()
             )
 
@@ -322,8 +332,27 @@ class ServeController:
             self._autoscale(state)
             with self._lock:
                 spec = state.spec
-                # health-check existing replicas (cheap ping with timeout)
+                # health-check existing replicas. Replicas still running
+                # __init__ (model load / jit warmup can take minutes) are
+                # judged NON-BLOCKINGLY against their first check_health
+                # call — pinging them with the steady-state timeout used to
+                # mark every slow-init replica unhealthy and restart-loop
+                # the deployment.
                 for r in state.replicas:
+                    if not r.initialized:
+                        ready_refs, _ = ray_tpu.wait([r.init_ref], timeout=0)
+                        if ready_refs:
+                            try:
+                                ray_tpu.get(r.init_ref, timeout=5.0)
+                                r.initialized = True
+                                self._bump_version_locked()  # routers may now use it
+                            except Exception:
+                                r.healthy = False  # __init__ or first ping failed
+                        elif (
+                            time.time() - r.started_at > REPLICA_INIT_TIMEOUT_S
+                        ):
+                            r.healthy = False  # wedged at init: replace it
+                        continue
                     try:
                         ray_tpu.get(r.actor.check_health.remote(), timeout=5.0)
                     except Exception:
@@ -398,7 +427,17 @@ class ServeController:
             spec.init_kwargs,
             spec.config.user_config,
         )
-        state.replicas.append(ReplicaInfo(replica_id=rid, actor=actor))
+        state.replicas.append(
+            ReplicaInfo(
+                replica_id=rid,
+                actor=actor,
+                started_at=time.time(),
+                # queued behind __init__: resolves when the replica is
+                # actually constructed — the reconcile loop polls it
+                # non-blockingly to flip `initialized`
+                init_ref=actor.check_health.remote(),
+            )
+        )
 
     # -- autoscaling -------------------------------------------------------
 
@@ -409,7 +448,7 @@ class ServeController:
         if cfg is None:
             return
         with self._lock:
-            replicas = [r for r in state.replicas if r.healthy]
+            replicas = [r for r in state.replicas if r.healthy and r.initialized]
         if not replicas:
             return
         total_ongoing = 0

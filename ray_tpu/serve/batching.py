@@ -24,24 +24,75 @@ from typing import Any, Callable, Optional
 
 _CREATE_LOCK = threading.Lock()
 
+_METRICS = None
+
+
+def _metrics() -> dict:
+    """Batching saturation gauges, created once per process (under
+    _CREATE_LOCK: concurrent first submissions must not register duplicate
+    global gauges, which would fight in metrics collect()). The same
+    signal surface the LLM deployment exports: queue depth says the
+    replica is admission-bound, flush size says how full the batches the
+    MXU actually sees are — both tagged per batched function so replica
+    autoscaling (and Grafana) can tell WHICH entry point saturates."""
+    global _METRICS
+    if _METRICS is None:
+        with _CREATE_LOCK:
+            if _METRICS is not None:
+                return _METRICS
+            from ray_tpu.util.metrics import Gauge
+
+            _METRICS = {
+                "depth": Gauge(
+                    "serve_batch_queue_depth",
+                    "requests waiting in a @serve.batch queue",
+                    ("fn", "model"),
+                ),
+                "flush": Gauge(
+                    "serve_batch_last_flush_size",
+                    "batch size of the most recent flush",
+                    ("fn", "model"),
+                ),
+            }
+    return _METRICS
+
 
 class _BatchQueue:
-    def __init__(self, fn: Callable, max_batch_size: int, batch_wait_timeout_s: float):
+    def __init__(
+        self,
+        fn: Callable,
+        max_batch_size: int,
+        batch_wait_timeout_s: float,
+        name: str = "",
+        model_id: str = "",
+    ):
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
+        self.name = name or getattr(fn, "__name__", "batch")
+        # multiplexed deployments keep one queue PER model id — the gauge
+        # series must keep them apart or one model's idle queue overwrites
+        # another's backlog in the saturation signal
+        self._tags = {"fn": self.name, "model": model_id}
+        self.last_flush_size = 0
         self._lock = threading.Lock()
         self._queue: list[tuple[Any, Future]] = []
         self._flusher_active = False
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
 
     def submit(self, item: Any) -> Future:
         fut: Future = Future()
         flush_now = False
         with self._lock:
             self._queue.append((item, fut))
+            depth = len(self._queue)
             if not self._flusher_active:
                 self._flusher_active = True
                 flush_now = True
+        _metrics()["depth"].set(depth, tags=self._tags)
         if flush_now:
             threading.Thread(target=self._flush_loop, daemon=True).start()
         return fut
@@ -59,7 +110,12 @@ class _BatchQueue:
                 self._queue = self._queue[self.max_batch_size :]
                 if not batch:
                     self._flusher_active = False
+                    _metrics()["depth"].set(0, tags=self._tags)
                     return
+                depth = len(self._queue)
+            self.last_flush_size = len(batch)
+            _metrics()["flush"].set(len(batch), tags=self._tags)
+            _metrics()["depth"].set(depth, tags=self._tags)
             items = [b[0] for b in batch]
             try:
                 results = self.fn(items)
@@ -131,7 +187,10 @@ def batch(
                             finally:
                                 _set_request_model_id(None)
 
-                        q = _BatchQueue(run, max_batch_size, batch_wait_timeout_s)
+                        q = _BatchQueue(
+                            run, max_batch_size, batch_wait_timeout_s,
+                            name=fn.__name__, model_id=model_id or "",
+                        )
                         queues[model_id] = q
             return q.submit(item).result()
 

@@ -1,0 +1,194 @@
+"""serve.llm: stream-first LLM serving on top of ``ray_tpu.llm``.
+
+``LLMDeployment`` runs one ``LLMEngine`` inside each replica: a daemon
+thread turns the engine crank while replica request threads submit and
+stream.  ``__call__`` is a GENERATOR, so the serve stack's existing
+streaming-generator machinery does the rest — callers use
+
+    handle = serve.run(build_llm_app(model="gptj", model_cfg=cfg))
+    for tok in handle.options(stream=True).remote([1, 2, 3],
+                                                  max_tokens=32):
+        ...
+
+and tokens cross the cluster as they are sampled (TTFT ≈ one prefill +
+one decode step, not the whole completion).  ``generate`` is the
+blocking whole-completion method for non-streaming callers (a generator
+return can't pickle through ``handle_request``).
+
+Autoscaling: the replica exports the engine's queue depth and KV-cache
+utilization — both through ``util.metrics`` gauges (``llm_*`` series)
+and through ``autoscaling_metrics()`` for direct polling.  Since a
+continuous-batching replica absorbs many concurrent requests per slot
+set, ongoing-request counts alone under-report saturation; queue depth
+(> 0 means the engine is admission-bound) and KV utilization (≈ 1.0
+means preemption-bound) are the honest signals.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.scheduler import SamplingParams
+
+
+def _build_model(model: str, model_cfg, params, seed: int):
+    """Materialize (cfg, params) inside the replica — shipping a seed
+    instead of a parameter pytree keeps deployment specs small and lets
+    each replica initialize straight onto its own device."""
+    import jax
+
+    if model == "gptj":
+        from ray_tpu.models.gptj import GPTJ_6B, GPTJConfig, gptj_init
+
+        cfg = model_cfg or GPTJ_6B
+        if not isinstance(cfg, GPTJConfig):
+            raise TypeError(f"model_cfg must be a GPTJConfig, got {type(cfg).__name__}")
+        if params is None:
+            params = gptj_init(jax.random.PRNGKey(seed), cfg)
+    elif model == "gpt":
+        from ray_tpu.models.gpt import GPTConfig, gpt_init
+
+        cfg = model_cfg or GPTConfig()
+        if not isinstance(cfg, GPTConfig):
+            raise TypeError(f"model_cfg must be a GPTConfig, got {type(cfg).__name__}")
+        if params is None:
+            params = gpt_init(jax.random.PRNGKey(seed), cfg)
+    else:
+        raise ValueError(f"unknown model family {model!r}; expected 'gptj' or 'gpt'")
+    return cfg, params
+
+
+class LLMDeployment:
+    """The replica callable. Decorate/bind via ``build_llm_app`` (or apply
+    ``serve.deployment`` yourself for custom replica options)."""
+
+    def __init__(
+        self,
+        model: str = "gptj",
+        model_cfg=None,
+        params: Optional[dict] = None,
+        engine_config: Optional[EngineConfig] = None,
+        seed: int = 0,
+        warmup: bool = True,
+        stream_timeout_s: float = 300.0,
+    ):
+        cfg, params = _build_model(model, model_cfg, params, seed)
+        #: max wait for the next streamed token — must cover the ADMISSION
+        #: wait of a request queued behind a saturated engine, not just
+        #: inter-token gaps (the engine's own 60s default is too tight for
+        #: a deployment whose whole point is absorbing a deep queue)
+        self._stream_timeout_s = stream_timeout_s
+        self._engine = LLMEngine(cfg, params, engine_config)
+        if warmup:
+            # compile the prefill/decode/sampling jits NOW, inside replica
+            # creation, so serve.run's readiness gate covers compile time
+            # and the first real request streams at steady-state latency
+            self._engine.generate([0], SamplingParams(max_tokens=2))
+        self._stop = threading.Event()
+        self._loop = threading.Thread(
+            target=self._engine.run_loop, args=(self._stop,),
+            name="llm-engine-loop", daemon=True,
+        )
+        self._loop.start()
+
+    # -- request path ------------------------------------------------------
+
+    def __call__(
+        self,
+        prompt: list,
+        max_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: tuple = (),
+        seed: int = 0,
+        deadline_s: Optional[float] = None,
+    ):
+        """Streaming generation: yields token ids as the engine samples
+        them. Call with ``handle.options(stream=True)``; the generator
+        shape is what routes this through ``handle_request_streaming``."""
+        params = SamplingParams(
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            stop_token_ids=tuple(stop_token_ids),
+            seed=seed,
+        )
+        req = self._engine.submit([int(t) for t in prompt], params, deadline_s)
+        # with an explicit deadline the engine itself ends the stream at
+        # the deadline; the get-timeout only needs to outlast it
+        timeout = (
+            deadline_s + 5.0 if deadline_s is not None else self._stream_timeout_s
+        )
+        try:
+            yield from self._engine.stream_tokens(req, timeout=timeout)
+        finally:
+            # consumer walked away (stream closed/replica thread unwinding):
+            # stop generating for nobody
+            if not req.finished:
+                self._engine.cancel(req.id)
+
+    def generate(self, prompt: list, **kwargs) -> list:
+        """Blocking whole-completion variant for non-streaming callers."""
+        return list(self.__call__(prompt, **kwargs))
+
+    # -- control plane -----------------------------------------------------
+
+    def autoscaling_metrics(self) -> dict:
+        """Saturation signals for replica autoscaling: ``queue_depth``
+        (admission-bound) and ``kv_utilization`` (memory-bound) on top of
+        the running count the controller already polls."""
+        s = self._engine.stats()
+        return {
+            "queue_depth": s["queue_depth"],
+            "kv_utilization": s["kv_utilization"],
+            "running": s["running"],
+            "waiting": s["waiting"],
+        }
+
+    def stats(self) -> dict:
+        return self._engine.stats()
+
+    def check_health(self) -> None:
+        if not self._loop.is_alive():
+            raise RuntimeError("LLM engine loop thread died")
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:  # raylint: disable=RL007
+            pass  # interpreter teardown: the daemon thread dies with us
+
+
+def build_llm_app(
+    model: str = "gptj",
+    model_cfg=None,
+    engine_config: Optional[EngineConfig] = None,
+    seed: int = 0,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 16,
+    autoscaling_config=None,
+    name: str = "LLMDeployment",
+):
+    """Bind an ``LLMDeployment`` application (deploy with ``serve.run``).
+
+    ``max_ongoing_requests`` should comfortably exceed the engine's
+    ``max_slots`` — the whole point of continuous batching is holding
+    more concurrent streams than decode slots and letting the engine's
+    queue absorb the difference (queue depth then drives autoscaling).
+    """
+    from ray_tpu.serve.api import deployment
+
+    dep = deployment(
+        LLMDeployment,
+        name=name,
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=autoscaling_config,
+    )
+    return dep.bind(
+        model=model, model_cfg=model_cfg, engine_config=engine_config, seed=seed
+    )
