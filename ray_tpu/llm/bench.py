@@ -1,17 +1,25 @@
-"""Continuous vs. static batching under staggered arrivals.
+"""LLM engine benchmarks: continuous batching + speculative decoding.
 
-``python -m ray_tpu.llm.bench`` prints one JSON line: aggregate decode
-tokens/s of the continuous-batching engine against the same workload run
-as sequential static-batch ``gptj_decode`` calls (the pre-``ray_tpu.llm``
-serving story: each request is its own decode, one after another, each
-waiting for its arrival time).  The workload staggers arrivals so the
-engine's advantage — new requests join the running batch mid-flight
-instead of queuing behind whole completions — is what gets measured.
+``python -m ray_tpu.llm.bench`` prints TWO JSON lines:
+
+* ``llm_continuous_batching_tokens_per_sec`` — aggregate decode tokens/s
+  of the continuous-batching engine against the same workload run as
+  sequential static-batch ``gptj_decode`` calls (the pre-``ray_tpu.llm``
+  serving story), under staggered arrivals so the engine's advantage —
+  new requests join the running batch mid-flight — is what gets measured.
+* ``llm_speculative_decode_speedup`` — the spec_k=3 n-gram-drafted engine
+  against the non-speculative engine on two workloads: a REPETITIVE one
+  (patterned prompts whose greedy continuations go periodic early — the
+  prompt-lookup drafter's home turf) and an ADVERSARIAL one (random
+  prompts, short outputs: acceptance near zero, so what's measured is the
+  backoff bound on regression).  Both paths must produce byte-identical
+  greedy tokens — asserted, or the comparison is comparing different
+  work.
 
 Sized to run on CPU in seconds (the same comparison holds on TPU with
-the real model; the ratio is what travels).  Invoked by the top-level
-``bench.py`` as a subprocess so a failure never costs the headline
-metric.
+the real model; the ratio is what travels).  ``--smoke`` shrinks the
+workloads for CI.  Invoked by the top-level ``bench.py`` as a subprocess
+so a failure never costs the headline metric.
 """
 
 from __future__ import annotations
@@ -91,7 +99,7 @@ def run_bench() -> dict:
             max_blocks_per_seq=blocks_per_seq, prefill_chunk=PROMPT_LEN,
         ),
     )
-    engine.generate(prompts[0], SamplingParams(max_tokens=2))  # warm the jits
+    engine.warmup()  # compile the step jits outside the timed windows
 
     def run_continuous():
         t0 = time.perf_counter()
@@ -134,10 +142,139 @@ def run_bench() -> dict:
     }
 
 
-def main() -> dict:
-    rec = run_bench()
-    print(json.dumps(rec), flush=True)
-    return rec
+# -- speculative decoding ----------------------------------------------------
+
+SPEC_K = 3
+SPEC_SLOTS = 4
+SPEC_PROMPT_LEN = 16
+# prompt seeds chosen (scanned offline) so the tiny model's greedy
+# continuation of the patterned prompt goes periodic within ~8 tokens —
+# the structured/templated-output regime prompt-lookup drafting targets
+REPETITIVE_SEEDS = (1, 13, 22, 36)
+ADVERSARIAL_SEEDS = (100, 101, 102, 103)
+
+
+def _spec_model():
+    import jax
+
+    from ray_tpu.models.gptj import GPTJConfig, gptj_init
+
+    cfg = GPTJConfig(
+        vocab_size=256, seq_len=256, d_model=128, n_layers=4, n_heads=4,
+        rotary_dim=16, dtype="float32", remat=False, attn_impl="xla",
+        fused_loss=False,
+    )
+    return cfg, gptj_init(jax.random.PRNGKey(1), cfg)
+
+
+def run_spec_bench(smoke: bool = False) -> dict:
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+
+    cfg, params = _spec_model()
+    windows = 1 if smoke else WINDOWS
+    mt_rep = 24 if smoke else 64
+    # the adversarial run stays 16 tokens even in smoke: shorter runs sit
+    # entirely inside the backoff ramp and overstate the regression
+    mt_adv = 16
+
+    def patterned(seed):
+        pat = list(np.random.RandomState(seed).randint(0, cfg.vocab_size, 4))
+        return (pat * 8)[:SPEC_PROMPT_LEN]
+
+    def random_prompt(seed):
+        return list(
+            np.random.RandomState(seed).randint(0, cfg.vocab_size, SPEC_PROMPT_LEN)
+        )
+
+    rep_prompts = [patterned(s) for s in REPETITIVE_SEEDS]
+    adv_prompts = [random_prompt(s) for s in ADVERSARIAL_SEEDS]
+    mt_max = max(mt_rep, mt_adv)
+
+    def make_engine(spec_k):
+        bps = -(-(SPEC_PROMPT_LEN + mt_max + SPEC_K + 1) // 8)
+        return LLMEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=SPEC_SLOTS, block_size=8,
+                num_blocks=SPEC_SLOTS * bps + 2, max_blocks_per_seq=bps,
+                prefill_chunk=SPEC_PROMPT_LEN, spec_k=spec_k,
+            ),
+        )
+
+    def run(engine, prompts, mt):
+        reqs = [engine.submit(p, SamplingParams(max_tokens=mt)) for p in prompts]
+        t0 = time.perf_counter()
+        while not all(r.finished for r in reqs):
+            engine.step()
+        return time.perf_counter() - t0, [r.out for r in reqs]
+
+    base = make_engine(0)
+    base.warmup()  # compile outside the timed windows
+    spec = make_engine(SPEC_K)
+    spec.warmup()  # both step paths: verify AND the backoff fallback
+
+    results = {}
+    for name, prompts, mt in (
+        ("repetitive", rep_prompts, mt_rep),
+        ("adversarial", adv_prompts, mt_adv),
+    ):
+        bt, bout = min(
+            (run(base, prompts, mt) for _ in range(windows)), key=lambda r: r[0]
+        )
+        s0 = spec.stats()
+        st, sout = min(
+            (run(spec, prompts, mt) for _ in range(windows)), key=lambda r: r[0]
+        )
+        s1 = spec.stats()
+        # greedy speculative decode must be token-identical to the plain
+        # engine, or the throughput comparison is comparing different work
+        assert sout == bout, f"spec/non-spec token mismatch on {name}"
+        total = len(prompts) * mt
+        results[name] = {
+            "baseline_tokens_per_sec": round(total / bt, 1),
+            "spec_tokens_per_sec": round(total / st, 1),
+            "speedup": round(bt / st, 3),
+            "acceptance_rate": round(
+                (s1["spec_accepted"] - s0["spec_accepted"])
+                / max(s1["spec_proposed"] - s0["spec_proposed"], 1),
+                3,
+            ),
+            "drafter_overhead_s": round(
+                s1["spec_draft_seconds"] - s0["spec_draft_seconds"], 4
+            ),
+        }
+    return {
+        "metric": "llm_speculative_decode_speedup",
+        "value": results["repetitive"]["spec_tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": results["repetitive"]["speedup"],
+        "detail": {
+            **results,
+            "drafter": "ngram",
+            "spec_k": SPEC_K,
+            "requests": SPEC_SLOTS,
+            "smoke": smoke,
+        },
+    }
+
+
+def main(argv=None) -> list:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="shrunken workloads for CI (seconds, looser signal)",
+    )
+    args = ap.parse_args(argv)
+    records = []
+    for fn in (run_bench, lambda: run_spec_bench(smoke=args.smoke)):
+        rec = fn()
+        print(json.dumps(rec), flush=True)
+        records.append(rec)
+    return records
 
 
 if __name__ == "__main__":

@@ -148,6 +148,28 @@ class KVBlockPool:
             blocks.extend(self._free.pop() for _ in range(extra))
             return True
 
+    def shrink_to(self, seq_id: str, n_tokens: int) -> int:
+        """Return the sequence's TAIL blocks beyond what ``n_tokens`` needs
+        to the free list; returns the number released.  The speculative-
+        decode rollback: verification provisionally grows a sequence by
+        ``k`` positions, and the rejected tail's blocks come back here.
+        (The device-side k/v of rejected positions need no rollback — they
+        sit beyond the sequence's length, every attention path masks by
+        length, and the next window overwrites them before the length ever
+        reaches them.)"""
+        with self._lock:
+            blocks = self._owned.get(seq_id)
+            if blocks is None:
+                raise KeyError(f"unknown sequence {seq_id!r}")
+            keep = self.blocks_for(n_tokens)
+            excess = len(blocks) - keep
+            if excess <= 0:
+                return 0
+            tail = blocks[keep:]
+            del blocks[keep:]
+            self._free.extend(reversed(tail))
+            return excess
+
     def free(self, seq_id: str) -> int:
         """Return a sequence's blocks to the pool (idempotent); returns the
         number of blocks released."""

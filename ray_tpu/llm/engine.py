@@ -11,7 +11,13 @@ one scheduler.  ``step()`` is the whole design:
    call, static slot count), sample per-slot tokens (per-request
    temperature/top-k/top-p/seed), stream them out, finish requests that
    hit ``max_tokens``/stop tokens, preempting the youngest when the
-   block pool runs dry.
+   block pool runs dry.  With ``spec_k > 0`` the decode step is
+   SPECULATIVE: a drafter (``llm.drafter``) proposes ``k`` tokens per
+   slot, the target model verifies all ``k+1`` positions in one jitted
+   call (``model_runner.verify_step``), and each slot emits its accepted
+   prefix plus a correction/bonus token — up to ``k+1`` tokens per step
+   at one target-model invocation, token-identical under greedy and
+   distribution-exact under sampling (``models.sampling``).
 
 Observability: every step is a ``util.tracing`` span; tokens/s, TTFT,
 inter-token latency, running/waiting counts, KV-block utilization and
@@ -74,6 +80,23 @@ def _metrics() -> dict:
                 "gap between consecutive streamed tokens",
                 boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
             ),
+            # speculative decode: drafted vs accepted counters give the
+            # lifetime acceptance rate; the gauges give the latest step's
+            "spec_proposed": Counter(
+                "llm_spec_draft_tokens", "draft tokens proposed for verification"
+            ),
+            "spec_accepted": Counter(
+                "llm_spec_accepted_tokens", "draft tokens accepted by verification"
+            ),
+            "spec_accept_rate": Gauge(
+                "llm_spec_acceptance_rate", "accepted/proposed of the last step"
+            ),
+            "spec_draft_s": Counter(
+                "llm_spec_draft_seconds", "cumulative wall time inside the drafter"
+            ),
+            "tokens_per_step": Gauge(
+                "llm_tokens_per_step", "tokens emitted by the last decode step"
+            ),
         }
     return _METRICS
 
@@ -82,7 +105,27 @@ def _metrics() -> dict:
 class EngineConfig:
     """Engine geometry. ``num_blocks`` includes the reserved trash block;
     ``max_blocks_per_seq * block_size`` caps a sequence (prompt + output),
-    additionally clamped by the model's positional table for GPT."""
+    additionally clamped by the model's positional table for GPT.
+
+    Speculative decoding: ``spec_k > 0`` turns it on — each step a drafter
+    proposes ``spec_k`` tokens per running slot and the target model
+    verifies all of them plus a bonus position in ONE jitted call
+    (``llm.drafter`` module doc).  ``spec_drafter`` is ``"ngram"``
+    (model-free prompt lookup; ``spec_ngram_max`` caps the matched n-gram)
+    or ``"model"`` (a small draft model passed to ``LLMEngine`` as
+    ``draft_model_cfg``/``draft_params``; ``spec_draft_ctx`` fixes its
+    context window).  Greedy output is token-identical either way; ``k``
+    trades verification width against acceptance — 2-4 fits most
+    workloads, higher only pays when acceptance stays near 1.
+
+    Adversarial (low-acceptance) workloads are bounded by backoff: when a
+    verify step accepts less than ``spec_min_accept`` of its drafts, the
+    engine falls back to plain decode for exponentially more steps
+    (doubling up to ``spec_backoff_max``) before probing speculation
+    again — a regime change (output entering a repetitive stretch) is
+    picked back up at the next probe, while steady low acceptance decays
+    to plain-decode cost plus one probe in ``spec_backoff_max``.  Both
+    step shapes are jitted once; toggling never retraces."""
 
     max_slots: int = 4
     num_blocks: int = 128
@@ -90,12 +133,27 @@ class EngineConfig:
     max_blocks_per_seq: int = 32
     prefill_chunk: int = 32
     attn_impl: str = "auto"
+    spec_k: int = 0
+    spec_drafter: str = "ngram"
+    spec_ngram_max: int = 3
+    spec_draft_ctx: int = 16
+    spec_min_accept: float = 0.3
+    spec_backoff_max: int = 32
 
 
 class LLMEngine:
-    def __init__(self, model_cfg, params: dict, engine_cfg: Optional[EngineConfig] = None):
+    def __init__(
+        self,
+        model_cfg,
+        params: dict,
+        engine_cfg: Optional[EngineConfig] = None,
+        draft_model_cfg=None,
+        draft_params: Optional[dict] = None,
+    ):
         self.cfg = engine_cfg or EngineConfig()
         self.model_cfg = model_cfg
+        if self.cfg.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
         cache_cfg = CacheConfig(
             num_blocks=self.cfg.num_blocks,
             block_size=self.cfg.block_size,
@@ -112,11 +170,29 @@ class LLMEngine:
             dtype=model_cfg.dtype,
         )
         self.scheduler = Scheduler(self.pool, self.cfg.max_slots)
+        self._drafter = None
+        if self.cfg.spec_k > 0:
+            from ray_tpu.llm.drafter import make_drafter
+
+            self._drafter = make_drafter(
+                self.cfg.spec_drafter,
+                self.cfg.spec_k,
+                self.cfg.max_slots,
+                ngram_max=self.cfg.spec_ngram_max,
+                draft_cfg=draft_model_cfg,
+                draft_params=draft_params,
+                draft_ctx=self.cfg.spec_draft_ctx,
+            )
         self._lock = threading.Lock()
         self._requests: dict[str, Request] = {}
         self._step_n = 0
         self._tokens_generated = 0
         self._preemptions = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_draft_s = 0.0
+        self._spec_skip = 0      # plain-decode steps left before re-probing
+        self._spec_backoff = 0   # current backoff length (0 = speculating)
         # model-length cap: paged table width, and the learned positional
         # table for GPT (rotary GPT-J has no absolute cap of its own)
         self.max_model_len = cache_cfg.max_seq_len
@@ -147,10 +223,12 @@ class LLMEngine:
             )
         # the request must be able to COMPLETE with the pool to itself —
         # admission's worst case is a re-admission one token before the end
-        # plus one block of headroom. Without this check an oversized
-        # request passes validation, can never be admitted, and livelocks
-        # the FIFO head (starving everything queued behind it).
-        worst = min(total - 1 + self.pool.cfg.block_size, self.pool.cfg.max_seq_len)
+        # plus one block of headroom (or, speculating, plus the window's k
+        # provisional positions). Without this check an oversized request
+        # passes validation, can never be admitted, and livelocks the FIFO
+        # head (starving everything queued behind it).
+        headroom = max(self.pool.cfg.block_size, self.cfg.spec_k)
+        worst = min(total - 1 + headroom, self.pool.cfg.max_seq_len)
         usable = self.pool.cfg.num_blocks - 1
         if self.pool.blocks_for(worst) > usable:
             raise ValueError(
@@ -209,9 +287,43 @@ class LLMEngine:
                 time.sleep(0.001)
         return list(req.out)
 
+    def warmup(self) -> None:
+        """Compile every jitted step path — prefill, decode, and (when
+        speculating) verify — so the first real request runs at
+        steady-state latency.  A speculating engine routes decode through
+        ``verify_step`` until acceptance drops, so one generate would
+        leave the PLAIN decode path (the backoff fallback) cold.  The
+        verify jit is driven DIRECTLY with a dummy batch rather than via
+        generate: whether a generate ever reaches verification is gated
+        on the drafter finding a confident match in the (model-dependent)
+        warmup output, so only a direct call guarantees the compile.  The
+        dummy batch's all-zero block tables route every provisional write
+        to the reserved trash block — real pool contents are untouched."""
+        self.generate([0], SamplingParams(max_tokens=2))
+        if self._drafter is not None:
+            with self._lock:
+                self._spec_skip = 1 << 30  # force the plain-decode path
+            self.generate([0], SamplingParams(max_tokens=2))
+            with self._lock:
+                self._spec_skip = 0
+                self._spec_backoff = 0
+                S, W = self.cfg.max_slots, self.cfg.spec_k + 1
+                k, v, _, _ = self.runner.verify_step(
+                    self.pool.k, self.pool.v,
+                    np.zeros((S, W), np.int32),
+                    np.zeros(S, np.int32),
+                    np.zeros((S, self.pool.cfg.max_blocks_per_seq), np.int32),
+                    np.zeros(S, np.float32),
+                    np.zeros(S, np.int32),
+                    np.ones(S, np.float32),
+                    np.zeros(S, np.uint32),
+                    np.zeros(S, np.int32),
+                )
+                self.pool.k, self.pool.v = k, v
+
     def stats(self) -> dict:
         with self._lock:
-            return {
+            s = {
                 "running": self.scheduler.num_running,
                 "waiting": self.scheduler.num_waiting,
                 "queue_depth": self.scheduler.num_waiting,
@@ -221,6 +333,14 @@ class LLMEngine:
                 "tokens_generated": self._tokens_generated,
                 "preemptions": self._preemptions,
             }
+            if self._drafter is not None:
+                s["spec_proposed"] = self._spec_proposed
+                s["spec_accepted"] = self._spec_accepted
+                s["spec_acceptance_rate"] = self._spec_accepted / max(
+                    self._spec_proposed, 1
+                )
+                s["spec_draft_seconds"] = self._spec_draft_s
+            return s
 
     def run_loop(self, stop: threading.Event, idle_sleep_s: float = 0.002) -> None:
         """Drive ``step()`` until ``stop`` is set (serve replicas run this
@@ -243,16 +363,27 @@ class LLMEngine:
             self._step_n += 1
             m = _metrics()
             m["steps"].inc()
-            with tracing.span(
-                "llm_engine_step",
+            # spec stats fill in during the step; span attributes serialize
+            # at span EXIT, so the dict lands populated in the trace
+            spec_info: dict = {}
+            attrs = dict(
                 step=self._step_n,
                 running=sched.num_running,
                 waiting=sched.num_waiting,
-            ):
+            )
+            if self._drafter is not None:
+                attrs["spec"] = spec_info
+            with tracing.span("llm_engine_step", **attrs):
                 self._reap()
                 sched.admit()
                 did = self._prefill_one()
-                did = self._decode_all() or did
+                if self._drafter is not None and self._spec_skip == 0:
+                    did = self._spec_decode_all(spec_info) or did
+                else:
+                    did_decode = self._decode_all()
+                    if did_decode and self._spec_skip > 0:
+                        self._spec_skip -= 1  # backoff ticks on real decodes
+                    did = did_decode or did
             # prune finished requests: the registry otherwise retains every
             # Request (prompt, output, stream queue) for the replica's
             # lifetime. Callers keep their own Request references; cancel()
@@ -310,19 +441,27 @@ class LLMEngine:
             self._emit(req, tok)
         return True
 
+    def _grow_all(self, extra: int = 0) -> None:
+        """Ensure every RUNNING slot has cache room for the position(s)
+        the next step writes (plus ``extra`` provisional speculative
+        ones), evicting the youngest when the pool is dry, with
+        preemption accounting."""
+        sched = self.scheduler
+        for req in list(sched.running):
+            if req.state != RUNNING:
+                continue
+            before = sched.preempt_count
+            if not sched.grow_for_decode(req, extra=extra):
+                pass  # req itself was preempted; it re-prefills later
+            self._preemptions += sched.preempt_count - before
+            _metrics()["preempt"].inc(sched.preempt_count - before)
+
     def _decode_all(self) -> bool:
         """One batched decode step over every RUNNING slot."""
         sched = self.scheduler
         # memory first: every runner needs space for the token it is about
         # to write; the youngest gets evicted when the pool is dry
-        for req in list(sched.running):
-            if req.state != RUNNING:
-                continue
-            before = sched.preempt_count
-            if not sched.grow_for_decode(req):
-                pass  # req itself was preempted; it re-prefills later
-            self._preemptions += sched.preempt_count - before
-            _metrics()["preempt"].inc(sched.preempt_count - before)
+        self._grow_all()
         active = [
             (i, r)
             for i, r in enumerate(sched.slots)
@@ -360,6 +499,114 @@ class LLMEngine:
         nxt = np.asarray(nxt)  # ONE host sync for the whole batch
         for i, req in active:
             self._emit(req, int(nxt[i]))
+        _metrics()["tokens_per_step"].set(len(active))
+        return True
+
+    def _spec_decode_all(self, spec_info: dict) -> bool:
+        """One speculative step over every RUNNING slot: draft k tokens
+        per slot, verify k+1 positions in one jitted call, emit the
+        accepted prefix + correction/bonus, roll the ledger back."""
+        import jax
+
+        sched = self.scheduler
+        kd = self.cfg.spec_k
+        active = [
+            (i, r)
+            for i, r in enumerate(sched.slots)
+            if r is not None and r.state == RUNNING
+        ]
+        if not active:
+            return False
+        t0 = time.perf_counter()
+        draft = self._drafter.propose([r.prompt + r.out for _, r in active])
+        draft_s = time.perf_counter() - t0
+        self._spec_draft_s += draft_s
+        _metrics()["spec_draft_s"].inc(draft_s)
+        # drafter confidence gate: when NO slot's proposal is backed by a
+        # real match (NGramDrafter.last_matched), the whole window would
+        # be a doomed probe — plain-decode this step instead of paying a
+        # w-wide verify to learn it.  Hostile workloads thus cost the
+        # (host-side, near-free) drafting only; model drafters have no
+        # such signal and rely on the acceptance backoff alone.
+        matched = getattr(self._drafter, "last_matched", None)
+        if matched is not None and not bool(matched.any()):
+            return self._decode_all()
+        draft_by_id = {r.id: draft[row] for row, (_, r) in enumerate(active)}
+        # memory next: the window provisionally writes positions
+        # seq_len-1 .. seq_len-1+k; the youngest gets evicted when dry
+        self._grow_all(extra=kd)
+        active = [(i, r) for i, r in active if r.state == RUNNING]
+        if not active:
+            return False
+        S, W = self.cfg.max_slots, kd + 1
+        tokens = np.zeros((S, W), np.int32)
+        base_pos = np.zeros(S, np.int32)
+        tables = np.zeros((S, self.pool.cfg.max_blocks_per_seq), np.int32)
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        seeds = np.zeros(S, np.uint32)
+        counters = np.zeros(S, np.int32)
+        for i, req in active:
+            tokens[i, 0] = req.out[-1] if req.out else req.prompt[-1]
+            tokens[i, 1:] = draft_by_id[req.id]
+            base_pos[i] = req.seq_len - 1  # the fed token's position
+            tables[i] = self.pool.table_row(req.id)
+            p = req.params
+            temp[i] = p.temperature
+            top_k[i] = p.top_k
+            top_p[i] = p.top_p
+            seeds[i] = p.seed & 0xFFFFFFFF
+            counters[i] = len(req.out)
+        k, v, n_acc, out = self.runner.verify_step(
+            self.pool.k, self.pool.v, tokens, base_pos, tables,
+            temp, top_k, top_p, seeds, counters,
+        )
+        self.pool.k, self.pool.v = k, v
+        n_acc, out = jax.device_get((n_acc, out))  # ONE host sync
+        emitted = 0
+        accepted = 0
+        for i, req in active:
+            n = int(n_acc[i])
+            accepted += n
+            for j in range(n + 1):
+                self._emit(req, int(out[i, j]))
+                emitted += 1
+                if req.finished:
+                    # stop token / length cap hit inside the window: the
+                    # rest of the acceptance is after-the-end, discard it
+                    break
+            if not req.finished:
+                # ledger rollback: return the rejected tail's provisional
+                # blocks (device k/v needs none — see cache.shrink_to)
+                self.pool.shrink_to(req.id, req.seq_len)
+        proposed = kd * len(active)
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        step_rate = accepted / max(proposed, 1)
+        if step_rate < self.cfg.spec_min_accept:
+            # low acceptance: back off to plain decode, doubling the pause
+            # while probes keep failing (EngineConfig docstring)
+            self._spec_backoff = min(
+                max(self._spec_backoff * 2, 2), self.cfg.spec_backoff_max
+            )
+            self._spec_skip = self._spec_backoff
+        else:
+            self._spec_backoff = 0
+        m = _metrics()
+        m["spec_proposed"].inc(proposed)
+        m["spec_accepted"].inc(accepted)
+        m["spec_accept_rate"].set(step_rate)
+        m["tokens_per_step"].set(emitted)
+        spec_info.update(
+            k=kd,
+            slots=len(active),
+            proposed=proposed,
+            accepted=accepted,
+            emitted=emitted,
+            draft_s=round(draft_s, 6),
+            backoff=self._spec_backoff,
+        )
         return True
 
     def _emit(self, req: Request, tok: int) -> None:

@@ -7,7 +7,7 @@ positions' k/v into physical blocks, attend via ``ops.paged_attention``,
 and hand back the updated pool arrays (functional updates; the engine
 holds the current version).
 
-Two entry shapes, each jitted once per engine:
+Three entry shapes, each jitted once per engine:
 
 * ``decode_step`` — (slots,) one token per running slot, batched across
   heterogeneous sequences (different lengths, block tables, sampling
@@ -18,10 +18,22 @@ Two entry shapes, each jitted once per engine:
   ``start..start+chunk`` (tail-padded; padded positions scatter to the
   trash block).  Returns the last valid position's logits so the final
   chunk seeds the first generated token.
+* ``verify_step`` — (slots, k+1) speculative-decode verification: each
+  slot feeds its last emitted token plus ``k`` drafted tokens, their k/v
+  scatter PROVISIONALLY into the pool, one multi-query paged attention
+  (``ops.paged_verify_attention``) yields all ``k+1`` positions' logits,
+  and ``models.sampling.speculative_verify`` accepts a prefix + one
+  correction/bonus token per slot.  Rejected positions need no device
+  rollback — they sit beyond the sequence length, everything masks by
+  length, and the next window overwrites them first (the block LEDGER
+  rolls back host-side via ``cache.shrink_to``).  Window positions past
+  the table's reach scatter to the trash block, so slots at the model-
+  length cap stay safe (their surplus logits are discarded host-side).
 
-Static shapes everywhere: slot count, chunk size, table width, and pool
-geometry are compile-time constants — admission, preemption, and
-completion never retrace.
+Static shapes everywhere: slot count, chunk size, window width ``k+1``,
+table width, and pool geometry are compile-time constants — admission,
+preemption, completion, and per-step acceptance-length changes never
+retrace.
 """
 
 from __future__ import annotations
@@ -33,10 +45,11 @@ import jax.numpy as jnp
 
 from ray_tpu.models.gpt import GPTConfig, _layernorm
 from ray_tpu.models.gptj import GPTJConfig
-from ray_tpu.models.sampling import sample_tokens
+from ray_tpu.models.sampling import sample_tokens, speculative_verify
 from ray_tpu.ops.paged_attention import (
     paged_attention,
     paged_prefill_attention_xla,
+    paged_verify_attention,
 )
 
 
@@ -82,6 +95,16 @@ def _sample_rows(logits, seeds, counters, temp, top_k, top_p):
     return jax.vmap(one)(logits, keys, temp, top_k, top_p)
 
 
+def _verify_rows(logits, draft, seeds, counters, temp, top_k, top_p):
+    """Per-slot speculative verification (same per-request determinism as
+    ``_sample_rows``: window token i keys off (seed, counter + i)).
+    logits: (S, W, V); draft: (S, W-1).  Returns (n_accepted (S,),
+    out_tokens (S, W))."""
+    return jax.vmap(speculative_verify)(
+        logits, draft, seeds, counters, temp, top_k, top_p
+    )
+
+
 class PagedModelRunner:
     """Owns the jitted step functions for one (config, params) pair."""
 
@@ -106,6 +129,7 @@ class PagedModelRunner:
         self._prefill = jax.jit(
             self._prefill_impl, donate_argnums=(1, 2), static_argnames=("chunk",)
         )
+        self._verify = jax.jit(self._verify_impl, donate_argnums=(1, 2))
 
     # -- shared layer math -------------------------------------------------
 
@@ -227,6 +251,88 @@ class PagedModelRunner:
                     temp, top_k, top_p, seeds, counters):
         return self._decode(
             self.params, k_pool, v_pool, tokens, positions, tables,
+            temp, top_k, top_p, seeds, counters,
+        )
+
+    # -- speculative verification step -------------------------------------
+
+    def _verify_impl(
+        self,
+        params,
+        k_pool,      # (L, NB, H, BS, D)
+        v_pool,
+        tokens,      # (S, W) int32 — last emitted token + k drafts per slot
+        base_pos,    # (S,) int32 — position of tokens[:, 0]
+        tables,      # (S, T) int32
+        temp,        # (S,) f32
+        top_k,       # (S,) i32
+        top_p,       # (S,) f32
+        seeds,       # (S,) u32
+        counters,    # (S,) i32 — output index of the window's first token
+    ):
+        cfg = self.cfg
+        bs = self.block_size
+        S, W = tokens.shape
+        tmax = tables.shape[1]
+        positions = base_pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        pos_flat = positions.reshape(-1)                     # (S*W,)
+        x = self._embed(tokens.reshape(-1), pos_flat)        # (S*W, d)
+        # window positions can provisionally run past the table's reach
+        # (a slot one emit away from the model-length cap still feeds k
+        # drafts): clamp the gather and scatter the overflow to trash —
+        # the engine never emits tokens from those positions
+        valid = pos_flat < tmax * bs
+        logical = jnp.minimum(pos_flat // bs, tmax - 1)
+        tables_rep = jnp.repeat(tables, W, axis=0)           # (S*W, T)
+        phys = jnp.where(
+            valid,
+            jnp.take_along_axis(tables_rep, logical[:, None], axis=1)[:, 0],
+            0,
+        )
+        off = pos_flat % bs
+        runner = self
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+            if runner.arch == "gptj":
+                h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, h, pos_flat)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_verify_attention(
+                    q.reshape(S, W, cfg.n_heads, cfg.head_dim),
+                    k_l, v_l, tables, positions, impl=runner.attn_impl,
+                ).astype(x.dtype)
+                att = runner._attn_out(layer, att.reshape(S * W, cfg.d_model))
+                out = x + att + runner._mlp(layer, h)  # parallel residual
+            else:
+                ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+                q, k, v = runner._qkv_rows(layer, ln1, pos_flat)
+                k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+                v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+                att = paged_verify_attention(
+                    q.reshape(S, W, cfg.n_heads, cfg.head_dim),
+                    k_l, v_l, tables, positions, impl=runner.attn_impl,
+                ).astype(x.dtype)
+                h = x + runner._attn_out(layer, att.reshape(S * W, cfg.d_model))
+                ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+                out = h + runner._mlp(layer, ln2)
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        logits = self._lm_head(x).reshape(S, W, -1)          # (S, W, V)
+        n_acc, out = _verify_rows(
+            logits, tokens[:, 1:], seeds, counters, temp, top_k, top_p
+        )
+        return k_pool, v_pool, n_acc, out
+
+    def verify_step(self, k_pool, v_pool, tokens, base_pos, tables,
+                    temp, top_k, top_p, seeds, counters):
+        return self._verify(
+            self.params, k_pool, v_pool, tokens, base_pos, tables,
             temp, top_k, top_p, seeds, counters,
         )
 

@@ -164,12 +164,15 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
-    def grow_for_decode(self, req: Request) -> bool:
-        """Ensure the token the next decode step writes (position
-        ``seq_len - 1``) has a cache slot, preempting younger requests if
-        the pool is dry. Returns False when ``req`` itself had to be
-        preempted (nobody younger to evict)."""
-        while not self.pool.grow_to(req.id, req.seq_len):
+    def grow_for_decode(self, req: Request, extra: int = 0) -> bool:
+        """Ensure the positions the next step writes (``seq_len - 1`` plus
+        ``extra`` provisional speculative positions) have cache slots,
+        preempting younger requests if the pool is dry.  The target clamps
+        at the table width — window positions past it scatter to the trash
+        block, so they need no allocation.  Returns False when ``req``
+        itself had to be preempted (nobody younger to evict)."""
+        target = min(req.seq_len + extra, self.pool.cfg.max_seq_len)
+        while not self.pool.grow_to(req.id, target):
             victim = self._youngest_running(exclude=req.id)
             if victim is None:
                 self.preempt(req)

@@ -1,13 +1,32 @@
 """Per-token sampling shared by the decode paths and the LLM engine.
 
-One helper — ``sample_tokens`` — implements greedy / temperature /
-top-k / top-p over a batch of next-token logit rows, with every knob
-accepted either as a scalar (whole batch) or as a per-row array (the
-continuous-batching engine mixes requests with different sampling params
-in one decode step). Everything is jit-safe with static shapes: dynamic
-per-row ``k`` is implemented by ranking a full descending sort rather
-than ``lax.top_k`` (whose k must be static), which also gives top-p its
-cumulative mass for free from the same sort.
+Two helpers:
+
+* ``sample_tokens`` — greedy / temperature / top-k / top-p over a batch
+  of next-token logit rows, with every knob accepted either as a scalar
+  (whole batch) or as a per-row array (the continuous-batching engine
+  mixes requests with different sampling params in one decode step).
+* ``speculative_verify`` — the accept/reject half of speculative
+  decoding for ONE sequence's ``w = k+1``-position verification window,
+  by SAMPLE-THEN-MATCH: position ``i`` draws the target token ``t_i``
+  from the SAME filtered distribution (and the same per-index PRNG key)
+  ``sample_tokens`` would have used at that output index, then accepts
+  the drafted prefix while ``draft_i == t_i`` and always emits ``t_i``.
+  Both built-in drafters are deterministic (point-mass proposals), so
+  this has exactly the acceptance probability of textbook rejection
+  sampling — accept ``x_i`` with ``p_i(x_i)``, i.e. ``min(1, p/q)`` with
+  ``q`` a point mass — while being stronger than the delta/residual
+  formulation where it matters: the emitted token at output index ``i``
+  depends only on ``(seed, i, prefix)``, never on where the verification
+  window happened to start, so sampled speculative decode is per-seed
+  reproducible across runs AND token-identical to the non-speculative
+  sampled path (greedy falls out as the ``temperature <= 0`` argmax
+  special case).
+
+Everything is jit-safe with static shapes: dynamic per-row ``k`` is
+implemented by ranking a full descending sort rather than ``lax.top_k``
+(whose k must be static), which also gives top-p its cumulative mass for
+free from the same sort.
 
 Convention: ``temperature <= 0`` means greedy (argmax) for that row —
 the PRNG key is still consumed uniformly so a batch mixing greedy and
@@ -20,6 +39,33 @@ import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+
+def _filtered_logits(logits, temp, kk, pp):
+    """Temperature-scaled logits with top-k/top-p support masked to
+    ``_NEG_INF``.  logits: (b, v) fp32; temp/kk/pp: (b,) arrays.  This IS
+    the distribution ``sample_tokens`` draws from — ``speculative_verify``
+    must score draft tokens under exactly the same filtering or the
+    accepted distribution would drift from the non-speculative path."""
+    b, v = logits.shape
+    safe_t = jnp.maximum(temp, 1e-6)[:, None]
+    scaled = logits / safe_t
+    # one descending sort serves both truncations: rank < k for top-k,
+    # exclusive cumulative mass < p for top-p (rank 0 always survives)
+    order = jnp.argsort(-scaled, axis=-1)
+    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
+    ranks = jnp.arange(v)[None, :]
+    probs = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (kk[:, None] <= 0) | (ranks < kk[:, None])
+    keep &= (cum - probs) < pp[:, None]
+    masked_sorted = jnp.where(keep, sorted_scaled, _NEG_INF)
+    # scatter the surviving logits back to vocab order
+    return (
+        jnp.full_like(scaled, _NEG_INF)
+        .at[jnp.arange(b)[:, None], order]
+        .set(masked_sorted)
+    )
 
 
 def sample_tokens(
@@ -44,25 +90,66 @@ def sample_tokens(
     pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    safe_t = jnp.maximum(temp, 1e-6)[:, None]
-    scaled = logits / safe_t
-    # one descending sort serves both truncations: rank < k for top-k,
-    # exclusive cumulative mass < p for top-p (rank 0 always survives)
-    order = jnp.argsort(-scaled, axis=-1)
-    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
-    ranks = jnp.arange(v)[None, :]
-    probs = jax.nn.softmax(sorted_scaled, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (kk[:, None] <= 0) | (ranks < kk[:, None])
-    keep &= (cum - probs) < pp[:, None]
-    masked_sorted = jnp.where(keep, sorted_scaled, _NEG_INF)
-    # scatter the surviving logits back to vocab order
-    masked = (
-        jnp.full_like(scaled, _NEG_INF)
-        .at[jnp.arange(b)[:, None], order]
-        .set(masked_sorted)
-    )
+    masked = _filtered_logits(logits, temp, kk, pp)
     keys = jax.random.split(key, b)
     sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
+
+
+def speculative_verify(
+    logits: jax.Array,
+    draft: jax.Array,
+    seed: jax.Array,
+    counter: jax.Array,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+):
+    """Accept/reject one sequence's drafted window against target logits.
+
+    ``logits``: (w, vocab) — the target model's logits at the window's w
+    positions (position i conditioned on the draft tokens before it);
+    ``draft``: (w-1,) int32 — the drafter's proposals; ``seed``/``counter``
+    — the request's sampling seed and the output index of the window's
+    FIRST token.
+
+    Sample-then-match (module doc): window index i draws ``out[i]`` with
+    the PRNG key ``fold_in(PRNGKey(seed), counter + i)`` — the exact key
+    AND filtered distribution the plain decode path's per-row sampler
+    uses at that output index — then the drafted prefix is accepted while
+    ``draft[i] == out[i]``.  ``out[i]`` is only CONDITIONALLY valid: its
+    logits assumed the draft prefix before it, which holds exactly up
+    through the first mismatch, so callers emit ``out[:n_accepted + 1]``
+    (accepted prefix + one correction/bonus token — the first mismatch's
+    replacement, or the bonus position when everything matched) and
+    ignore the rest.
+
+    Greedy (``temperature <= 0``) accepts while ``draft[i] == argmax`` —
+    the emitted chain is exactly the sequential argmax chain.  Either
+    way the emitted token at output index i depends only on
+    (seed, i, prefix): identical to non-speculative decode, whatever the
+    drafter proposed and wherever the window boundaries fell.
+    """
+    logits = logits.astype(jnp.float32)
+    w, v = logits.shape
+    kd = w - 1
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (w,))
+    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (w,))
+    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (w,))
+
+    base = jax.random.PRNGKey(seed)
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(base, counter + i)
+    )(jnp.arange(w, dtype=jnp.int32))  # (w, 2)
+    out = jax.vmap(
+        lambda lg, key, t, k_, p_: sample_tokens(
+            lg[None, :], key, t[None], k_[None], p_[None]
+        )[0]
+    )(logits, keys, temp, kk, pp)  # (w,) int32
+
+    if kd:
+        accept = draft == out[:kd]
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32))).astype(jnp.int32)
+    else:  # empty draft (w == 1): the window is just the bonus position
+        n_acc = jnp.int32(0)
+    return n_acc, out

@@ -156,12 +156,17 @@ class EnvRunner:
         }
         for t in range(T):
             self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._policy(self.params, self._obs, key)
-            action = np.asarray(action)
+            # ONE batched device→host transfer per env step (the step is
+            # inherently host-synchronous — the vector env needs concrete
+            # actions — but three per-array syncs stalled the pipeline
+            # three times for one round trip's worth of data)
+            action, logp, value = jax.device_get(  # raylint: disable=RL006
+                self._policy(self.params, self._obs, key)
+            )
             buf["obs"][t] = self._obs
             buf["act"][t] = action
-            buf["logp"][t] = np.asarray(logp)
-            buf["val"][t] = np.asarray(value)
+            buf["logp"][t] = logp
+            buf["val"][t] = value
             env_action = self._act_transform(action)
             buf["env_act"][t] = env_action
             self._obs, rew, term, trunc, final = self.vec.step(env_action)

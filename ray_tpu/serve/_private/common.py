@@ -18,13 +18,22 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 class AutoscalingConfig:
     """Reference: ``serve/config.py`` AutoscalingConfig /
     ``_private/autoscaling_policy.py`` (decisions from ongoing-request
-    telemetry vs a per-replica target)."""
+    telemetry vs a per-replica target).
+
+    Beyond ongoing counts, the controller folds in replica-exported
+    ``autoscaling_metrics`` (see ``serve.llm.LLMDeployment``): queued
+    requests (``queue_depth``) count toward load the same as ongoing
+    ones, and any replica whose KV-cache utilization reaches
+    ``kv_utilization_threshold`` adds upscale pressure even when request
+    counts look calm (a memory-bound engine preempts long before its
+    request count saturates)."""
 
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    kv_utilization_threshold: float = 0.9
 
 
 @dataclasses.dataclass

@@ -31,6 +31,35 @@ RECONCILE_PERIOD_S = 0.25
 REPLICA_INIT_TIMEOUT_S = 120.0
 
 
+def desired_replicas(
+    cfg: AutoscalingConfig, metrics: list[dict], current: int
+) -> int:
+    """Pure scaling decision from one round of replica metrics.
+
+    Load is ongoing requests PLUS replica-exported queue depth (a
+    continuous-batching replica holds admitted streams in its engine
+    queue, invisible to ongoing counts alone), divided by the per-replica
+    target.  A replica at/above the KV-utilization threshold adds one
+    replica of upscale pressure on top — a memory-bound engine preempts
+    and thrashes long before its request count looks saturated.  Bounded
+    by [min_replicas, max_replicas]; delay/hysteresis is the caller's
+    (``_autoscale``'s) job."""
+    total_load = 0.0
+    kv_max = 0.0
+    for m in metrics:
+        total_load += m.get("num_ongoing_requests", 0)
+        custom = m.get("autoscaling_metrics") or {}
+        total_load += custom.get("queue_depth", 0)
+        kv_max = max(kv_max, custom.get("kv_utilization", 0.0))
+    desired = (
+        -(-int(total_load) // max(int(cfg.target_ongoing_requests), 1))
+        or cfg.min_replicas
+    )
+    if kv_max >= cfg.kv_utilization_threshold:
+        desired = max(desired, current + 1)
+    return max(cfg.min_replicas, min(cfg.max_replicas, desired))
+
+
 class _DeploymentState:
     def __init__(self, spec: DeploymentSpec):
         self.spec = spec
@@ -449,24 +478,20 @@ class ServeController:
             return
         with self._lock:
             replicas = [r for r in state.replicas if r.healthy and r.initialized]
+            current = state.target_replicas
         if not replicas:
             return
-        total_ongoing = 0
+        metrics = []
         for r in replicas:
             try:
-                m = ray_tpu.get(r.actor.get_metrics.remote(), timeout=5.0)
-                total_ongoing += m["num_ongoing_requests"]
+                metrics.append(
+                    ray_tpu.get(r.actor.get_metrics.remote(), timeout=5.0)
+                )
             except Exception as e:
                 # count an unreachable replica as zero load, but surface it:
                 # persistently silent metrics skew autoscaling down
                 warn_throttled("serve controller: replica metrics", e)
-        desired = max(
-            cfg.min_replicas,
-            min(
-                cfg.max_replicas,
-                -(-int(total_ongoing) // max(int(cfg.target_ongoing_requests), 1)) or cfg.min_replicas,
-            ),
-        )
+        desired = desired_replicas(cfg, metrics, current)
         now = time.time()
         with self._lock:
             current = state.target_replicas

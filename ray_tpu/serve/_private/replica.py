@@ -99,12 +99,25 @@ class Replica:
         return True
 
     def get_metrics(self) -> dict:
-        return {
+        m = {
             "replica_id": self.replica_id,
             "num_ongoing_requests": self._ongoing,
             "num_total_requests": self._total,
             "timestamp": time.time(),
         }
+        # deployment-exported saturation signals (e.g. serve.llm's queue
+        # depth / KV utilization): a continuous-batching replica absorbs
+        # many requests per slot set, so ongoing counts alone under-report
+        # load — the controller folds these into its scaling decision
+        fn = getattr(self._callable, "autoscaling_metrics", None)
+        if fn is not None:
+            try:
+                custom = fn()
+                if isinstance(custom, dict):
+                    m["autoscaling_metrics"] = custom
+            except Exception:  # raylint: disable=RL007
+                pass  # a broken exporter must not break health/metrics RPCs
+        return m
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

@@ -16,12 +16,14 @@ blocking whole-completion method for non-streaming callers (a generator
 return can't pickle through ``handle_request``).
 
 Autoscaling: the replica exports the engine's queue depth and KV-cache
-utilization — both through ``util.metrics`` gauges (``llm_*`` series)
-and through ``autoscaling_metrics()`` for direct polling.  Since a
-continuous-batching replica absorbs many concurrent requests per slot
-set, ongoing-request counts alone under-report saturation; queue depth
-(> 0 means the engine is admission-bound) and KV utilization (≈ 1.0
-means preemption-bound) are the honest signals.
+utilization — through ``util.metrics`` gauges (``llm_*`` series) and
+through ``autoscaling_metrics()``, which the serve controller's scaling
+decision CONSUMES (``_private/controller.desired_replicas``: queued
+requests count as load; a KV-saturated replica adds upscale pressure).
+Since a continuous-batching replica absorbs many concurrent requests per
+slot set, ongoing-request counts alone under-report saturation; queue
+depth (> 0 means the engine is admission-bound) and KV utilization
+(≈ 1.0 means preemption-bound) are the honest signals.
 """
 
 from __future__ import annotations
@@ -73,19 +75,34 @@ class LLMDeployment:
         seed: int = 0,
         warmup: bool = True,
         stream_timeout_s: float = 300.0,
+        draft_model_cfg=None,
+        draft_params: Optional[dict] = None,
     ):
         cfg, params = _build_model(model, model_cfg, params, seed)
+        # speculative decoding with the small-model drafter
+        # (engine_config.spec_drafter == "model"): the draft model's
+        # config + params pass straight through to the engine; the
+        # default n-gram drafter needs neither
+        if draft_model_cfg is not None and draft_params is None:
+            _, draft_params = _build_model(
+                model, draft_model_cfg, None, seed
+            )
         #: max wait for the next streamed token — must cover the ADMISSION
         #: wait of a request queued behind a saturated engine, not just
         #: inter-token gaps (the engine's own 60s default is too tight for
         #: a deployment whose whole point is absorbing a deep queue)
         self._stream_timeout_s = stream_timeout_s
-        self._engine = LLMEngine(cfg, params, engine_config)
+        self._engine = LLMEngine(
+            cfg, params, engine_config,
+            draft_model_cfg=draft_model_cfg, draft_params=draft_params,
+        )
         if warmup:
-            # compile the prefill/decode/sampling jits NOW, inside replica
-            # creation, so serve.run's readiness gate covers compile time
-            # and the first real request streams at steady-state latency
-            self._engine.generate([0], SamplingParams(max_tokens=2))
+            # compile the prefill/decode/verify/sampling jits NOW, inside
+            # replica creation, so serve.run's readiness gate covers
+            # compile time and the first real request streams at
+            # steady-state latency (covers BOTH decode paths of a
+            # speculating engine — see LLMEngine.warmup)
+            self._engine.warmup()
         self._stop = threading.Event()
         self._loop = threading.Thread(
             target=self._engine.run_loop, args=(self._stop,),

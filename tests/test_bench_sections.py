@@ -1,0 +1,88 @@
+"""bench.py section isolation (VERDICT r5 robustness satellite).
+
+One flaky compile (e.g. a dropped remote_compile tunnel) must no longer
+zero a whole round's recorded numbers: every section runs behind
+``bench._section`` — retry once on failure, emit the section's own JSON
+line the moment it finishes, and let the final record carry whatever
+sections succeeded.
+"""
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _run(sections, name, fn):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        result = bench._section(sections, name, fn)
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.startswith("{")]
+    return result, lines
+
+
+def test_section_success_first_try():
+    sections = {}
+    result, lines = _run(sections, "good", lambda: {"value": 7})
+    assert result == {"value": 7}
+    assert sections["good"] == {"section": "good", "ok": True, "attempts": 1}
+    assert json.loads(lines[-1])["ok"] is True
+
+
+def test_section_retries_transient_failure_once():
+    sections = {}
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("tunnel reset by peer")
+        return {"value": 42}
+
+    result, _ = _run(sections, "flaky", flaky)
+    assert result == {"value": 42} and len(calls) == 2
+    assert sections["flaky"]["ok"] is True and sections["flaky"]["attempts"] == 2
+    # attempt 1's transient error must not linger on a successful record
+    assert "error" not in sections["flaky"]
+
+
+def test_section_double_failure_still_emits_json():
+    """Both attempts fail: the section records its error, PRINTS its own
+    JSON line anyway (a later crash cannot erase it), and returns None so
+    the caller's record goes out with the other sections."""
+    sections = {}
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("remote_compile tunnel down")
+
+    result, lines = _run(sections, "exploding", boom)
+    assert result is None and len(calls) == 2
+    rec = json.loads(lines[-1])
+    assert rec["section"] == "exploding" and rec["ok"] is False
+    assert "remote_compile tunnel down" in rec["error"]
+
+
+def test_section_empty_result_counts_as_failure():
+    """Subprocess-wrapped sections signal failure by returning {} — the
+    wrapper must retry and record the miss instead of treating empty as
+    success."""
+    sections = {}
+    result, _ = _run(sections, "empty", dict)
+    assert not result  # falsy either way; callers use `or {}`
+    assert sections["empty"]["ok"] is False
+    assert sections["empty"]["error"] == "empty result"
+
+
+def test_failed_sections_do_not_stop_later_ones():
+    sections = {}
+    _run(sections, "a", lambda: (_ for _ in ()).throw(ValueError("x")))
+    result, _ = _run(sections, "b", lambda: {"value": 1})
+    assert result == {"value": 1}
+    assert sections["a"]["ok"] is False and sections["b"]["ok"] is True
