@@ -106,6 +106,9 @@ def init(
             )
         ctx.namespace = resumed_ns or namespace or "default"
         runtime.set_ctx(ctx)
+        from ray_tpu._private import events as _events
+
+        _events.install_crash_handlers()
         atexit.register(_atexit_shutdown)
         return _context_info()
     if address is not None and _head is None:
@@ -153,6 +156,12 @@ def init(
         ctx.namespace = namespace
     runtime.set_ctx(ctx)
     _set_head(head)
+    # flight recorder: the driver's event ring flushes to JSONL on
+    # unhandled exceptions / SIGTERM too (events.py; workers arm theirs
+    # in worker_main) — postmortems cover the whole process tree
+    from ray_tpu._private import events as _events
+
+    _events.install_crash_handlers()
     atexit.register(_atexit_shutdown)
     return _context_info()
 

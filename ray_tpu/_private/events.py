@@ -1,0 +1,283 @@
+"""Flight recorder: an always-on, bounded ring buffer of structured events.
+
+Reference shape: the GCS task-event stream + Ray's debug-state dumps — but
+process-local and always armed, so a postmortem of a killed replica or a
+preemption storm needs no re-run.  Every process (driver, head, workers)
+appends typed events into a fixed-size deque; the steady-state cost is one
+lock + tuple append (~sub-microsecond), and memory is bounded by
+``capacity`` regardless of uptime.
+
+Three consumers:
+
+* **Live drain** — :func:`collect_cluster_events` gathers every live
+  worker's ring through the head (same broadcast/mailbox machinery as the
+  worker stack dumps), so ``python -m ray_tpu.obs events`` / ``obs req
+  <id>`` can reconstruct a request's life across processes.
+* **Crash flush** — :func:`install_crash_handlers` arms ``sys.excepthook``
+  / ``threading.excepthook`` / ``SIGTERM`` to dump the ring as JSONL into
+  ``RAY_TPU_EVENTS_DIR`` before the process dies.  Workers are killed by
+  SIGTERM (proc_handles), so a replica shot mid-stream still leaves its
+  last ``capacity`` events on disk.
+* **Chrome trace** — ``util.tracing.export_chrome_trace`` renders events
+  carrying a ``request_id`` as one per-request lane.
+
+Knobs (environment, read at import):
+
+* ``RAY_TPU_EVENTS`` — ``0`` disables recording entirely (bench A/B).
+* ``RAY_TPU_EVENTS_CAPACITY`` — ring size per process (default 8192).
+* ``RAY_TPU_EVENTS_DIR`` — crash-flush directory (default
+  ``<tempdir>/ray_tpu_events``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("RAY_TPU_EVENTS", "1").lower() not in ("0", "false", "off")
+
+
+def _env_capacity() -> int:
+    try:
+        return max(16, int(os.environ.get("RAY_TPU_EVENTS_CAPACITY", "8192")))
+    except ValueError:
+        return 8192
+
+
+_enabled = _env_enabled()
+_capacity = _env_capacity()
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=_capacity)
+_seq = itertools.count()  # per-process monotonic id: stable merge order
+_installed = False
+_dropped = 0  # events recorded before the current ring window (wraparound)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool) -> None:
+    """Toggle recording (benchmark A/B; tests). Always-on by default."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def configure(capacity: Optional[int] = None) -> None:
+    """Resize the ring (drops recorded events; tests/tuning only)."""
+    global _ring, _capacity
+    if capacity is not None:
+        with _lock:
+            _capacity = max(16, int(capacity))
+            _ring = deque(_ring, maxlen=_capacity)
+
+
+def record(etype: str, request_id: Optional[str] = None, **fields: Any) -> None:
+    """Append one event. Hot path: one tuple append, no serialization, no
+    I/O — cost is paid only when a consumer drains.
+
+    LOCK-FREE on purpose: ``deque.append`` (bounded) and ``next(count)``
+    are single atomic C calls under the GIL, and the crash handlers call
+    this from signal frames that may have interrupted another ``record``
+    on the same thread — a lock here would deadlock the dying process.
+    The ``_dropped`` read-modify-write is the one racy piece; it is an
+    advisory wraparound counter and may undercount under contention."""
+    global _dropped
+    if not _enabled:
+        return
+    if len(_ring) == _capacity:
+        _dropped += 1
+    _ring.append((next(_seq), time.time(), etype, request_id, fields or None))
+
+
+def snapshot(request_id: Optional[str] = None) -> list[dict]:
+    """Events currently in the ring (oldest first), as dicts. Optionally
+    filtered to one request.
+
+    Deliberately LOCK-FREE: ``list(deque)`` is a single C call, atomic
+    under the GIL even while other threads append.  It must stay that
+    way — the SIGTERM crash handler calls this from a signal frame that
+    may have interrupted ``record()`` mid-append ON THIS THREAD, where
+    taking the (non-reentrant) recorder lock would deadlock a dying
+    worker instead of flushing it."""
+    items = list(_ring)
+    pid = os.getpid()
+    out = []
+    for seq, ts, etype, rid, fields in items:
+        if request_id is not None and rid != request_id:
+            continue
+        ev = {"seq": seq, "ts": ts, "type": etype, "pid": pid}
+        if rid is not None:
+            ev["request_id"] = rid
+        if fields:
+            ev.update(fields)
+        out.append(ev)
+    return out
+
+
+def stats() -> dict:
+    # lock-free for the same signal-safety reason as snapshot(): every
+    # read here is a single atomic operation
+    return {
+        "enabled": _enabled,
+        "capacity": _capacity,
+        "size": len(_ring),
+        "dropped": _dropped,
+    }
+
+
+def clear() -> None:
+    global _dropped
+    _ring.clear()
+    _dropped = 0
+
+
+# ---------------------------------------------------------------------------
+# crash flush
+# ---------------------------------------------------------------------------
+
+
+def events_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_EVENTS_DIR",
+        os.path.join(tempfile.gettempdir(), "ray_tpu_events"),
+    )
+
+
+def flush(path: Optional[str] = None, reason: str = "manual") -> Optional[str]:
+    """Dump the ring as JSONL (one event per line, preceded by a header
+    line with process metadata). Returns the path, or None when the ring
+    is empty. Never raises — a flush failing must not mask the crash that
+    triggered it."""
+    try:
+        events = snapshot()
+        if not events:
+            return None
+        if path is None:
+            d = events_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"events-{os.getpid()}.jsonl")
+        with open(path, "w") as f:
+            header = {
+                "_flight_recorder": 1,
+                "pid": os.getpid(),
+                "reason": reason,
+                "time": time.time(),
+                **stats(),
+            }
+            f.write(json.dumps(header) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev, default=repr) + "\n")
+        return path
+    except Exception:
+        return None
+
+
+def install_crash_handlers() -> None:
+    """Arm flush-on-death (idempotent): unhandled exceptions in any thread
+    and SIGTERM (how workers are killed). The previous hooks/handlers are
+    chained, and SIGTERM re-raises the default action after flushing so
+    the process still dies."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    prev_except = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        record("crash.exception", error=f"{tp.__name__}: {val}")
+        flush(reason="excepthook")
+        prev_except(tp, val, tb)
+
+    sys.excepthook = _excepthook
+
+    prev_thread = threading.excepthook
+
+    def _thread_hook(args):
+        # daemon-thread crashes (engine loops, flushers) matter most here
+        record(
+            "crash.thread_exception",
+            thread=getattr(args.thread, "name", None),
+            error=f"{getattr(args.exc_type, '__name__', args.exc_type)}: {args.exc_value}",
+        )
+        flush(reason="threading.excepthook")
+        prev_thread(args)
+
+    threading.excepthook = _thread_hook
+
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            record("crash.sigterm")
+            flush(reason="sigterm")
+            if prev_term is signal.SIG_IGN:
+                return  # the process chose to ignore SIGTERM: honor that
+            if callable(prev_term) and prev_term is not signal.SIG_DFL:
+                prev_term(signum, frame)
+            else:
+                # restore the default action and re-deliver so the process
+                # dies with the conventional SIGTERM status
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass  # non-main interpreter / restricted env: hooks still armed
+
+
+# ---------------------------------------------------------------------------
+# cluster drain (head broadcast — same mailbox as worker stack dumps)
+# ---------------------------------------------------------------------------
+
+
+def collect_cluster_events(
+    request_id: Optional[str] = None, timeout: float = 5.0
+) -> list[dict]:
+    """This process's ring + every live worker's, via the head broadcast
+    (``rpc_collect_events``). Events gain a ``node``/``pid`` origin; order
+    is (ts, seq) across processes. Best-effort: an unreachable cluster
+    returns local events only."""
+    out = list(snapshot(request_id))
+    try:
+        from ray_tpu._private.runtime import get_ctx
+
+        ctx = get_ctx()
+        remote = ctx.call("collect_events", timeout=timeout)
+    except Exception:
+        remote = None
+    if remote:
+        # the caller's own ring comes back through the drain too (as a
+        # worker reply, or as the head's "head" entry for an in-process
+        # driver) — de-dup by event identity, not by pid: a bare pid
+        # check would silently drop a REMOTE node's worker that happens
+        # to share the caller's pid
+        seen = {(e["pid"], e["seq"], e["ts"]) for e in out}
+        for node, per_pid in remote.items():
+            for pid, evs in per_pid.items():
+                if pid == "_errors" or not isinstance(evs, list):
+                    continue
+                for ev in evs:
+                    if request_id is not None and ev.get("request_id") != request_id:
+                        continue
+                    key = (ev.get("pid"), ev.get("seq"), ev.get("ts"))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    ev.setdefault("node", node)
+                    out.append(ev)
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return out

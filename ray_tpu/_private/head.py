@@ -930,6 +930,9 @@ class Head:
             # shared reply mailbox with stack dumps; workers of one node
             # merge under their node's req_id
             self._mailbox_post(msg[1]["req_id"], {msg[1]["pid"]: msg[1]["profile"]})
+        elif kind == "events_result":
+            # flight-recorder drain replies ride the same mailbox
+            self._mailbox_post(msg[1]["req_id"], {msg[1]["pid"]: msg[1]["events"]})
 
     def _mailbox_post(self, req_id: str, update: dict) -> None:
         """Merge a reply into the stacks/profile rendezvous mailbox. Bounded:
@@ -1101,6 +1104,7 @@ class Head:
         blocking = method in (
             "get", "wait", "pg_ready", "get_actor_named", "stream_next",
             "worker_stacks", "worker_profile", "mutex_acquire",
+            "collect_events",
         )
         if blocking:
             # blocking RPCs park until objects/actors materialize; run them
@@ -3917,21 +3921,20 @@ class Head:
             out[node_hex] = {"error": "no reply within timeout"}
         return out
 
-    def rpc_worker_profile(self, duration_s: float = 2.0, interval_ms: float = 10.0,
-                           timeout: float = 0.0):
-        """Sampling CPU profile of every live worker (reference: the
-        dashboard's py-spy ``cpu_profile`` endpoint). Each worker samples
-        itself (``reporter.sample_profile``) and posts collapsed stacks
-        back; returns ``{node_hex: {pid: collapsed_text}}`` — feed a value
-        straight to flamegraph.pl or speedscope."""
+    def _broadcast_rendezvous(self, msg_kind: str, payload: dict,
+                              deadline: float) -> dict:
+        """Fan ``(msg_kind, payload + req_id)`` out to every live
+        registered worker and gather the replies posted to the stacks
+        mailbox until ``deadline``.  One req_id per NODE (its workers
+        merge into one mailbox entry), which keeps the 64-entry mailbox
+        bound a per-node bound, not per-worker.  Returns ``{node_hex:
+        {pid: reply}}``; nodes with missing workers additionally carry an
+        ``_errors`` list (a distinct key shape from pids, so callers
+        iterating pids never trip on it) — partial coverage is reported,
+        never silently assumed total.  Shared by ``rpc_worker_profile``
+        and ``rpc_collect_events``."""
         import uuid as _uuid
 
-        duration_s = min(max(float(duration_s), 0.05), 60.0)  # bound GIL cost
-        timeout = timeout or duration_s + 5.0
-        deadline = time.monotonic() + timeout
-        req = {"duration_s": duration_s, "interval_s": interval_ms / 1000.0}
-        # one req_id per NODE (its workers merge into one mailbox entry):
-        # keeps the 64-entry mailbox bound a per-node bound, not per-worker
         req_ids: dict[str, tuple[str, int]] = {}  # rid -> (node_hex, expected)
         with self.lock:
             for node in self.nodes.values():
@@ -3942,7 +3945,7 @@ class Head:
                     continue
                 rid = _uuid.uuid4().hex
                 for wh in whs:
-                    self._enqueue_send(wh, ("profile", dict(req, req_id=rid)))
+                    self._enqueue_send(wh, (msg_kind, dict(payload, req_id=rid)))
                 req_ids[rid] = (node.node_id.hex(), len(whs))
         self.flush_outbox()
         out: dict[str, dict] = {}
@@ -3950,10 +3953,8 @@ class Head:
         def _take(rid: str, node_hex: str, expected: int) -> None:
             got = self._stacks_replies.pop(rid, None) or {}
             dest = out.setdefault(node_hex, {})
-            dest.update({str(p): t for p, t in got.items()})
+            dest.update({str(p): v for p, v in got.items()})
             if len(got) < expected:
-                # distinct key shape from pids (cf. rpc_worker_stacks' node-
-                # level error): callers iterate pids without tripping on it
                 dest["_errors"] = [
                     f"{expected - len(got)} worker(s) did not reply within timeout"
                 ]
@@ -3971,12 +3972,42 @@ class Head:
                 _take(rid, node_hex, expected)  # deadline: keep partials
         return out
 
+    def rpc_worker_profile(self, duration_s: float = 2.0, interval_ms: float = 10.0,
+                           timeout: float = 0.0):
+        """Sampling CPU profile of every live worker (reference: the
+        dashboard's py-spy ``cpu_profile`` endpoint). Each worker samples
+        itself (``reporter.sample_profile``) and posts collapsed stacks
+        back; returns ``{node_hex: {pid: collapsed_text}}`` — feed a value
+        straight to flamegraph.pl or speedscope."""
+        duration_s = min(max(float(duration_s), 0.05), 60.0)  # bound GIL cost
+        timeout = timeout or duration_s + 5.0
+        req = {"duration_s": duration_s, "interval_s": interval_ms / 1000.0}
+        return self._broadcast_rendezvous(
+            "profile", req, time.monotonic() + timeout
+        )
+
+    def rpc_collect_events(self, timeout: float = 5.0):
+        """Drain every live worker's flight-recorder ring (plus this
+        process's own) — ``{node_hex: {pid: [event, ...]}}``. Same
+        broadcast/mailbox rendezvous as ``rpc_worker_profile``; workers
+        that miss the deadline are reported under ``_errors`` so callers
+        see partial coverage instead of assuming it was total."""
+        from ray_tpu._private import events as _ev
+
+        timeout = min(max(float(timeout), 0.2), 30.0)
+        out = self._broadcast_rendezvous(
+            "events_drain", {}, time.monotonic() + timeout
+        )
+        # the head process's own ring (the in-process driver's, usually)
+        out.setdefault("head", {})[str(os.getpid())] = _ev.snapshot()
+        return out
+
     def rpc_task_events(self):
         with self.lock:
             return [
                 {"task_id": tid.hex(), "name": name, "state": state,
-                 "time": t, "kind": kind}
-                for tid, name, state, t, kind in self.task_events
+                 "time": t, "kind": kind, "request_id": rid}
+                for tid, name, state, t, kind, rid in self.task_events
             ]
 
     def rpc_autoscaler_demand(self):
@@ -4122,9 +4153,11 @@ class Head:
     def _event(self, rec, state):
         # hot path (3 events per task): store a compact tuple; consumers
         # (rpc_task_events -> state API / timeline) expand to dicts lazily
+        spec = rec["spec"]
+        tctx = spec.get("trace_ctx")
         self.task_events.append(
-            (rec["task_id"], rec["spec"].get("name"), state, time.time(),
-             rec["spec"].get("kind"))
+            (rec["task_id"], spec.get("name"), state, time.time(),
+             spec.get("kind"), tctx.get("request_id") if tctx else None)
         )
         if len(self.task_events) > GLOBAL_CONFIG.task_events_max_entries:
             # floor of 1 so tiny settings still trim instead of growing forever

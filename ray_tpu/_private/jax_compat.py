@@ -1,0 +1,37 @@
+"""Version shims over moving jax APIs.
+
+The repo targets the modern surface (``jax.shard_map`` with
+``check_vma``); older installs (0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the same semantics under
+``check_rep``. Kernel/parallel call sites import from here so the rest
+of the codebase stays on one spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` when available, else the 0.4.x experimental one
+    (``check_vma`` maps onto the old ``check_rep`` flag)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static mesh-axis size inside an SPMD region. ``jax.lax.axis_size``
+    when available; on 0.4.x ``psum(1, axis)`` constant-folds to a Python
+    int at trace time, so loop bounds stay static either way."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import pickle
+import sys
 import threading
 import uuid
 from multiprocessing import resource_tracker, shared_memory
@@ -293,7 +294,17 @@ class ShmReader:
             arena = attach_arena(loc.name)
             if arena is None or not arena.pin(loc.offset, loc.gen):
                 raise FileNotFoundError(f"arena object gone: {loc.name}+{loc.offset}")
-            self._block = _PinnedBlock(arena, loc.offset, loc.total_size)
+            if sys.version_info >= (3, 12):
+                self._block = _PinnedBlock(arena, loc.offset, loc.total_size)
+            else:
+                # pre-PEP 688 interpreters can't export a buffer from a
+                # Python class, so views could not keep the pin alive —
+                # copy the block out and release the pin immediately.
+                # Correct (views reference the private copy), not zero-copy.
+                try:
+                    self._block = bytes(arena.view(loc.offset, loc.total_size))
+                finally:
+                    arena.unpin(loc.offset)
             return
         self.shm = shared_memory.SharedMemory(name=loc.name)
         _untrack(self.shm)

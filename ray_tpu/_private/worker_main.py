@@ -172,6 +172,13 @@ def main(
     from ray_tpu._private.reporter import arm_stack_dumps
 
     arm_stack_dumps()
+    # flight recorder: flush the event ring to JSONL when this worker dies
+    # by SIGTERM (how proc_handles kills us) or an unhandled exception —
+    # the postmortem story for a replica shot mid-stream (events.py)
+    from ray_tpu._private import events as _events
+
+    _events.record("worker.start", node=node_id_bin.hex()[:12])
+    _events.install_crash_handlers()
     try:
         ctx.send_raw(
             ("register", {"pid": os.getpid(), "node_id": node_id_bin, "token": token})
@@ -194,6 +201,10 @@ def main(
         def _dump(*_a):
             pr.disable()
             pr.dump_stats(os.path.join(prof_dir, f"worker-{os.getpid()}.prof"))
+            # this handler REPLACES the flight recorder's SIGTERM hook —
+            # flush the event ring here so a profiled worker still leaves
+            # its postmortem JSONL (flush never raises)
+            _events.flush(reason="sigterm")
             os._exit(0)
 
         global _prof_exit
@@ -286,6 +297,8 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
                 state.stream_cv.notify_all()
         elif kind == "profile":
             _start_profile(ctx, msg[1])
+        elif kind == "events_drain":
+            _drain_events(ctx, msg[1])
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
@@ -329,6 +342,29 @@ def _start_profile(ctx, req: dict) -> None:
             pass  # head gone: nothing to report to
 
     threading.Thread(target=_run, daemon=True, name="rt-profiler").start()
+
+
+def _drain_events(ctx, req: dict) -> None:
+    """Reply with this worker's flight-recorder ring (head rendezvous:
+    ``rpc_collect_events``). Snapshot off the recv loop — the ring can be
+    large and serialization must not stall task dispatch."""
+
+    def _run():
+        from ray_tpu._private import events as _ev
+
+        try:
+            evs = _ev.snapshot()
+        except Exception as e:  # noqa: BLE001 — drain is best-effort
+            evs = [{"type": "events.drain_failed", "error": repr(e)}]
+        try:
+            ctx.send_raw(
+                ("events_result",
+                 {"req_id": req["req_id"], "pid": os.getpid(), "events": evs})
+            )
+        except Exception:
+            pass  # head gone: nothing to report to
+
+    threading.Thread(target=_run, daemon=True, name="rt-events-drain").start()
 
 
 def _handle_cancel(state: WorkerState, task_id: bytes):
@@ -467,7 +503,22 @@ def _stream_results(state: WorkerState, spec: dict, gen) -> None:
     with a consumer-acked backpressure window
     (``streaming_backpressure_items``). The task's single declared return
     becomes the completion object: None on success, the exception on a
-    mid-stream failure."""
+    mid-stream failure.
+
+    The generator BODY runs during this drive (not at creation), possibly
+    on an async actor's done-pool thread — (re-)install the submitter's
+    trace context here so spans/events inside streaming bodies (the serve
+    LLM path) keep their request_id for the stream's whole life."""
+    from ray_tpu.util import tracing as _tracing
+
+    prev_trace = _tracing.set_trace_context(spec.get("trace_ctx"))
+    try:
+        _stream_results_inner(state, spec, gen)
+    finally:
+        _tracing.set_trace_context(prev_trace)
+
+
+def _stream_results_inner(state: WorkerState, spec: dict, gen) -> None:
     from ray_tpu._private.ids import ObjectID, TaskID
 
     task_id = spec["task_id"]
@@ -552,10 +603,15 @@ def _sync_over_asyncgen(agen, loop):
 
 def _run_task(state: WorkerState, spec: dict):
     from ray_tpu._private import runtime_env as renv
+    from ray_tpu.util import tracing as _tracing
 
     task_id = spec["task_id"]
     state.current_task_id = task_id
     state.task_threads[task_id] = threading.get_ident()
+    # re-install the submitter's trace context on the executing thread:
+    # spans/events inside the task body (and any nested .remote() hops)
+    # carry the same request_id end-to-end (util.tracing module doc)
+    prev_trace = _tracing.set_trace_context(spec.get("trace_ctx"))
     if spec["kind"] != "actor_method":
         # a plain task runs in its SUBMITTER's namespace (client sessions):
         # named-actor ops inside the function resolve where the submitter's
@@ -584,11 +640,13 @@ def _run_task(state: WorkerState, spec: dict):
             value = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
         is_error = True
     finally:
+        _tracing.set_trace_context(prev_trace)
         state.current_task_id = None
         state.task_threads.pop(task_id, None)
         state.cancel_requested.discard(task_id)
     if spec.get("num_returns") == "streaming" and not is_error:
         # the function returned a generator: drive it item by item
+        # (_stream_results re-installs the trace context for the drive)
         _stream_results(state, spec, value)
         return
     try:
@@ -763,10 +821,22 @@ async def _arun(state: WorkerState, spec: dict):
     import functools
     import inspect
 
+    from ray_tpu.util import tracing as _tracing
+
     loop = asyncio.get_running_loop()
     task_id = spec["task_id"]
     state.async_tasks[task_id] = asyncio.current_task()
     is_error = False
+    # best-effort trace context for async actors: the loop thread is shared,
+    # so interleaved coroutines can momentarily see each other's context —
+    # spans inside async methods still tag correctly in the common
+    # one-request-at-a-time case (sync actors get exact scoping in _run_task).
+    # On exit the context is CLEARED (if still ours) rather than restored:
+    # under interleaving, a saved "previous" context can belong to a request
+    # that already finished, and restoring it would tag the loop thread's
+    # later events with a dead request's id indefinitely.
+    my_trace = spec.get("trace_ctx")
+    _tracing.set_trace_context(my_trace)
     try:
         group = spec.get("concurrency_group")
         if group and group not in state.group_sems:
@@ -831,6 +901,8 @@ async def _arun(state: WorkerState, spec: dict):
             value = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
         is_error = True
     finally:
+        if _tracing.get_trace_context() is my_trace:
+            _tracing.set_trace_context(None)
         state.async_tasks.pop(task_id, None)
         state.cancel_requested.discard(task_id)
     if spec.get("num_returns") == "streaming" and not is_error:

@@ -111,6 +111,14 @@ class ActorHandle:
             "return_ids": return_ids,
             "name": f"{self._class_name}.{name}",
         }
+        # trace-context propagation: the submitter's request_id rides the
+        # spec so the executing worker's spans/events nest under it; with
+        # no active context the call roots a trace at its own task id
+        from ray_tpu.util import tracing as _tracing
+
+        spec["trace_ctx"] = _tracing.get_trace_context() or {
+            "request_id": task_id.hex()[:16]
+        }
         if concurrency_group:
             spec["concurrency_group"] = concurrency_group
         refs = ctx.submit_actor_task(spec)

@@ -91,6 +91,29 @@ def _payload(path: str):
         return {"job_id": job_id, "logs": "\n".join(lines[-tail:])}
     if path == "/api/metrics":
         return um.collect()
+    if path == "/api/percentiles":
+        # p50/p95/p99 snapshots for every cluster histogram (obs top's
+        # TTFT/ITL view over HTTP)
+        return um.histogram_percentiles()
+    if path == "/api/events":
+        # flight-recorder drain (cluster-wide, newest last); ?request_id=
+        # narrows to one request, ?tail= caps the reply
+        from ray_tpu._private import events as ev
+
+        rid = (query.get("request_id") or [None])[0]
+        try:
+            tail = int((query.get("tail") or ["500"])[0])
+        except ValueError:
+            tail = 500
+        return ev.collect_cluster_events(rid)[-tail:]
+    if path == "/api/request":
+        # one request's merged timeline (same data as `obs req <id>`)
+        from ray_tpu.obs import request_events
+
+        rid = (query.get("id") or [""])[0]
+        if not rid:
+            return {"error": "pass ?id=<request_id>"}
+        return request_events(rid)
     if path == "/api/grafana":
         from ray_tpu.util.grafana import dashboard_json
 

@@ -38,6 +38,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from ray_tpu._private import events as _events
 from ray_tpu.llm.cache import CacheConfig, KVBlockPool
 from ray_tpu.llm.model_runner import PagedModelRunner, _sample_rows
 from ray_tpu.llm.scheduler import (
@@ -238,6 +239,10 @@ class LLMEngine:
             )
         deadline = time.time() + deadline_s if deadline_s is not None else None
         req = Request(prompt, params, deadline=deadline)
+        _events.record(
+            "llm.submit", request_id=req.trace_id, engine_req=req.id,
+            prompt_len=len(prompt), max_tokens=params.max_tokens,
+        )
         with self._lock:
             self._requests[req.id] = req
             self.scheduler.add(req)
@@ -424,6 +429,10 @@ class LLMEngine:
         )
         self.pool.k, self.pool.v = k, v
         req.prefill_pos += n_valid
+        _events.record(
+            "llm.prefill_chunk", request_id=req.trace_id, engine_req=req.id,
+            pos=req.prefill_pos, of=len(full), n=n_valid,
+        )
         if req.prefill_pos >= len(full):
             # final chunk: its last position's logits seed generation
             p = req.params
@@ -498,6 +507,10 @@ class LLMEngine:
         self.pool.k, self.pool.v = k, v
         nxt = np.asarray(nxt)  # ONE host sync for the whole batch
         for i, req in active:
+            _events.record(
+                "llm.decode", request_id=req.trace_id, engine_req=req.id,
+                step=self._step_n, token=int(nxt[i]),
+            )
             self._emit(req, int(nxt[i]))
         _metrics()["tokens_per_step"].set(len(active))
         return True
@@ -569,6 +582,10 @@ class LLMEngine:
         for i, req in active:
             n = int(n_acc[i])
             accepted += n
+            _events.record(
+                "llm.verify", request_id=req.trace_id, engine_req=req.id,
+                step=self._step_n, proposed=kd, accepted=n,
+            )
             for j in range(n + 1):
                 self._emit(req, int(out[i, j]))
                 emitted += 1
@@ -617,6 +634,10 @@ class LLMEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             m["ttft"].observe(now - req.arrival_t)
+            _events.record(
+                "llm.first_token", request_id=req.trace_id,
+                engine_req=req.id, ttft_s=round(now - req.arrival_t, 6),
+            )
         elif req.last_token_t is not None:
             m["itl"].observe(now - req.last_token_t)
         req.last_token_t = now

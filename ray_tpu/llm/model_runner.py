@@ -38,11 +38,13 @@ retrace.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from ray_tpu._private import events as _events
 from ray_tpu.models.gpt import GPTConfig, _layernorm
 from ray_tpu.models.gptj import GPTJConfig
 from ray_tpu.models.sampling import sample_tokens, speculative_verify
@@ -130,6 +132,21 @@ class PagedModelRunner:
             self._prefill_impl, donate_argnums=(1, 2), static_argnames=("chunk",)
         )
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1, 2))
+        self._compiled: set = set()  # (fn, shape-key)s already traced
+
+    def _note_compile(self, fn: str, key: Any, t0: float) -> None:
+        """Flight-recorder marker for each jit trace+compile: the first
+        call per (fn, static-shape) pays the compile, and that wall time
+        dominating a serve replica's init (or a mid-traffic retrace, which
+        should NEVER happen — static shapes) is exactly what a postmortem
+        needs to see.  Subsequent steady-state calls record nothing."""
+        if (fn, key) in self._compiled:
+            return
+        self._compiled.add((fn, key))
+        _events.record(
+            "llm.compile", fn=fn, shape=str(key), arch=self.arch,
+            first_call_s=round(time.perf_counter() - t0, 3),
+        )
 
     # -- shared layer math -------------------------------------------------
 
@@ -249,10 +266,13 @@ class PagedModelRunner:
 
     def decode_step(self, k_pool, v_pool, tokens, positions, tables,
                     temp, top_k, top_p, seeds, counters):
-        return self._decode(
+        t0 = time.perf_counter()
+        out = self._decode(
             self.params, k_pool, v_pool, tokens, positions, tables,
             temp, top_k, top_p, seeds, counters,
         )
+        self._note_compile("decode", len(tokens), t0)
+        return out
 
     # -- speculative verification step -------------------------------------
 
@@ -331,10 +351,13 @@ class PagedModelRunner:
 
     def verify_step(self, k_pool, v_pool, tokens, base_pos, tables,
                     temp, top_k, top_p, seeds, counters):
-        return self._verify(
+        t0 = time.perf_counter()
+        out = self._verify(
             self.params, k_pool, v_pool, tokens, base_pos, tables,
             temp, top_k, top_p, seeds, counters,
         )
+        self._note_compile("verify", tuple(jnp.shape(tokens)), t0)
+        return out
 
     # -- prefill chunk -----------------------------------------------------
 
@@ -393,7 +416,10 @@ class PagedModelRunner:
         return k_pool, v_pool, logits
 
     def prefill_chunk(self, k_pool, v_pool, tokens, start, n_valid, table):
-        return self._prefill(
+        t0 = time.perf_counter()
+        out = self._prefill(
             self.params, k_pool, v_pool, tokens,
             jnp.int32(start), jnp.int32(n_valid), table, chunk=len(tokens),
         )
+        self._note_compile("prefill", len(tokens), t0)
+        return out

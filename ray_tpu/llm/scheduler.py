@@ -33,7 +33,9 @@ import time
 from collections import deque
 from typing import Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu.llm.cache import KVBlockPool
+from ray_tpu.util import tracing as _tracing
 
 _req_counter = itertools.count()
 
@@ -78,6 +80,11 @@ class Request:
         if not prompt:
             raise ValueError("prompt must contain at least one token")
         self.id = f"req-{next(_req_counter)}"
+        # end-to-end correlation id: the submitting thread's trace context
+        # (proxy-minted for served traffic, set via tracing.trace_context
+        # for direct engine use); falls back to the engine-local id so
+        # every request is traceable through `obs req <id>` either way
+        self.trace_id = _tracing.current_request_id() or self.id
         self.prompt = list(prompt)
         self.params = params
         self.deadline = deadline
@@ -162,6 +169,11 @@ class Scheduler:
             req.prefill_pos = 0
             self._admitted_at[req.id] = next(self._admit_seq)
             admitted.append(req)
+            _events.record(
+                "llm.admit", request_id=req.trace_id, engine_req=req.id,
+                slot=slot, seq_len=req.seq_len,
+                wait_s=round(time.time() - req.arrival_t, 6),
+            )
         return admitted
 
     def grow_for_decode(self, req: Request, extra: int = 0) -> bool:
@@ -204,6 +216,10 @@ class Scheduler:
         req.prefill_pos = 0
         req.state = WAITING
         self.waiting.appendleft(req)
+        _events.record(
+            "llm.preempt", request_id=req.trace_id, engine_req=req.id,
+            tokens_out=len(req.out), recompute_len=req.seq_len,
+        )
 
     def finish(self, req: Request, reason: str) -> None:
         slot = self._slot_of(req)
@@ -217,6 +233,11 @@ class Scheduler:
         self._admitted_at.pop(req.id, None)
         req.state = FINISHED
         req.finish_reason = reason
+        _events.record(
+            "llm.finish", request_id=req.trace_id, engine_req=req.id,
+            reason=reason, tokens_out=len(req.out),
+            dur_s=round(time.time() - req.arrival_t, 6),
+        )
         req.stream.put(("done", reason))
 
     def _slot_of(self, req: Request) -> Optional[int]:

@@ -1,0 +1,444 @@
+"""Live observability CLI: ``python -m ray_tpu.obs <command>``.
+
+Reference: the state CLI (``ray summary`` / ``ray list`` /
+``ray timeline``) plus the dashboard's live cluster view, folded into one
+terminal tool over this repo's three observability surfaces:
+
+* ``util.metrics`` — cluster-merged counters/gauges/histograms (now with
+  bucket-interpolated percentile snapshots),
+* the flight recorder (``_private/events.py``) — every process's
+  always-on ring of structured events, drained live through the head,
+* ``util.tracing`` — spans + task events correlated by ``request_id``.
+
+Commands::
+
+    python -m ray_tpu.obs top --address HOST:PORT [--watch 2]
+        Live cluster + LLM engine view: nodes, tasks by state,
+        running/waiting requests, KV utilization, speculative acceptance
+        rate, tokens/s, TTFT/ITL p50/p95/p99.
+
+    python -m ray_tpu.obs req <request_id> --address HOST:PORT
+        One request's life as a timeline: proxy -> replica -> engine
+        events (admission, prefill chunks, first token, per-step
+        decode/verify with accepted counts, preemptions, finish), with
+        relative timestamps and a latency summary.
+
+    python -m ray_tpu.obs events --address HOST:PORT [--tail 50]
+        Tail the cluster-wide flight recorder (newest last).
+
+    python -m ray_tpu.obs timeline --address HOST:PORT -o trace.json
+        Chrome-trace export (task events + spans + one lane per request);
+        load in chrome://tracing or Perfetto.
+
+Every command needs a running cluster (``--address``, or
+``RAY_TPU_ADDRESS``); ``req``/``events`` also read crash-flush JSONL
+files from ``--events-dir`` so a killed worker's last events still show.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import Optional
+
+
+def _attach(address: Optional[str]):
+    import ray_tpu
+
+    ray_tpu.init(address=address or os.environ.get("RAY_TPU_ADDRESS") or None)
+    return ray_tpu
+
+
+def _offline(args) -> bool:
+    """True when the command should run purely from crash-flush JSONL:
+    an explicit --events-dir and no address to attach to.  Booting a
+    fresh local cluster just to read files off disk would be slow, can
+    fail in restricted sandboxes, and contributes zero events — the
+    postmortem flow (CI artifact triage, a dead cluster's events dir)
+    must work with nothing alive."""
+    return bool(
+        getattr(args, "events_dir", None)
+        and not (args.address or os.environ.get("RAY_TPU_ADDRESS"))
+    )
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def _fmt_pcts(p: dict) -> str:
+    def one(v):
+        return "-" if v is None or (isinstance(v, float) and math.isnan(v)) else _fmt_ms(v)
+
+    return (
+        f"p50={one(p.get('p50'))} p95={one(p.get('p95'))} "
+        f"p99={one(p.get('p99'))} (n={p.get('count', 0)})"
+    )
+
+
+def _first_series(per_tag: dict):
+    """A metric's sole (or first) tagged series — engine metrics are
+    untagged, so this is the value."""
+    for v in per_tag.values():
+        return v
+    return None
+
+
+def _load_crash_files(events_dir: Optional[str]) -> list[dict]:
+    """Crash-flush JSONL files (``events.flush``) — the postmortem side of
+    ``events``/``req``: a killed worker can't answer the live drain, but
+    its flushed ring is still on disk."""
+    from ray_tpu._private import events as ev
+
+    d = events_dir or ev.events_dir()
+    out: list[dict] = []
+    if not os.path.isdir(d):
+        return out
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".jsonl"):
+            continue
+        try:
+            with open(os.path.join(d, fname)) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("_flight_recorder"):
+                        continue  # header line
+                    rec.setdefault("crash_flush", fname)
+                    out.append(rec)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# top
+# ---------------------------------------------------------------------------
+
+
+def _render_top(prev_sample: Optional[tuple]) -> tuple:
+    """One frame of ``obs top``. Returns (tokens_counter, time) so the
+    next frame can rate the token counter into tokens/s."""
+    from ray_tpu.util import state as st
+    from ray_tpu.util.metrics import collect, histogram_percentiles
+
+    data = collect()
+    metrics = data.get("metrics", {})
+    summary = st.summary()
+    nodes = [n for n in st.list_nodes() if n.get("Alive", n.get("alive", True))]
+
+    def gauge(name, default=None):
+        v = _first_series(metrics.get(name, {}))
+        return default if v is None else v
+
+    now = time.time()
+    tokens = gauge("llm_generated_tokens", 0.0) or 0.0
+    rate = None
+    if prev_sample is not None:
+        dt = now - prev_sample[1]
+        if dt > 0:
+            rate = max(0.0, (tokens - prev_sample[0]) / dt)
+
+    lines = [
+        time.strftime("-- ray_tpu obs top -- %H:%M:%S"),
+        f"nodes: {len(nodes)}  "
+        f"tasks: {summary.get('tasks', {}).get('by_state') or {}}  "
+        f"actors: {summary.get('actors', {}).get('by_state') or {}}",
+    ]
+    if "llm_running_requests" in metrics:
+        acc = gauge("llm_spec_acceptance_rate")
+        lines.append(
+            "engine: "
+            f"running={int(gauge('llm_running_requests', 0) or 0)} "
+            f"waiting={int(gauge('llm_waiting_requests', 0) or 0)} "
+            f"kv_util={float(gauge('llm_kv_block_utilization', 0.0) or 0.0):.2f} "
+            f"tokens/step={gauge('llm_tokens_per_step', 0)} "
+            + (f"accept_rate={acc:.2f} " if acc is not None else "")
+            + (f"tokens/s={rate:.1f}" if rate is not None else f"tokens={int(tokens)}")
+        )
+        pcts = histogram_percentiles()
+        ttft = _first_series(pcts.get("llm_time_to_first_token_s", {}))
+        itl = _first_series(pcts.get("llm_inter_token_latency_s", {}))
+        if ttft:
+            lines.append(f"TTFT: {_fmt_pcts(ttft)}")
+        if itl:
+            lines.append(f"ITL:  {_fmt_pcts(itl)}")
+    else:
+        lines.append("engine: (no llm_* metrics published — no LLM replica running)")
+    print("\n".join(lines), flush=True)
+    return (tokens, now)
+
+
+def cmd_top(args) -> int:
+    ray_tpu = _attach(args.address)
+    try:
+        sample = None
+        while True:
+            sample = _render_top(sample)
+            if args.once:
+                return 0
+            time.sleep(max(args.watch, 0.2))
+            print()
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# req
+# ---------------------------------------------------------------------------
+
+
+def request_events(request_id: str, events_dir: Optional[str] = None) -> list[dict]:
+    """Everything known about one request, merged and time-ordered: live
+    flight-recorder rings (cluster drain), crash-flush files, and span/
+    task-event records tagged with the id."""
+    from ray_tpu._private import events as ev
+    from ray_tpu.util import state as st
+    from ray_tpu.util import tracing
+
+    merged = ev.collect_cluster_events(request_id)
+    for rec in _load_crash_files(events_dir):
+        if rec.get("request_id") == request_id:
+            merged.append(rec)
+    # spans (cluster-wide) whose args carry the id become span events
+    for s in tracing.collect_cluster_spans():
+        if (s.get("args") or {}).get("request_id") != request_id:
+            continue
+        merged.append(
+            {
+                "ts": s["ts"] / 1e6,
+                "type": f"span:{s['name']}",
+                "dur_s": round(s.get("dur", 0.0) / 1e6, 6),
+                "request_id": request_id,
+                "pid": s.get("pid"),
+            }
+        )
+    # runtime task events (submitted/running/finished hops)
+    try:
+        for t in st.get_task_events():
+            if t.get("request_id") != request_id:
+                continue
+            merged.append(
+                {
+                    "ts": t["time"],
+                    "type": f"task:{t.get('name') or t['task_id'][:8]}:{t['state']}",
+                    "request_id": request_id,
+                }
+            )
+    except Exception:
+        pass  # state API gone (detached postmortem): recorder data stands alone
+    merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return _dedup(merged)
+
+
+def _dedup(evs: list[dict]) -> list[dict]:
+    """Drop events that arrived through more than one channel (the live
+    drain AND a crash-flush file — a process that flushed but survived
+    answers both), keyed on per-process identity."""
+    seen = set()
+    out = []
+    for e in evs:
+        key = (e.get("ts"), e.get("type"), e.get("pid"), e.get("seq"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
+
+
+def render_request(request_id: str, evs: list[dict]) -> str:
+    """Human-readable single-request timeline (what ``obs req`` prints)."""
+    if not evs:
+        return f"request {request_id}: no events found"
+    t0 = evs[0].get("ts", 0.0)
+    lines = [f"request {request_id}  ({len(evs)} events)"]
+    for e in evs:
+        rel = (e.get("ts", t0) - t0) * 1e3
+        extras = {
+            k: v
+            for k, v in e.items()
+            if k not in ("ts", "seq", "type", "request_id", "pid", "node")
+        }
+        where = e.get("node", "")[:8] or e.get("pid", "")
+        detail = " ".join(f"{k}={v}" for k, v in extras.items())
+        lines.append(f"  +{rel:9.1f}ms  {e.get('type', '?'):<24} {detail}  [{where}]")
+    # summary: TTFT / decode steps / acceptance / finish
+    ttft = next((e["ttft_s"] for e in evs if e.get("type") == "llm.first_token"), None)
+    fin = next((e for e in evs if e.get("type") == "llm.finish"), None)
+    verifies = [e for e in evs if e.get("type") == "llm.verify"]
+    parts = []
+    if ttft is not None:
+        parts.append(f"ttft={_fmt_ms(ttft)}")
+    if verifies:
+        acc = sum(e.get("accepted", 0) for e in verifies)
+        prop = sum(e.get("proposed", 0) for e in verifies)
+        parts.append(
+            f"spec: {len(verifies)} windows accepted {acc}/{prop} "
+            f"({acc / max(prop, 1):.2f})"
+        )
+    if fin:
+        parts.append(
+            f"finished: {fin.get('reason')} after {fin.get('tokens_out')} tokens "
+            f"in {_fmt_ms(fin.get('dur_s', 0.0))}"
+        )
+    if parts:
+        lines.append("  -- " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+def cmd_req(args) -> int:
+    if _offline(args):
+        evs = [
+            r for r in _load_crash_files(args.events_dir)
+            if r.get("request_id") == args.request_id
+        ]
+        evs.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+        print(render_request(args.request_id, evs))
+        return 0 if evs else 1
+    ray_tpu = _attach(args.address)
+    try:
+        evs = request_events(args.request_id, args.events_dir)
+        print(render_request(args.request_id, evs))
+        return 0 if evs else 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# events / timeline
+# ---------------------------------------------------------------------------
+
+
+def cmd_events(args) -> int:
+    from ray_tpu._private import events as ev
+
+    ray_tpu = None
+    if not _offline(args):
+        ray_tpu = _attach(args.address)
+    try:
+        evs = (
+            ev.collect_cluster_events(args.request_id or None)
+            if ray_tpu is not None
+            else []
+        )
+        evs.extend(
+            rec
+            for rec in _load_crash_files(args.events_dir)
+            if not args.request_id or rec.get("request_id") == args.request_id
+        )
+        if args.type:
+            evs = [e for e in evs if str(e.get("type", "")).startswith(args.type)]
+        evs.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+        evs = _dedup(evs)
+        for e in evs[-args.tail :]:
+            print(json.dumps(e, default=repr))
+        return 0
+    finally:
+        if ray_tpu is not None:
+            ray_tpu.shutdown()
+
+
+def offline_trace(events_dir: Optional[str], output: str) -> list[dict]:
+    """Chrome trace from crash-flush JSONL alone — no cluster needed.
+    The postmortem path (CI artifacts, a dead cluster's events dir):
+    every flushed event becomes an instant marker on its process's lane,
+    and request-tagged events additionally get their per-request lane."""
+    from ray_tpu.util import tracing
+
+    evs = _load_crash_files(events_dir)
+    entries = []
+    for e in evs:
+        args = {
+            k: v
+            for k, v in e.items()
+            if k not in ("ts", "type", "seq", "pid", "crash_flush")
+        }
+        entries.append(
+            {
+                "name": e.get("type", "event"),
+                "cat": "recorder",
+                "ph": "i",
+                "s": "t",
+                "ts": e.get("ts", 0.0) * 1e6,
+                "pid": f"proc-{e.get('pid', '?')}",
+                "tid": e.get("crash_flush", "events"),
+                "args": args,
+            }
+        )
+    entries += tracing.request_lanes([], evs)
+    with open(output, "w") as f:
+        json.dump(entries, f)
+    return entries
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import tracing
+
+    if args.events_dir:
+        events = offline_trace(args.events_dir, args.output)
+        lanes = {e["tid"] for e in events if e.get("pid") == "requests"}
+        print(
+            f"wrote {len(events)} events ({len(lanes)} request lanes) "
+            f"to {args.output} (offline, from {args.events_dir})"
+        )
+        return 0
+    ray_tpu = _attach(args.address)
+    try:
+        events = tracing.export_chrome_trace(args.output)
+        lanes = {e["tid"] for e in events if e.get("pid") == "requests"}
+        print(
+            f"wrote {len(events)} events ({len(lanes)} request lanes) "
+            f"to {args.output}"
+        )
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.obs",
+        description="live cluster / request observability",
+    )
+    parser.add_argument("--address", default=None, help="head HOST:PORT")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("top", help="live cluster + LLM engine view")
+    p.add_argument("--watch", type=float, default=2.0, help="refresh seconds")
+    p.add_argument("--once", action="store_true", help="print one frame and exit")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("req", help="one request's timeline")
+    p.add_argument("request_id")
+    p.add_argument("--events-dir", default=None, help="crash-flush JSONL dir")
+    p.set_defaults(fn=cmd_req)
+
+    p = sub.add_parser("events", help="tail the cluster flight recorder")
+    p.add_argument("--tail", type=int, default=50)
+    p.add_argument("--type", default=None, help="event-type prefix filter")
+    p.add_argument("--request-id", default=None)
+    p.add_argument("--events-dir", default=None, help="crash-flush JSONL dir")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("timeline", help="export a chrome trace with request lanes")
+    p.add_argument("-o", "--output", default="ray_tpu_trace.json")
+    p.add_argument(
+        "--events-dir", default=None,
+        help="build the trace offline from crash-flush JSONL (no cluster)",
+    )
+    p.set_defaults(fn=cmd_timeline)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -405,7 +405,9 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array, mesh) -> j
             f"tp={mesh.shape.get('tp', 1)}"
         )
     spec = P(("dp", "fsdp"), "tp", None, None)
-    fn = jax.shard_map(
+    from ray_tpu._private.jax_compat import shard_map
+
+    fn = shard_map(
         flash_attention, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

@@ -109,7 +109,9 @@ def pipeline_apply(
         out = jax.lax.psum(out, axis_name)
         return out
 
-    fn = jax.shard_map(
+    from ray_tpu._private.jax_compat import shard_map
+
+    fn = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(param_spec, in_spec),

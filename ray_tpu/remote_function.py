@@ -93,6 +93,15 @@ class RemoteFunction:
             "kwargs": s_kwargs,
             "return_ids": return_ids,
         }
+        # trace-context propagation (util.tracing): a submission under an
+        # active context carries its request_id to the executing worker;
+        # otherwise the task roots a fresh trace at its own id — free
+        # (task ids are already random), so every task tree is traceable
+        from ray_tpu.util import tracing as _tracing
+
+        spec["trace_ctx"] = _tracing.get_trace_context() or {
+            "request_id": task_id.hex()[:16]
+        }
         ns = getattr(ctx, "namespace", "default")
         if ns != "default":
             # tasks inherit the submitter's namespace (reference: job-scoped

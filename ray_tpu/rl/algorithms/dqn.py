@@ -152,9 +152,10 @@ class DQN(Algorithm):
                 metrics = self.learner_group.update(batch)
                 td_abs = metrics.pop("td_abs", None)
                 if cfg.prioritized_replay and "batch_indexes" in batch and td_abs is not None:
-                    self.buffer.update_priorities(
-                        batch["batch_indexes"], np.asarray(td_abs)
-                    )
+                    # td_abs is already host numpy: Learner.update does ONE
+                    # device_get for the whole metrics pytree — re-wrapping
+                    # it per update would be a redundant sync in this loop
+                    self.buffer.update_priorities(batch["batch_indexes"], td_abs)
             # 3) periodic target network sync + weight broadcast
             if self._steps_since_target_sync >= cfg.target_update_freq:
                 self.learner_group.apply(_sync_target)

@@ -121,13 +121,19 @@ def record_experience(
         else:
             act = policy(obs)
         nxt, rew, term, trunc, _ = env.step(act)
-        cols[sb.OBS].append(np.asarray(obs, np.float32))
+        # raw appends only — the float32 conversion happens ONCE on the
+        # whole column below, not per step inside the rollout loop
+        cols[sb.OBS].append(obs)
         cols[sb.ACTIONS].append(act)
-        cols[sb.REWARDS].append(np.float32(rew))
-        cols[sb.NEXT_OBS].append(np.asarray(nxt, np.float32))
+        cols[sb.REWARDS].append(rew)
+        cols[sb.NEXT_OBS].append(nxt)  # envs return fresh arrays per step
         cols[sb.TERMINATEDS].append(bool(term))
         if term or trunc:
             obs, _ = env.reset()
         else:
             obs = nxt
-    return OfflineDataset({k: np.stack(v) if k in (sb.OBS, sb.NEXT_OBS) else np.asarray(v) for k, v in cols.items()})
+    return OfflineDataset({
+        k: np.asarray(v, np.float32) if k in (sb.OBS, sb.NEXT_OBS, sb.REWARDS)
+        else np.asarray(v)
+        for k, v in cols.items()
+    })

@@ -18,6 +18,7 @@ import time
 import uuid
 from typing import Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu._private.log_util import warn_throttled
 from ray_tpu.serve._private.common import (
     AutoscalingConfig,
@@ -374,18 +375,37 @@ class ServeController:
                             try:
                                 ray_tpu.get(r.init_ref, timeout=5.0)
                                 r.initialized = True
+                                _events.record(
+                                    "serve.replica_initialized",
+                                    replica=r.replica_id,
+                                    init_s=round(time.time() - r.started_at, 3),
+                                )
                                 self._bump_version_locked()  # routers may now use it
-                            except Exception:
+                            except Exception as e:
                                 r.healthy = False  # __init__ or first ping failed
+                                _events.record(
+                                    "serve.replica_unhealthy",
+                                    replica=r.replica_id,
+                                    reason=f"init_failed: {e!r}",
+                                )
                         elif (
                             time.time() - r.started_at > REPLICA_INIT_TIMEOUT_S
                         ):
                             r.healthy = False  # wedged at init: replace it
+                            _events.record(
+                                "serve.replica_unhealthy",
+                                replica=r.replica_id, reason="init_timeout",
+                            )
                         continue
                     try:
                         ray_tpu.get(r.actor.check_health.remote(), timeout=5.0)
-                    except Exception:
+                    except Exception as e:
                         r.healthy = False
+                        _events.record(
+                            "serve.replica_unhealthy",
+                            replica=r.replica_id,
+                            reason=f"health_check: {e!r}",
+                        )
                 dead = [r for r in state.replicas if not r.healthy]
                 if dead:
                     state.replicas = [r for r in state.replicas if r.healthy]
@@ -406,6 +426,10 @@ class ServeController:
                     victim = state.replicas.pop()
                     deadline = (
                         time.time() + spec.config.graceful_shutdown_timeout_s
+                    )
+                    _events.record(
+                        "serve.replica_draining", replica=victim.replica_id,
+                        deployment=spec.name,
                     )
                     state.draining.append((victim, deadline))
                     self._bump_version_locked()
@@ -428,6 +452,9 @@ class ServeController:
                 except Exception:
                     done = True  # unreachable: nothing left to drain
             if done:
+                _events.record(
+                    "serve.replica_stopped", replica=victim.replica_id,
+                )
                 try:
                     ray_tpu.kill(victim.actor)
                 except Exception:  # raylint: disable=RL007
@@ -455,6 +482,9 @@ class ServeController:
             spec.init_args,
             spec.init_kwargs,
             spec.config.user_config,
+        )
+        _events.record(
+            "serve.replica_starting", replica=rid, deployment=spec.name,
         )
         state.replicas.append(
             ReplicaInfo(
@@ -506,6 +536,10 @@ class ServeController:
                 return
             delay = cfg.upscale_delay_s if direction > 0 else cfg.downscale_delay_s
             if now - (state._scale_pressure_since or now) >= delay:
+                _events.record(
+                    "serve.autoscale", deployment=state.spec.name,
+                    from_replicas=current, to_replicas=desired,
+                )
                 state.target_replicas = desired
                 state._scale_pressure_since = None
                 state._scale_direction = 0

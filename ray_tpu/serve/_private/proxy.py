@@ -34,12 +34,15 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import h11
 
+from ray_tpu._private import events as _events
 from ray_tpu.serve._private.common import CONTROLLER_NAME
+from ray_tpu.util import tracing as _tracing
 
 _READ_CHUNK = 1 << 16
 _DISPATCH_THREADS = 32  # blocking picks/lookups/fetches — never held per-request
@@ -254,19 +257,24 @@ class ProxyActor:
             self._handles[app] = ent
         return ent
 
-    def _route(self, app: str, payload):
+    def _route(self, app: str, payload, request_id: str):
         """Dispatch pool (ONE hop per request): route lookup + admission/
         pick may block. Returns ("stream", None) for streaming apps, else
         ("unary", un-settled DeploymentResponse) — the slot stays held until
         resolution so admission caps and pow-2 balancing see async requests
-        exactly like blocking callers."""
-        handle, streaming = self._handle_for(app)
-        if streaming:
-            return "stream", None
-        return "unary", handle.remote(payload)
+        exactly like blocking callers. The request's trace context is
+        installed on this dispatch thread so the replica submission (an
+        actor-method hop) carries the request_id downstream."""
+        with _tracing.trace_context(request_id):
+            handle, streaming = self._handle_for(app)
+            if streaming:
+                return "stream", None
+            with _tracing.span("proxy_route", app=app):
+                return "unary", handle.remote(payload)
 
     def _run_stream(self, app: str, payload, loop, q: "asyncio.Queue",
-                    cancel: threading.Event, window: threading.Semaphore):
+                    cancel: threading.Event, window: threading.Semaphore,
+                    request_id: str = ""):
         """Dedicated thread per stream (long-lived by nature — must not
         occupy the dispatch pool): iterates the streaming generator with a
         bounded chunk window and stops (disposing the remote stream) when
@@ -277,6 +285,11 @@ class ProxyActor:
 
         gen = None
         try:
+            # trace context on the stream thread: the streaming replica hop
+            # inherits the proxy-minted request_id
+            _tracing.set_trace_context(
+                {"request_id": request_id} if request_id else None
+            )
             handle, _ = self._handle_for(app)
             gen = handle.options(stream=True).remote(payload)
             for item in gen:
@@ -340,17 +353,22 @@ class ProxyActor:
             writer.write(data)
             await writer.drain()
 
-    async def _respond(self, writer, conn, code: int, body, ctype=None):
+    async def _respond(self, writer, conn, code: int, body, ctype=None,
+                       request_id: str = ""):
         data, default_ctype = _encode_body(body)
         headers = [
             ("content-type", ctype or default_ctype),
             ("content-length", str(len(data))),
         ]
+        if request_id:
+            # clients correlate their response with `obs req <id>` by this
+            headers.append(("x-request-id", request_id))
         await self._send(writer, conn, h11.Response(status_code=code, headers=headers))
         await self._send(writer, conn, h11.Data(data=data))
         await self._send(writer, conn, h11.EndOfMessage())
 
-    async def _respond_stream(self, writer, conn, app: str, payload, loop):
+    async def _respond_stream(self, writer, conn, app: str, payload, loop,
+                              request_id: str = ""):
         """Chunked transfer: h11 frames chunks automatically when no
         content-length is declared. Errors after the header cannot become a
         second response — truncate the stream (close) like the reference."""
@@ -359,7 +377,7 @@ class ProxyActor:
         window = threading.Semaphore(_STREAM_WINDOW)
         threading.Thread(
             target=self._run_stream,
-            args=(app, payload, loop, q, cancel, window),
+            args=(app, payload, loop, q, cancel, window, request_id),
             name="proxy-stream",
             daemon=True,
         ).start()
@@ -368,18 +386,23 @@ class ProxyActor:
             window.release()
             if first_kind == "error":
                 code = 404 if isinstance(first_val, KeyError) else 500
-                await self._respond(writer, conn, code, {"error": repr(first_val)})
-                return
+                _events.record(
+                    "proxy.response", request_id=request_id, status=code,
+                    error=repr(first_val), streaming=True,
+                )
+                await self._respond(
+                    writer, conn, code, {"error": repr(first_val)},
+                    request_id=request_id,
+                )
+                return False
+            headers = [
+                ("content-type", "application/octet-stream"),
+                ("transfer-encoding", "chunked"),
+            ]
+            if request_id:
+                headers.append(("x-request-id", request_id))
             await self._send(
-                writer,
-                conn,
-                h11.Response(
-                    status_code=200,
-                    headers=[
-                        ("content-type", "application/octet-stream"),
-                        ("transfer-encoding", "chunked"),
-                    ],
-                ),
+                writer, conn, h11.Response(status_code=200, headers=headers)
             )
             kind, val = first_kind, first_val
             while True:
@@ -387,14 +410,18 @@ class ProxyActor:
                     await self._send(writer, conn, h11.Data(data=val))
                 elif kind == "end":
                     await self._send(writer, conn, h11.EndOfMessage())
-                    return
+                    return True
                 else:  # mid-stream error: truncate
                     import traceback
 
+                    _events.record(
+                        "proxy.stream_error", request_id=request_id,
+                        error=repr(val),
+                    )
                     print("[serve-proxy] streaming response failed:", flush=True)
                     traceback.print_exception(val)
                     writer.close()
-                    return
+                    return False
                 kind, val = await q.get()
                 window.release()
         finally:
@@ -420,13 +447,31 @@ class ProxyActor:
                 target = request.target.decode()
                 headers = {k.decode().lower(): v.decode() for k, v in request.headers}
                 app = target.strip("/").split("/")[0] or "default"
+                # trace root: honor a caller-supplied x-request-id (gateway
+                # chains) or mint one; it rides the task specs downstream
+                # and echoes back in the response header
+                rid = headers.get("x-request-id") or _tracing.new_request_id()
+                t_req = time.time()
+                _events.record(
+                    "proxy.request", request_id=rid, app=app,
+                    method=request.method.decode(), bytes_in=len(body),
+                )
                 try:
                     payload = _parse_payload(body, headers.get("content-type", ""))
                     kind, resp = await loop.run_in_executor(
-                        self._dispatch_pool, self._route, app, payload
+                        self._dispatch_pool, self._route, app, payload, rid
                     )
                     if kind == "stream":
-                        await self._respond_stream(writer, conn, app, payload, loop)
+                        ok = await self._respond_stream(
+                            writer, conn, app, payload, loop, request_id=rid
+                        )
+                        if ok:
+                            # failures already recorded proxy.response /
+                            # proxy.stream_error inside _respond_stream
+                            _events.record(
+                                "proxy.stream_done", request_id=rid,
+                                dur_s=round(time.time() - t_req, 6),
+                            )
                     else:
                         res = self._resolver.register(resp, loop)
                         try:
@@ -436,14 +481,27 @@ class ProxyActor:
                         except (asyncio.TimeoutError, asyncio.CancelledError):
                             self._resolver.discard(res)  # free slot + tracking
                             raise
-                        await self._respond(writer, conn, 200, result)
+                        _events.record(
+                            "proxy.response", request_id=rid, status=200,
+                            dur_s=round(time.time() - t_req, 6),
+                        )
+                        await self._respond(writer, conn, 200, result, request_id=rid)
                 except KeyError as e:
-                    await self._respond(writer, conn, 404, {"error": str(e)})
+                    _events.record("proxy.response", request_id=rid, status=404)
+                    await self._respond(
+                        writer, conn, 404, {"error": str(e)}, request_id=rid
+                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001
+                    _events.record(
+                        "proxy.response", request_id=rid, status=500,
+                        error=repr(e),
+                    )
                     try:
-                        await self._respond(writer, conn, 500, {"error": repr(e)})
+                        await self._respond(
+                            writer, conn, 500, {"error": repr(e)}, request_id=rid
+                        )
                     except h11.LocalProtocolError:
                         return  # headers already sent (stream): just close
                 # keep-alive

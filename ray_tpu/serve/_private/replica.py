@@ -34,18 +34,31 @@ class Replica:
     # -- request path ------------------------------------------------------
 
     def handle_request(self, method: str, args: tuple, kwargs: dict, model_id=None) -> Any:
+        from ray_tpu._private import events as _events
         from ray_tpu.serve.multiplex import _set_request_model_id
+        from ray_tpu.util import tracing as _tracing
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
         _set_request_model_id(model_id)
+        # worker_main installed the proxy/submitter trace context on this
+        # thread; the span + events below correlate under that request_id
+        rid = _tracing.current_request_id()
+        _events.record(
+            "replica.request", request_id=rid,
+            replica=self.replica_id, method=method,
+        )
         try:
-            target = self._callable if method == "__call__" else getattr(self._callable, method)
-            if method == "__call__" and not callable(target):
-                raise TypeError(f"Deployment {type(self._callable).__name__} is not callable")
-            return target(*args, **kwargs)
+            with _tracing.span("replica_handle", replica=self.replica_id, method=method):
+                target = self._callable if method == "__call__" else getattr(self._callable, method)
+                if method == "__call__" and not callable(target):
+                    raise TypeError(f"Deployment {type(self._callable).__name__} is not callable")
+                return target(*args, **kwargs)
         finally:
+            _events.record(
+                "replica.done", request_id=rid, replica=self.replica_id
+            )
             _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1
@@ -56,12 +69,19 @@ class Replica:
         (reference: serve streaming responses over generator returns).
         Ongoing-count spans the WHOLE stream (admission control sees a
         streaming request as occupying its slot until exhausted)."""
+        from ray_tpu._private import events as _events
         from ray_tpu.serve.multiplex import _set_request_model_id
+        from ray_tpu.util import tracing as _tracing
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
         _set_request_model_id(model_id)
+        rid = _tracing.current_request_id()
+        _events.record(
+            "replica.request", request_id=rid,
+            replica=self.replica_id, method=method, streaming=True,
+        )
         try:
             target = self._callable if method == "__call__" else getattr(self._callable, method)
             out = target(*args, **kwargs)
@@ -84,6 +104,10 @@ class Replica:
             else:
                 yield from out
         finally:
+            _events.record(
+                "replica.done", request_id=rid,
+                replica=self.replica_id, streaming=True,
+            )
             _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1

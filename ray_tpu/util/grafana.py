@@ -40,6 +40,38 @@ _CORE_PANELS = [
      "Bytes currently spilled to disk."),
 ]
 
+# LLM serving row (engine metrics export from replica processes, so the
+# registry-driven loop below can't discover them from the dashboard
+# process — they get a static row instead; names: llm/engine.py).
+_LLM_PANELS = [
+    ("LLM tokens/s", "rate(ray_tpu_llm_generated_tokens[1m])", "short",
+     "Engine-wide generation throughput."),
+    # running and waiting are separate panels: both series are untagged,
+    # so a PromQL `a or b` would drop `b` whenever `a` exists
+    ("LLM running requests", "ray_tpu_llm_running_requests", "short",
+     "Requests currently holding decode slots."),
+    ("LLM waiting requests", "ray_tpu_llm_waiting_requests", "short",
+     "Requests queued for admission (upscale pressure)."),
+    ("KV block utilization", "ray_tpu_llm_kv_block_utilization", "percentunit",
+     "Fraction of paged-KV blocks in use (preemption pressure above the threshold)."),
+    ("TTFT p99",
+     'histogram_quantile(0.99, rate(ray_tpu_llm_time_to_first_token_s_bucket[5m]))',
+     "s", "Time to first token (SLO latency; obs top shows the live snapshot)."),
+    ("Inter-token latency p99",
+     'histogram_quantile(0.99, rate(ray_tpu_llm_inter_token_latency_s_bucket[5m]))',
+     "s", "Gap between consecutive streamed tokens."),
+    ("Speculative acceptance rate", "ray_tpu_llm_spec_acceptance_rate", "percentunit",
+     "Accepted/proposed draft tokens of the last verify window."),
+]
+
+# names the static LLM row already covers — the dynamic user-metric loop
+# skips them to avoid duplicate panels when the engine runs in-process
+_LLM_NAMES = {
+    "llm_generated_tokens", "llm_running_requests", "llm_waiting_requests",
+    "llm_kv_block_utilization", "llm_time_to_first_token_s",
+    "llm_inter_token_latency_s", "llm_spec_acceptance_rate",
+}
+
 
 def _panel(panel_id: int, title: str, expr: str, unit: str, desc: str, y: int) -> dict:
     return {
@@ -86,12 +118,14 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     panels = []
     y = 0
     pid = 0
-    for title, expr, unit, desc in _CORE_PANELS:
+    for title, expr, unit, desc in _CORE_PANELS + _LLM_PANELS:
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:
             y += 8
     for name in names:
+        if name in _LLM_NAMES:
+            continue
         if kinds.get(name) == "histogram":
             # the exporter emits _bucket/_sum/_count for histograms, never
             # the bare name — a bare-name panel would be permanently empty

@@ -139,6 +139,83 @@ class Histogram(Metric):
 
     record = observe  # reference alias
 
+    def percentiles(
+        self, qs: Sequence[float] = (0.5, 0.95, 0.99), tags: Optional[dict] = None
+    ) -> dict:
+        """This PROCESS's distribution snapshot: ``{"p50": ..., "p95": ...,
+        "count": n, "sum": s}`` (bucket interpolation —
+        :func:`percentiles_from_buckets`). Cluster-wide: ``histogram_percentiles``."""
+        key = self._tags(tags)
+        with self._lock:
+            cur = self._data.get(key)
+            data = list(cur) if isinstance(cur, list) else None
+        return _percentile_summary(self.boundaries, data, qs)
+
+
+def percentiles_from_buckets(
+    boundaries: Sequence[float], counts: Sequence[float], q: float
+) -> float:
+    """Quantile estimate from histogram buckets, Prometheus
+    ``histogram_quantile`` style: linear interpolation inside the target
+    bucket; the overflow (+Inf) bucket clamps to the highest boundary (no
+    upper bound to interpolate toward). ``counts`` is the per-bucket
+    (non-cumulative) layout ``observe()`` maintains — one slot per
+    boundary plus overflow."""
+    total = sum(counts)
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(boundaries):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            frac = (rank - prev) / max(counts[i], 1e-12)
+            return lo + (b - lo) * min(max(frac, 0.0), 1.0)
+        lo = b
+    return float(boundaries[-1]) if boundaries else float("nan")
+
+
+def _percentile_summary(
+    boundaries: Sequence[float], data: Optional[list], qs: Sequence[float]
+) -> dict:
+    if not data:
+        out = {f"p{round(q * 100) if q < 1 else 100}": float("nan") for q in qs}
+        out.update(count=0, sum=0.0)
+        return out
+    buckets, total, s = data[:-2], data[-1], data[-2]
+    out = {
+        f"p{round(q * 100) if q < 1 else 100}": percentiles_from_buckets(
+            boundaries, buckets, q
+        )
+        for q in qs
+    }
+    out.update(count=int(total), sum=float(s))
+    return out
+
+
+def histogram_percentiles(
+    name: Optional[str] = None, qs: Sequence[float] = (0.5, 0.95, 0.99)
+) -> dict:
+    """CLUSTER-wide percentile snapshots from ``collect()``'s merged
+    buckets: ``{metric_name: {tagset: {"p50": ..., "count": ...}}}``
+    (optionally one metric). What ``obs top`` renders for TTFT/ITL."""
+    data = collect()
+    out: dict[str, dict] = {}
+    for mname, series in data.get("metrics", {}).items():
+        if data.get("kinds", {}).get(mname) != "histogram":
+            continue
+        if name is not None and mname != name:
+            continue
+        bounds = tuple(data.get("boundaries", {}).get(mname, ()))
+        out[mname] = {
+            tagset: _percentile_summary(bounds, val, qs)
+            for tagset, val in series.items()
+            if isinstance(val, list)
+        }
+    return out
+
 
 # ---------------------------------------------------------------------------
 # publication + collection
@@ -210,10 +287,13 @@ def collect() -> dict:
     merged: dict[str, dict] = {}
     kinds: dict[str, str] = {}
     boundaries: dict[str, list] = {}
+    helps: dict[str, str] = {}
     for snap in snapshots:
         for m in snap["metrics"]:
             name, kind = m["name"], m["kind"]
             kinds[name] = kind
+            if m.get("description"):
+                helps[name] = m["description"]
             if "boundaries" in m:
                 boundaries[name] = m["boundaries"]
             out = merged.setdefault(name, {})
@@ -227,17 +307,40 @@ def collect() -> dict:
                     out[tagset] = (
                         [a + b for a, b in zip(prev, val)] if prev else list(val)
                     )
-    return {"kinds": kinds, "metrics": merged, "boundaries": boundaries}
+    return {
+        "kinds": kinds, "metrics": merged, "boundaries": boundaries,
+        "help": helps,
+    }
+
+
+def _escape_label(v) -> str:
+    # exposition format: backslash, double-quote and newline are escaped
+    # inside label values
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_num(v) -> str:
+    # canonical sample values: integers bare, floats via repr (shortest
+    # round-trippable form — Prometheus parses either)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
 
 
 def prometheus_text() -> str:
-    """Render collect() in the Prometheus exposition format (histograms as
-    cumulative ``_bucket{le=...}`` series + ``_sum``/``_count``)."""
+    """Render collect() in the Prometheus exposition format: ``# HELP`` /
+    ``# TYPE`` per family, escaped label values, and histograms as
+    CUMULATIVE ``_bucket{le="..."}`` series (ending at ``le="+Inf"`` ==
+    ``_count``) plus ``_sum``/``_count`` — parseable by any exposition
+    parser (tests re-parse the output to prove it)."""
     data = collect()
     lines = []
     for name, series in data.get("metrics", {}).items():
         kind = data["kinds"].get(name, "counter")
         prom_kind = {"gauge": "gauge", "histogram": "histogram"}.get(kind, "counter")
+        help_text = data.get("help", {}).get(name, "")
+        if help_text:
+            esc = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP ray_tpu_{name} {esc}")
         lines.append(f"# TYPE ray_tpu_{name} {prom_kind}")
         bounds = data.get("boundaries", {}).get(name, [])
         for tagset, val in series.items():
@@ -249,18 +352,29 @@ def prometheus_text() -> str:
                     merged_tags.update(extra)
                 if not merged_tags:
                     return ""
-                return "{" + ",".join(f'{k}="{v}"' for k, v in merged_tags.items()) + "}"
+                return (
+                    "{"
+                    + ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in merged_tags.items()
+                    )
+                    + "}"
+                )
 
             if isinstance(val, list):
                 cum = 0
                 for b, count in zip(bounds, val):
                     cum += count
-                    lines.append(f'ray_tpu_{name}_bucket{fmt({"le": b})} {cum}')
-                lines.append(f'ray_tpu_{name}_bucket{fmt({"le": "+Inf"})} {val[-1]}')
-                lines.append(f"ray_tpu_{name}_sum{fmt()} {val[-2]}")
-                lines.append(f"ray_tpu_{name}_count{fmt()} {val[-1]}")
+                    lines.append(
+                        f'ray_tpu_{name}_bucket{fmt({"le": _fmt_num(b)})} '
+                        f"{_fmt_num(cum)}"
+                    )
+                lines.append(
+                    f'ray_tpu_{name}_bucket{fmt({"le": "+Inf"})} {_fmt_num(val[-1])}'
+                )
+                lines.append(f"ray_tpu_{name}_sum{fmt()} {_fmt_num(val[-2])}")
+                lines.append(f"ray_tpu_{name}_count{fmt()} {_fmt_num(val[-1])}")
             else:
-                lines.append(f"ray_tpu_{name}{fmt()} {val}")
+                lines.append(f"ray_tpu_{name}{fmt()} {_fmt_num(val)}")
     return "\n".join(lines) + "\n"
 
 

@@ -158,6 +158,12 @@ def timeline(path: Optional[str] = None) -> list[dict]:
             open_ts[tid] = ev
         elif ev["state"] in ("FINISHED", "FAILED") and tid in open_ts:
             start = open_ts.pop(tid)
+            args = {"state": ev["state"], "task_id": tid}
+            rid = ev.get("request_id") or start.get("request_id")
+            if rid:
+                # one lane per request: tracing.export_chrome_trace mirrors
+                # entries carrying a request_id into the "requests" group
+                args["request_id"] = rid
             trace.append(
                 {
                     "name": ev.get("name") or tid[:8],
@@ -167,7 +173,7 @@ def timeline(path: Optional[str] = None) -> list[dict]:
                     "dur": max(0.0, (ev["time"] - start["time"]) * 1e6),
                     "pid": "ray_tpu",
                     "tid": tid[:8],
-                    "args": {"state": ev["state"], "task_id": tid},
+                    "args": args,
                 }
             )
     if path is not None:

@@ -13,8 +13,19 @@ timeline, nested per process/actor.
     with tracing.span("preprocess", batch=i):
         ...
 
-``tracing.export_chrome_trace(path)`` merges runtime task events and user
-spans into one chrome://tracing-loadable JSON file.
+``tracing.export_chrome_trace(path)`` merges runtime task events, user
+spans, and flight-recorder request events into one chrome://tracing-loadable
+JSON file — with one lane per request for everything that carries a
+``request_id``.
+
+**Trace context.** A request_id is minted at the serve proxy (or by
+``trace_context()`` in application code, or implicitly at ``remote()``
+submission) and carried as a per-thread context: ``remote()`` /
+actor-method submissions stamp it into the task spec, the executing worker
+re-installs it around the task body, and every ``span``/flight-recorder
+event recorded underneath is tagged with it.  One request's life across
+proxy → router → replica → engine is thereby a single correlated trace
+(``python -m ray_tpu.obs req <id>``).
 """
 
 from __future__ import annotations
@@ -24,6 +35,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any, Iterator, Optional
 
 _local = threading.local()
@@ -35,10 +47,54 @@ def _now_us() -> float:
     return time.time() * 1e6
 
 
+# ---------------------------------------------------------------------------
+# trace context (request_id propagation)
+# ---------------------------------------------------------------------------
+
+
+def new_request_id() -> str:
+    """Mint a fresh request id (16 hex chars — short enough to grep, wide
+    enough to never collide within a cluster's lifetime)."""
+    return uuid.uuid4().hex[:16]
+
+
+def get_trace_context() -> Optional[dict]:
+    """The calling thread's active trace context ({"request_id": ...}) or
+    None. Shipped in task specs by remote()/actor submissions."""
+    return getattr(_local, "trace_ctx", None)
+
+
+def set_trace_context(ctx: Optional[dict]) -> Optional[dict]:
+    """Install (or clear, with None) the thread's trace context; returns
+    the previous one so callers can restore it."""
+    prev = getattr(_local, "trace_ctx", None)
+    _local.trace_ctx = ctx
+    return prev
+
+
+def current_request_id() -> Optional[str]:
+    ctx = getattr(_local, "trace_ctx", None)
+    return ctx.get("request_id") if ctx else None
+
+
+@contextlib.contextmanager
+def trace_context(request_id: Optional[str] = None) -> Iterator[str]:
+    """Scope a request id onto this thread (minting one if not given);
+    spans, flight-recorder events, and remote() hops underneath carry it."""
+    rid = request_id or new_request_id()
+    prev = set_trace_context({"request_id": rid})
+    try:
+        yield rid
+    finally:
+        set_trace_context(prev)
+
+
 @contextlib.contextmanager
 def span(name: str, **attributes: Any) -> Iterator[None]:
     """Record a named region. Nesting tracks a per-thread stack so child
-    spans indent under their parent in the trace viewer."""
+    spans indent under their parent in the trace viewer. An active trace
+    context tags the span with its request_id (one lane per request in
+    the exported trace)."""
     depth = getattr(_local, "depth", 0)
     _local.depth = depth + 1
     t0 = _now_us()
@@ -55,8 +111,12 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
             "pid": f"proc-{os.getpid()}",
             "tid": f"thread-{threading.get_ident() & 0xFFFF}-d{depth}",
         }
-        if attributes:
-            rec["args"] = {k: _jsonable(v) for k, v in attributes.items()}
+        rid = current_request_id()
+        if attributes or rid:
+            args = {k: _jsonable(v) for k, v in attributes.items()}
+            if rid:
+                args.setdefault("request_id", rid)
+            rec["args"] = args
         with _lock:
             _spans.append(rec)
 
@@ -103,12 +163,71 @@ def collect_cluster_spans() -> list[dict]:
     return out
 
 
+def request_lanes(
+    spans: list[dict], recorder_events: list[dict]
+) -> list[dict]:
+    """Chrome-trace entries giving each request its own lane: spans whose
+    args carry a request_id are mirrored into pid="requests"/tid=<id>, and
+    flight-recorder events with a request_id become instant markers on the
+    same lane — proxy→replica→engine spans plus per-token events line up
+    under one request.
+
+    Single-entry ids are NOT mirrored: every rootless ``remote()``
+    submission auto-mints a request_id, so a plain 50k-task batch job
+    would otherwise double its trace into 50k one-slice lanes.  A lane
+    only earns its row when the id correlates at least two records —
+    which every served/multi-hop request does."""
+    counts: dict[str, int] = {}
+    for s in spans:
+        rid = (s.get("args") or {}).get("request_id")
+        if rid:
+            counts[rid] = counts.get(rid, 0) + 1
+    for ev in recorder_events:
+        rid = ev.get("request_id")
+        if rid:
+            counts[rid] = counts.get(rid, 0) + 1
+    lanes: list[dict] = []
+    for s in spans:
+        rid = (s.get("args") or {}).get("request_id")
+        if not rid or counts[rid] < 2:
+            continue
+        lanes.append({**s, "pid": "requests", "tid": f"req:{rid}"})
+    for ev in recorder_events:
+        rid = ev.get("request_id")
+        if not rid or counts[rid] < 2:
+            continue
+        args = {
+            k: v
+            for k, v in ev.items()
+            if k not in ("ts", "type", "seq", "request_id")
+        }
+        lanes.append(
+            {
+                "name": ev.get("type", "event"),
+                "cat": "request",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant marker
+                "ts": ev.get("ts", 0.0) * 1e6,
+                "pid": "requests",
+                "tid": f"req:{rid}",
+                "args": args,
+            }
+        )
+    return lanes
+
+
 def export_chrome_trace(path: Optional[str] = None) -> list[dict]:
-    """Runtime task events + user spans as one Chrome trace
-    (reference: ``ray timeline``, ``_private/state.py:924``)."""
+    """Runtime task events + user spans + per-request lanes as one Chrome
+    trace (reference: ``ray timeline``, ``_private/state.py:924``). Every
+    span/flight-recorder event carrying a request_id additionally lands in
+    a ``requests``-group lane keyed by its id, so one request's whole life
+    reads as a single row in chrome://tracing / Perfetto."""
+    from ray_tpu._private import events as ev
     from ray_tpu.util import state as st
 
-    events = st.timeline() + collect_cluster_spans()
+    spans = st.timeline() + collect_cluster_spans()
+    recorder = ev.collect_cluster_events()
+    events = spans + request_lanes(spans, recorder)
     if path:
         with open(path, "w") as f:
             json.dump(events, f)

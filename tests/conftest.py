@@ -67,3 +67,30 @@ def ray_start_cluster():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running learning tests")
+
+
+def pytest_runtest_logreport(report):
+    """Failures land in the flight recorder too, so the flushed ring
+    interleaves 'which test failed' with the runtime events around it."""
+    if report.failed and report.when == "call":
+        try:
+            from ray_tpu._private import events
+
+            events.record("ci.test_failed", test=report.nodeid)
+        except Exception:
+            pass
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """On a failing run, flush THIS process's flight-recorder ring to
+    ``RAY_TPU_EVENTS_DIR`` so CI can upload it as a postmortem artifact
+    next to the worker rings (those crash-flush themselves on the SIGTERM
+    that kills them — _private/events.py).  A green run writes nothing."""
+    if exitstatus == 0:
+        return
+    try:
+        from ray_tpu._private import events
+
+        events.flush(reason=f"pytest-exit-{exitstatus}")
+    except Exception:
+        pass  # never let observability turn a test failure into an error

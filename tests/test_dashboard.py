@@ -136,7 +136,9 @@ def test_grafana_dashboard_json(dash):
     assert "Tasks by state" in titles and "Alive nodes" in titles
     for p in board["panels"]:
         assert p["type"] == "timeseries"
-        assert p["targets"][0]["expr"].startswith("ray_tpu_")
+        # exprs may wrap the series in PromQL functions (rate(),
+        # histogram_quantile() — the LLM row), but always target our ns
+        assert "ray_tpu_" in p["targets"][0]["expr"]
         assert "gridPos" in p and "id" in p
 
     # CLI writer round-trips
@@ -160,3 +162,35 @@ def test_logs_endpoint_shape(dash):
     _, body = _get(dash, "/api/logs?job_id=nope")
     data = json.loads(body)
     assert "logs" in data and data["job_id"] == "nope"
+
+
+def test_observability_endpoints(dash):
+    """PR 4 surfaces: /api/percentiles, /api/events (+ filters),
+    /api/request — the HTTP face of obs top / obs events / obs req."""
+    from ray_tpu._private import events
+    from ray_tpu.util import metrics as um
+    from ray_tpu.util.metrics import Histogram
+
+    h = Histogram("dash_lat_s", "latency", boundaries=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    um.flush()
+    _, body = _get(dash, "/api/percentiles")
+    pcts = json.loads(body)
+    snap = next(iter(pcts["dash_lat_s"].values()))
+    assert snap["count"] == 3 and snap["p50"] > 0
+
+    events.record("dash.test_event", request_id="dash-rid-1", n=7)
+    events.record("dash.other")
+    _, body = _get(dash, "/api/events?tail=50")
+    evs = json.loads(body)
+    assert any(e["type"] == "dash.test_event" for e in evs)
+    _, body = _get(dash, "/api/events?request_id=dash-rid-1")
+    only = json.loads(body)
+    assert only and all(e.get("request_id") == "dash-rid-1" for e in only)
+
+    _, body = _get(dash, "/api/request?id=dash-rid-1")
+    req = json.loads(body)
+    assert any(e["type"] == "dash.test_event" and e["n"] == 7 for e in req)
+    _, body = _get(dash, "/api/request")
+    assert "error" in json.loads(body)

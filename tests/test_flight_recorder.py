@@ -1,0 +1,517 @@
+"""Flight recorder + end-to-end request tracing (OBSERVABILITY.md).
+
+Coverage demanded by the PR's acceptance criteria:
+
+* trace-context propagation: a ``remote()`` task hop, a nested task hop,
+  and an actor-method hop all execute under the submitter's request_id
+  (child spans + head task events carry it);
+* the recorder ring: bounded wraparound, disable toggle, flush/reload,
+  and crash-flush when a worker is SIGTERM'd mid-stream;
+* ``prometheus_text()`` re-parses as valid exposition format (cumulative
+  histogram buckets, ``le`` labels, ``_sum``/``_count`` consistency);
+* bucket-interpolated percentile snapshots (`Histogram.percentiles`,
+  `histogram_percentiles`);
+* ``obs req`` renders one correlated timeline — proxy → replica →
+  engine events under a single request_id, TTFT + per-window accepted
+  counts included — from a REAL request served over HTTP through
+  ``serve/llm.py`` with ``spec_k > 0``.
+"""
+
+import json
+import math
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import events
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    histogram_percentiles,
+    percentiles_from_buckets,
+    prometheus_text,
+)
+
+
+@pytest.fixture
+def fresh_ring():
+    """Isolate each test's view of the process-global ring."""
+    st = events.stats()
+    events.clear()
+    events.set_enabled(True)
+    yield
+    events.configure(capacity=st["capacity"])
+    events.set_enabled(st["enabled"])
+    events.clear()
+
+
+# ---------------------------------------------------------------------------
+# ring buffer
+# ---------------------------------------------------------------------------
+
+
+class TestRing:
+    def test_record_and_snapshot(self, fresh_ring):
+        events.record("a.b", request_id="r1", x=1)
+        events.record("a.c")
+        evs = events.snapshot()
+        assert [e["type"] for e in evs] == ["a.b", "a.c"]
+        assert evs[0]["request_id"] == "r1" and evs[0]["x"] == 1
+        assert "request_id" not in evs[1]
+        assert evs[0]["seq"] < evs[1]["seq"]
+        assert events.snapshot(request_id="r1") == [evs[0]]
+
+    def test_wraparound_bounds_memory(self, fresh_ring):
+        events.configure(capacity=64)
+        for i in range(200):
+            events.record("w", i=i)
+        st = events.stats()
+        assert st["size"] == 64 and st["capacity"] == 64
+        assert st["dropped"] == 200 - 64
+        evs = events.snapshot()
+        # the ring keeps the NEWEST 64, oldest first
+        assert [e["i"] for e in evs] == list(range(136, 200))
+
+    def test_disable_toggle(self, fresh_ring):
+        events.set_enabled(False)
+        events.record("nope")
+        assert events.snapshot() == []
+        events.set_enabled(True)
+        events.record("yep")
+        assert [e["type"] for e in events.snapshot()] == ["yep"]
+
+    def test_flush_roundtrip(self, fresh_ring, tmp_path):
+        events.record("f.one", request_id="rid9", k="v")
+        events.record("f.two")
+        path = str(tmp_path / "ring.jsonl")
+        assert events.flush(path, reason="test") == path
+        lines = [json.loads(x) for x in open(path)]
+        assert lines[0]["_flight_recorder"] == 1
+        assert lines[0]["reason"] == "test" and lines[0]["size"] == 2
+        assert [x["type"] for x in lines[1:]] == ["f.one", "f.two"]
+        assert lines[1]["request_id"] == "rid9"
+
+    def test_flush_empty_ring_writes_nothing(self, fresh_ring, tmp_path):
+        assert events.flush(str(tmp_path / "empty.jsonl")) is None
+        assert not (tmp_path / "empty.jsonl").exists()
+
+    def test_recorder_overhead_smoke(self, fresh_ring):
+        """The hot path is one lock + tuple append: 50k events must land
+        in well under a second even on a loaded CI box (the end-to-end
+        ≤5% tokens/s bound is measured by ``llm.bench --smoke`` A/B)."""
+        events.configure(capacity=1024)
+        t0 = time.perf_counter()
+        for i in range(50_000):
+            events.record("hot", request_id="r", step=i)
+        dt = time.perf_counter() - t0
+        assert events.stats()["size"] == 1024
+        assert dt < 5.0, f"50k record() took {dt:.2f}s"
+
+
+def test_crash_flush_on_sigterm_subprocess(tmp_path):
+    """A process armed with install_crash_handlers dumps its ring as
+    JSONL when SIGTERM kills it (how proc_handles shoots workers)."""
+    code = (
+        "import os, signal\n"
+        "from ray_tpu._private import events\n"
+        "events.install_crash_handlers()\n"
+        "events.record('boot', request_id='rz', n=1)\n"
+        "events.record('work', request_id='rz', n=2)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+    )
+    env = dict(os.environ, RAY_TPU_EVENTS_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, timeout=60,
+        capture_output=True, cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    assert proc.returncode != 0  # died by signal, not a clean exit
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert len(files) == 1, (files, proc.stderr.decode()[-500:])
+    lines = [json.loads(x) for x in open(tmp_path / files[0])]
+    assert lines[0]["reason"] == "sigterm"
+    types = [x["type"] for x in lines[1:]]
+    assert types == ["boot", "work", "crash.sigterm"]
+
+
+def test_worker_killed_mid_stream_leaves_crash_flush(tmp_path, monkeypatch):
+    """The acceptance scenario: a worker streaming tokens is SIGTERM'd
+    mid-stream; its flight-recorder ring must survive on disk, and the
+    offline trace renderer must read it back with the request lane."""
+    monkeypatch.setenv("RAY_TPU_EVENTS_DIR", str(tmp_path))
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+
+        @ray_tpu.remote(num_returns="streaming")
+        def stream():
+            from ray_tpu._private import events as ev
+            from ray_tpu.util import tracing as tr
+
+            ev.record("stream.begin", request_id=tr.current_request_id(),
+                      pid_hint=os.getpid())
+            yield os.getpid()
+            for i in range(1000):
+                ev.record("stream.tick", request_id=tr.current_request_id(), i=i)
+                time.sleep(0.05)
+                yield i
+
+        with tracing.trace_context() as rid:
+            g = stream.remote()
+        it = iter(g)
+        victim = ray_tpu.get(next(it), timeout=30)
+        ray_tpu.get(next(it), timeout=30)  # producer is inside the loop
+        os.kill(victim, signal.SIGTERM)
+
+        deadline = time.time() + 30
+        flushed = None
+        while time.time() < deadline and flushed is None:
+            for f in os.listdir(tmp_path):
+                if f == f"events-{victim}.jsonl":
+                    flushed = tmp_path / f
+            time.sleep(0.2)
+        assert flushed is not None, os.listdir(tmp_path)
+        lines = [json.loads(x) for x in open(flushed)]
+        assert lines[0]["reason"] == "sigterm"
+        types = {x["type"] for x in lines[1:]}
+        assert "stream.begin" in types and "stream.tick" in types
+        # the stream's events carry the submitter's request_id
+        assert any(x.get("request_id") == rid for x in lines[1:])
+
+        # postmortem rendering with NO cluster involvement
+        from ray_tpu.obs import offline_trace
+
+        out = str(tmp_path / "trace.json")
+        entries = offline_trace(str(tmp_path), out)
+        lanes = {e["tid"] for e in entries if e.get("pid") == "requests"}
+        assert f"req:{rid}" in lanes
+        assert json.load(open(out))  # valid chrome-trace JSON
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_thread_scoping_and_restore(self):
+        assert tracing.current_request_id() is None
+        with tracing.trace_context("outer123") as rid:
+            assert rid == "outer123" == tracing.current_request_id()
+            with tracing.trace_context() as inner:
+                assert inner != "outer123"
+                assert tracing.current_request_id() == inner
+            assert tracing.current_request_id() == "outer123"
+        assert tracing.current_request_id() is None
+
+    def test_remote_task_hop(self, ray_start_regular):
+        @ray_tpu.remote
+        def child():
+            from ray_tpu.util import tracing as tr
+
+            return tr.current_request_id()
+
+        with tracing.trace_context() as rid:
+            ref = child.remote()
+        assert ray_tpu.get(ref, timeout=30) == rid
+
+    def test_nested_task_hop(self, ray_start_regular):
+        @ray_tpu.remote
+        def leaf():
+            from ray_tpu.util import tracing as tr
+
+            return tr.current_request_id()
+
+        @ray_tpu.remote
+        def mid():
+            return ray_tpu.get(leaf.remote(), timeout=30)
+
+        with tracing.trace_context() as rid:
+            ref = mid.remote()
+        assert ray_tpu.get(ref, timeout=30) == rid
+
+    def test_actor_method_hop(self, ray_start_regular):
+        @ray_tpu.remote
+        class A:
+            def whoami(self):
+                from ray_tpu.util import tracing as tr
+
+                return tr.current_request_id()
+
+        a = A.remote()
+        with tracing.trace_context() as rid:
+            got = ray_tpu.get(a.whoami.remote(), timeout=30)
+        assert got == rid
+        # a call with NO active context still roots a trace (task-id id)
+        rootless = ray_tpu.get(a.whoami.remote(), timeout=30)
+        assert rootless and rootless != rid
+
+    def test_child_span_carries_request_id(self, ray_start_regular):
+        @ray_tpu.remote
+        def spanner():
+            from ray_tpu.util import tracing as tr
+
+            with tr.span("child_work", part=1):
+                return tr.current_request_id()
+
+        with tracing.trace_context() as rid:
+            ray_tpu.get(spanner.remote(), timeout=30)
+        spans = [
+            s for s in tracing.collect_cluster_spans()
+            if s["name"] == "child_work"
+            and (s.get("args") or {}).get("request_id") == rid
+        ]
+        assert spans, "remote span did not inherit the submitter's request_id"
+
+    def test_head_task_events_carry_request_id(self, ray_start_regular):
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        def noop():
+            return 1
+
+        with tracing.trace_context() as rid:
+            ray_tpu.get(noop.remote(), timeout=30)
+        mine = [t for t in state.get_task_events() if t.get("request_id") == rid]
+        states = {t["state"] for t in mine}
+        assert "FINISHED" in states, "head task events missing the request_id"
+
+    def test_cluster_event_drain(self, ray_start_regular, fresh_ring):
+        @ray_tpu.remote
+        def emit():
+            from ray_tpu._private import events as ev
+            from ray_tpu.util import tracing as tr
+
+            ev.record("drain.me", request_id=tr.current_request_id())
+            return os.getpid()
+
+        with tracing.trace_context() as rid:
+            worker_pid = ray_tpu.get(emit.remote(), timeout=30)
+        assert worker_pid != os.getpid()  # really a remote ring
+        deadline = time.time() + 20
+        got = []
+        while time.time() < deadline and not got:
+            got = [
+                e for e in events.collect_cluster_events(rid)
+                if e["type"] == "drain.me"
+            ]
+        assert got and got[0]["request_id"] == rid
+
+
+# ---------------------------------------------------------------------------
+# metrics: percentiles + prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_percentiles_from_buckets_math():
+    bounds = (1.0, 2.0, 4.0)
+    counts = (1, 1, 1, 1)  # one obs per bucket incl. overflow
+    # rank 2 of 4 lands exactly at the top of bucket[1]
+    assert percentiles_from_buckets(bounds, counts, 0.5) == pytest.approx(2.0)
+    # deep quantiles clamp at the top finite boundary (overflow bucket)
+    assert percentiles_from_buckets(bounds, counts, 0.99) == pytest.approx(4.0)
+    # interpolation INSIDE a bucket: all mass in (1, 2]
+    assert percentiles_from_buckets(bounds, (0, 10, 0, 0), 0.5) == pytest.approx(1.5)
+    assert math.isnan(percentiles_from_buckets(bounds, (0, 0, 0, 0), 0.5))
+
+
+def test_histogram_percentile_snapshot():
+    h = Histogram("fr_pct_hist", "test", boundaries=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    p = h.percentiles()
+    assert p["count"] == 4 and p["sum"] == pytest.approx(6.05)
+    assert 0.1 < p["p50"] <= 1.0
+    assert p["p99"] <= 10.0
+    empty = Histogram("fr_pct_empty", "test").percentiles()
+    assert empty["count"] == 0 and math.isnan(empty["p50"])
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[0-9eE+.\-]+|NaN|[+-]Inf)$"
+)
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text-format parser: validates every line and
+    returns {family: {"type":..., "samples": [(name, labels, value)]}}."""
+    families: dict = {}
+    current = None
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            current = families.setdefault(name, {"type": kind, "samples": []})
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for pair in re.split(r",(?=[a-zA-Z_])", m.group("labels")):
+                assert _LABEL_RE.match(pair), f"bad label {pair!r} in {line!r}"
+                k, v = pair.split("=", 1)
+                labels[k] = v[1:-1]
+        assert current is not None, f"sample before any TYPE: {line!r}"
+        current["samples"].append((m.group("name"), labels, float(m.group("value"))))
+    return families
+
+
+def test_prometheus_text_scrape_and_reparse(ray_start_regular):
+    c = Counter("fr_requests_total", "requests served", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    c.inc(2, tags={"route": 'b"quote\\path'})  # exercises label escaping
+    g = Gauge("fr_kv_util", "kv utilization")
+    g.set(0.375)
+    h = Histogram("fr_latency_s", "latency", boundaries=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+
+    fams = _parse_exposition(prometheus_text())
+
+    assert fams["ray_tpu_fr_requests_total"]["type"] == "counter"
+    by_route = {
+        s[1]["route"]: s[2]
+        for s in fams["ray_tpu_fr_requests_total"]["samples"]
+    }
+    assert by_route["/a"] == 3
+    # escaped label round-trips: \" -> " and \\ -> "\"
+    assert by_route['b\\"quote\\\\path'] == 2
+
+    assert fams["ray_tpu_fr_kv_util"]["samples"][0][2] == 0.375
+
+    hist = fams["ray_tpu_fr_latency_s"]
+    assert hist["type"] == "histogram"
+    buckets = [(s[1]["le"], s[2]) for s in hist["samples"]
+               if s[0].endswith("_bucket")]
+    count = [s[2] for s in hist["samples"] if s[0].endswith("_count")][0]
+    total = [s[2] for s in hist["samples"] if s[0].endswith("_sum")][0]
+    # cumulative and monotone, finite boundaries ordered, +Inf == count
+    les = [float("inf") if le == "+Inf" else float(le) for le, _ in buckets]
+    assert les == sorted(les) and les[-1] == float("inf")
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals)
+    assert vals[-1] == count == 5
+    assert vals[:3] == [1, 3, 4]  # 0.05 | 0.5,0.5 | 5.0 (50.0 -> +Inf)
+    assert total == pytest.approx(56.05)
+
+    # the cluster-merged percentile view exposes the same histogram
+    pcts = histogram_percentiles("fr_latency_s")["fr_latency_s"]
+    snap = next(iter(pcts.values()))
+    assert snap["count"] == 5 and 0.1 <= snap["p50"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# obs req: one correlated timeline from a REAL served LLM request
+# ---------------------------------------------------------------------------
+
+
+def test_obs_req_from_served_llm_request():
+    """HTTP request → proxy → replica → speculative engine: everything
+    correlates under the proxy-minted request_id that comes back in the
+    x-request-id response header, and ``obs req`` renders TTFT plus
+    per-window accepted-token counts from it."""
+    from ray_tpu import serve
+    from ray_tpu.llm import EngineConfig
+    from ray_tpu.obs import render_request, request_events
+    from ray_tpu.serve.llm import build_llm_app
+
+    from ray_tpu.models.gptj import GPTJConfig
+
+    tiny = GPTJConfig(
+        vocab_size=128, seq_len=64, d_model=32, n_layers=2, n_heads=2,
+        rotary_dim=8, dtype="float32", remat=False, attn_impl="xla",
+        fused_loss=False,
+    )
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    try:
+        app = build_llm_app(
+            model="gptj",
+            model_cfg=tiny,
+            engine_config=EngineConfig(
+                max_slots=2, num_blocks=32, block_size=4,
+                max_blocks_per_seq=12, prefill_chunk=8, spec_k=3,
+            ),
+        )
+        serve.run(app, name="llm", http=True, http_port=0)
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        port = ray_tpu.get(controller.get_proxy_port.remote(), timeout=30)
+
+        # periodic prompt: the n-gram drafter finds a match immediately,
+        # so at least the first decode window goes through verification
+        prompt = [5, 6, 7] * 4
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm",
+            data=json.dumps(prompt).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            rid = resp.headers.get("x-request-id")
+            resp.read()  # drain the stream to completion
+        assert rid, "proxy did not return an x-request-id header"
+
+        # the full merged timeline (recorder rings cluster-wide + spans)
+        deadline = time.time() + 30
+        have = set()
+        want = {
+            "proxy.request", "replica.request", "llm.submit", "llm.admit",
+            "llm.prefill_chunk", "llm.first_token", "llm.verify",
+            "llm.finish",
+        }
+        while time.time() < deadline and not want <= have:
+            evs = request_events(rid)
+            have = {e["type"] for e in evs}
+            time.sleep(0.5)
+        assert want <= have, f"missing event types: {want - have}"
+
+        ttfts = [e for e in evs if e["type"] == "llm.first_token"]
+        assert ttfts and ttfts[0]["ttft_s"] > 0
+        verifies = [e for e in evs if e["type"] == "llm.verify"]
+        assert verifies and all(
+            0 <= e["accepted"] <= e["proposed"] for e in verifies
+        )
+        # events are time-ordered: the proxy sees the request before the
+        # engine admits it, and the finish comes last of the llm family
+        order = [e["type"] for e in evs]
+        assert order.index("proxy.request") < order.index("llm.admit")
+        assert order.index("llm.admit") < order.index("llm.finish")
+
+        text = render_request(rid, evs)
+        assert rid in text and "ttft=" in text and "spec:" in text
+        assert "finished: stop" in text or "finished: length" in text
+
+        # chrome trace: one lane per request in the "requests" group
+        out = "/tmp/fr_trace_test.json"
+        entries = tracing.export_chrome_trace(out)
+        lanes = {e["tid"] for e in entries if e.get("pid") == "requests"}
+        assert f"req:{rid}" in lanes
+        os.remove(out)
+
+        # `x-request-id` passthrough: a caller-supplied id is honored
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm",
+            data=json.dumps(prompt).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "x-request-id": "caller-chain-0042",
+            },
+        )
+        with urllib.request.urlopen(req2, timeout=300) as resp:
+            assert resp.headers.get("x-request-id") == "caller-chain-0042"
+            resp.read()
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
