@@ -60,6 +60,19 @@ _ring: deque = deque(maxlen=_capacity)
 _seq = itertools.count()  # per-process monotonic id: stable merge order
 _installed = False
 _dropped = 0  # events recorded before the current ring window (wraparound)
+_node: Optional[str] = None  # this process's node id (workers set it at boot)
+
+
+def set_node(node: Optional[str]) -> None:
+    """Tag this process's events with its node id at the SOURCE (workers
+    call this at boot). The live drain infers origin from the reply route,
+    but crash-flush files and OTLP resources need it carried in-band."""
+    global _node
+    _node = node
+
+
+def get_node() -> Optional[str]:
+    return _node
 
 
 def enabled() -> bool:
@@ -112,10 +125,13 @@ def snapshot(request_id: Optional[str] = None) -> list[dict]:
     items = list(_ring)
     pid = os.getpid()
     out = []
+    node = _node
     for seq, ts, etype, rid, fields in items:
         if request_id is not None and rid != request_id:
             continue
         ev = {"seq": seq, "ts": ts, "type": etype, "pid": pid}
+        if node is not None:
+            ev["node"] = node
         if rid is not None:
             ev["request_id"] = rid
         if fields:
@@ -153,6 +169,36 @@ def events_dir() -> str:
     )
 
 
+def load_crash_files(directory: Optional[str] = None) -> list[dict]:
+    """Read back every crash-flush JSONL in ``directory`` (default: the
+    events dir) — the postmortem half of the recorder: a killed worker
+    can't answer the live drain, but its flushed ring is on disk. Events
+    gain ``crash_flush`` (their source file) and the header's ``node``
+    when the event itself carries none."""
+    d = directory or events_dir()
+    out: list[dict] = []
+    if not os.path.isdir(d):
+        return out
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".jsonl"):
+            continue
+        node = None
+        try:
+            with open(os.path.join(d, fname)) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("_flight_recorder"):
+                        node = rec.get("node")
+                        continue  # header line
+                    rec.setdefault("crash_flush", fname)
+                    if node is not None:
+                        rec.setdefault("node", node)
+                    out.append(rec)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
 def flush(path: Optional[str] = None, reason: str = "manual") -> Optional[str]:
     """Dump the ring as JSONL (one event per line, preceded by a header
     line with process metadata). Returns the path, or None when the ring
@@ -170,6 +216,7 @@ def flush(path: Optional[str] = None, reason: str = "manual") -> Optional[str]:
             header = {
                 "_flight_recorder": 1,
                 "pid": os.getpid(),
+                "node": _node,
                 "reason": reason,
                 "time": time.time(),
                 **stats(),

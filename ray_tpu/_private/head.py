@@ -633,6 +633,10 @@ class Head:
         self._stacks_replies: dict[str, dict] = {}
         self._stacks_cv = threading.Condition()
         self.task_events: list[dict] = []  # observability feed (state API)
+        # metric time-series store + SLO alert engine (both lazy: created on
+        # first push/query so clusters that never look pay ~nothing)
+        self._metric_series = None
+        self._alerts = None
         self._infeasible_warned: dict[bytes, float] = {}
         # streaming-generator returns: task_id -> {"items": {index: obj_id},
         # "count": Optional[int] (set at completion), "next": next index a
@@ -668,6 +672,12 @@ class Head:
         )
         fb.start()
         self._threads.append(fb)
+        if os.environ.get("RAY_TPU_ALERTS", "1").lower() not in ("0", "false", "off"):
+            al = threading.Thread(
+                target=self._alerts_loop, name="head-alerts", daemon=True
+            )
+            al.start()
+            self._threads.append(al)
         if GLOBAL_CONFIG.memory_monitor_refresh_ms > 0:
             m = threading.Thread(
                 target=self._memory_monitor_loop, name="head-memmon", daemon=True
@@ -3708,6 +3718,71 @@ class Head:
                 self.cv.notify_all()
                 return True
             return False
+
+    # -- metric time series + SLO alerts (observability plane) -------------
+
+    def _series_store(self):
+        """Lazy SeriesStore: bounded per-process metric history, fed by
+        every process's metrics flusher (``series_push``) alongside the KV
+        snapshot mailbox. Guarded by its own lock — the hot scheduling path
+        must never contend with observability pushes."""
+        store = self._metric_series
+        if store is None:
+            from ray_tpu.util.metrics import SeriesStore
+
+            with self.lock:
+                if self._metric_series is None:
+                    self._metric_series = SeriesStore()
+                store = self._metric_series
+        return store
+
+    def _alert_manager(self):
+        mgr = self._alerts
+        if mgr is None:
+            from ray_tpu._private.alerts import AlertManager
+
+            with self.lock:
+                if self._alerts is None:
+                    self._alerts = AlertManager()
+                mgr = self._alerts
+        return mgr
+
+    def rpc_series_push(self, proc, interval, series):
+        self._series_store().push(proc, interval, series)
+        return True
+
+    def rpc_series_get(self, name=None):
+        """Raw per-process series (the drain format);
+        ``util.metrics.collect_series`` merges client-side with the same
+        function the head's own alert evaluator uses."""
+        return self._series_store().raw(name)
+
+    def rpc_alerts(self, eval_now=False):
+        """The SLO rule engine's current state. ``eval_now`` forces one
+        evaluation pass against the freshly merged series (obs alerts
+        --eval-once; tests) instead of waiting for the evaluator tick."""
+        mgr = self._alert_manager()
+        if eval_now:
+            mgr.evaluate(self._series_store().merged())
+        return mgr.state()
+
+    def _alerts_loop(self):
+        import os as _os
+
+        try:
+            interval = max(
+                1.0, float(_os.environ.get("RAY_TPU_ALERTS_INTERVAL_S", "15"))
+            )
+        except ValueError:
+            interval = 15.0
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                self._alert_manager().evaluate(self._series_store().merged())
+            except Exception as e:
+                # the evaluator must never die with the cluster still up —
+                # a broken rule would otherwise silently end all alerting
+                warn_throttled("head alert evaluator", e)
 
     def rpc_kv_put(self, key, value):
         with self.lock:

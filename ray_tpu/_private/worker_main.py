@@ -177,6 +177,9 @@ def main(
     # the postmortem story for a replica shot mid-stream (events.py)
     from ray_tpu._private import events as _events
 
+    # in-band node origin: crash-flush files and OTLP resources keep their
+    # node attribution even when the head never sees this process again
+    _events.set_node(node_id_bin.hex()[:12])
     _events.record("worker.start", node=node_id_bin.hex()[:12])
     _events.install_crash_handlers()
     try:

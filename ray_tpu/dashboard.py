@@ -95,6 +95,14 @@ def _payload(path: str):
         # p50/p95/p99 snapshots for every cluster histogram (obs top's
         # TTFT/ITL view over HTTP)
         return um.histogram_percentiles()
+    if path == "/api/series":
+        # merged metric time series (?name= narrows to one metric) — the
+        # data `obs series` renders, JSON for dashboards/tooling
+        name = (query.get("name") or [None])[0]
+        return um.collect_series(name)
+    if path == "/api/alerts":
+        # SLO burn-rate engine state (?eval=1 forces a pass first)
+        return st.get_alerts(eval_now=(query.get("eval") or ["0"])[0] == "1")
     if path == "/api/events":
         # flight-recorder drain (cluster-wide, newest last); ?request_id=
         # narrows to one request, ?tail= caps the reply

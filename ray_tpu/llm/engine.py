@@ -71,6 +71,9 @@ def _metrics() -> dict:
         _METRICS = {
             "tokens": Counter("llm_generated_tokens", "tokens sampled by the engine"),
             "steps": Counter("llm_engine_steps", "engine step-loop iterations"),
+            "finished": Counter(
+                "llm_finished_requests", "requests finished for any reason"
+            ),
             "preempt": Counter("llm_preemptions", "requests evicted under KV pressure"),
             "running": Gauge("llm_running_requests", "requests holding decode slots"),
             "waiting": Gauge("llm_waiting_requests", "requests queued for admission"),
@@ -189,6 +192,7 @@ class LLMEngine:
         self._step_n = 0
         self._tokens_generated = 0
         self._preemptions = 0
+        self._finished_published = 0  # scheduler.finish_count already counted
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_draft_s = 0.0
@@ -656,3 +660,7 @@ class LLMEngine:
         m["running"].set(self.scheduler.num_running)
         m["waiting"].set(self.scheduler.num_waiting)
         m["kv_util"].set(self.pool.utilization())
+        done = self.scheduler.finish_count
+        if done > self._finished_published:
+            m["finished"].inc(done - self._finished_published)
+            self._finished_published = done

@@ -121,6 +121,7 @@ class Scheduler:
         self._admit_seq = itertools.count()
         self._admitted_at: dict[str, int] = {}  # request id -> admission tick
         self.preempt_count = 0
+        self.finish_count = 0  # lifetime finishes (engine rates this per step)
 
     # -- queries -----------------------------------------------------------
 
@@ -233,6 +234,7 @@ class Scheduler:
         self._admitted_at.pop(req.id, None)
         req.state = FINISHED
         req.finish_reason = reason
+        self.finish_count += 1
         _events.record(
             "llm.finish", request_id=req.trace_id, engine_req=req.id,
             reason=reason, tokens_out=len(req.out),

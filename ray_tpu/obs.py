@@ -30,6 +30,21 @@ Commands::
         Chrome-trace export (task events + spans + one lane per request);
         load in chrome://tracing or Perfetto.
 
+    python -m ray_tpu.obs series llm_generated_tokens --address HOST:PORT
+        Metric history without Grafana: sparkline of the rate (counters) /
+        value (gauges) / observations-per-second + windowed percentiles
+        (histograms), from the head-drained time-series rings.
+
+    python -m ray_tpu.obs alerts --address HOST:PORT [--eval-once]
+        The SLO burn-rate engine's state: every rule with FIRING/OK/
+        RESOLVED status, current burn value, firing age, and labels.
+
+    python -m ray_tpu.obs export -o otlp.json --address HOST:PORT
+        OTLP-JSON export of spans, flight-recorder events, and metric
+        series (resourceSpans/resourceLogs/resourceMetrics in one file);
+        --events-dir exports crash-flush postmortems with no cluster, and
+        RAY_TPU_OTLP_ENDPOINT (or --post) adds a best-effort HTTP sink.
+
 Every command needs a running cluster (``--address``, or
 ``RAY_TPU_ADDRESS``); ``req``/``events`` also read crash-flush JSONL
 files from ``--events-dir`` so a killed worker's last events still show.
@@ -94,24 +109,7 @@ def _load_crash_files(events_dir: Optional[str]) -> list[dict]:
     its flushed ring is still on disk."""
     from ray_tpu._private import events as ev
 
-    d = events_dir or ev.events_dir()
-    out: list[dict] = []
-    if not os.path.isdir(d):
-        return out
-    for fname in sorted(os.listdir(d)):
-        if not fname.endswith(".jsonl"):
-            continue
-        try:
-            with open(os.path.join(d, fname)) as f:
-                for line in f:
-                    rec = json.loads(line)
-                    if rec.get("_flight_recorder"):
-                        continue  # header line
-                    rec.setdefault("crash_flush", fname)
-                    out.append(rec)
-        except (OSError, ValueError):
-            continue
-    return out
+    return ev.load_crash_files(events_dir)
 
 
 # ---------------------------------------------------------------------------
@@ -119,14 +117,33 @@ def _load_crash_files(events_dir: Optional[str]) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def _render_top(prev_sample: Optional[tuple]) -> tuple:
-    """One frame of ``obs top``. Returns (tokens_counter, time) so the
-    next frame can rate the token counter into tokens/s."""
+def _series_rate_text(merged: dict, name: str) -> str:
+    """Newest delta/dt of a cluster-merged counter series, or ``—`` when
+    fewer than 2 samples exist — a one-frame ``obs top`` must never fake a
+    rate out of a lifetime counter."""
+    from ray_tpu.util.metrics import latest_rate
+
+    ent = merged.get(name)
+    if not ent:
+        return "—"
+    rates = [
+        r for r in (latest_rate(points) for points in ent["series"].values())
+        if r is not None
+    ]
+    if not rates:
+        return "—"
+    return f"{sum(rates):.1f}"
+
+
+def _render_top() -> None:
+    """One frame of ``obs top``. Rates come from the metric time-series
+    (delta/dt of the head-drained rings), not lifetime counters."""
     from ray_tpu.util import state as st
-    from ray_tpu.util.metrics import collect, histogram_percentiles
+    from ray_tpu.util.metrics import collect, collect_series, histogram_percentiles
 
     data = collect()
     metrics = data.get("metrics", {})
+    series = collect_series()
     summary = st.summary()
     nodes = [n for n in st.list_nodes() if n.get("Alive", n.get("alive", True))]
 
@@ -134,20 +151,15 @@ def _render_top(prev_sample: Optional[tuple]) -> tuple:
         v = _first_series(metrics.get(name, {}))
         return default if v is None else v
 
-    now = time.time()
-    tokens = gauge("llm_generated_tokens", 0.0) or 0.0
-    rate = None
-    if prev_sample is not None:
-        dt = now - prev_sample[1]
-        if dt > 0:
-            rate = max(0.0, (tokens - prev_sample[0]) / dt)
-
     lines = [
         time.strftime("-- ray_tpu obs top -- %H:%M:%S"),
         f"nodes: {len(nodes)}  "
         f"tasks: {summary.get('tasks', {}).get('by_state') or {}}  "
         f"actors: {summary.get('actors', {}).get('by_state') or {}}",
     ]
+    req_rate = _series_rate_text(series, "serve_requests")
+    if req_rate != "—":
+        lines.append(f"serve: requests/s={req_rate}")
     if "llm_running_requests" in metrics:
         acc = gauge("llm_spec_acceptance_rate")
         lines.append(
@@ -157,7 +169,8 @@ def _render_top(prev_sample: Optional[tuple]) -> tuple:
             f"kv_util={float(gauge('llm_kv_block_utilization', 0.0) or 0.0):.2f} "
             f"tokens/step={gauge('llm_tokens_per_step', 0)} "
             + (f"accept_rate={acc:.2f} " if acc is not None else "")
-            + (f"tokens/s={rate:.1f}" if rate is not None else f"tokens={int(tokens)}")
+            + f"tokens/s={_series_rate_text(series, 'llm_generated_tokens')} "
+            + f"req/s={_series_rate_text(series, 'llm_finished_requests')}"
         )
         pcts = histogram_percentiles()
         ttft = _first_series(pcts.get("llm_time_to_first_token_s", {}))
@@ -168,16 +181,30 @@ def _render_top(prev_sample: Optional[tuple]) -> tuple:
             lines.append(f"ITL:  {_fmt_pcts(itl)}")
     else:
         lines.append("engine: (no llm_* metrics published — no LLM replica running)")
+    firing = _firing_alerts()
+    if firing:
+        lines.append(
+            "ALERTS: " + "  ".join(
+                f"{a['rule']}=FIRING({a['value']:.2f})" for a in firing
+            )
+        )
     print("\n".join(lines), flush=True)
-    return (tokens, now)
+
+
+def _firing_alerts() -> list[dict]:
+    try:
+        from ray_tpu._private.runtime import get_ctx
+
+        return [a for a in get_ctx().call("alerts") if a.get("status") == "FIRING"]
+    except Exception:
+        return []
 
 
 def cmd_top(args) -> int:
     ray_tpu = _attach(args.address)
     try:
-        sample = None
         while True:
-            sample = _render_top(sample)
+            _render_top()
             if args.once:
                 return 0
             time.sleep(max(args.watch, 0.2))
@@ -186,6 +213,161 @@ def cmd_top(args) -> int:
         return 0
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# series / alerts / export
+# ---------------------------------------------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 48) -> str:
+    """Terminal sparkline of the newest ``width`` values."""
+    vals = [v for v in values[-width:] if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))] for v in vals)
+
+
+def render_series(name: str, ent: dict, window_s: float) -> str:
+    """One metric's history as text: per-tagset sparkline + summary.
+    Counters render as rates, gauges as raw values, histograms as an
+    observations/s sparkline plus a percentile summary over the window."""
+    from ray_tpu.util.metrics import (
+        series_percentiles_over_window,
+        series_rate,
+    )
+
+    kind = ent.get("kind", "counter")
+    lines = [f"{name} ({kind})"]
+    for tagset, points in sorted(ent.get("series", {}).items()):
+        label = tagset or "(untagged)"
+        if kind == "histogram":
+            counts = [(ts, v[-1]) for ts, v in points if isinstance(v, (list, tuple))]
+            rates = series_rate(counts)
+            pct = series_percentiles_over_window(
+                points, ent.get("boundaries") or (), window_s
+            )
+            if rates:
+                lines.append(
+                    f"  {label}: obs/s {sparkline([r for _t, r in rates])}  "
+                    f"last={rates[-1][1]:.1f}/s"
+                )
+            else:
+                lines.append(f"  {label}: — (needs ≥2 samples)")
+            lines.append(f"    window {int(window_s)}s: {_fmt_pcts(pct)}")
+        elif kind == "counter":
+            rates = series_rate(points)
+            if rates:
+                lines.append(
+                    f"  {label}: rate {sparkline([r for _t, r in rates])}  "
+                    f"last={rates[-1][1]:.1f}/s"
+                )
+            else:
+                lines.append(f"  {label}: — (needs ≥2 samples)")
+        else:
+            vals = [float(v) for _t, v in points]
+            if vals:
+                lines.append(
+                    f"  {label}: {sparkline(vals)}  last={vals[-1]:.3f}"
+                )
+            else:
+                lines.append(f"  {label}: (no samples)")
+    if len(lines) == 1:
+        lines.append("  (no series — metric never sampled)")
+    return "\n".join(lines)
+
+
+def cmd_series(args) -> int:
+    from ray_tpu.util.metrics import collect_series
+
+    ray_tpu = _attach(args.address)
+    try:
+        merged = collect_series(args.metric or None)
+        if args.metric:
+            ent = merged.get(args.metric)
+            if ent is None:
+                print(f"no series for metric {args.metric!r}")
+                return 1
+            print(render_series(args.metric, ent, args.window))
+        else:
+            for name in sorted(merged):
+                print(render_series(name, merged[name], args.window))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def render_alerts(alerts: list[dict]) -> str:
+    """The ``obs alerts`` table: rule, status, value, age, labels."""
+    if not alerts:
+        return "no SLO rules registered"
+    now = time.time()
+    lines = [f"{'RULE':<22} {'STATUS':<9} {'VALUE':>9}  {'SINCE':>8}  DETAIL"]
+    for a in alerts:
+        since = a.get("since")
+        age = f"{now - since:.0f}s" if since else "-"
+        detail = a.get("detail") or {}
+        parts = []
+        if "fast_burn" in detail:
+            parts.append(
+                f"burn fast={detail['fast_burn']:.2f} slow={detail.get('slow_burn', 0):.2f}"
+            )
+        if detail.get("no_data"):
+            parts.append("no data")
+        if a.get("labels"):
+            parts.append(",".join(f"{k}={v}" for k, v in a["labels"].items()))
+        lines.append(
+            f"{a['rule']:<22} {a['status']:<9} {a.get('value', 0.0):>9.3f}  "
+            f"{age:>8}  {' '.join(parts)}"
+        )
+    return "\n".join(lines)
+
+
+def cmd_alerts(args) -> int:
+    from ray_tpu._private.runtime import get_ctx
+
+    ray_tpu = _attach(args.address)
+    try:
+        alerts = get_ctx().call("alerts", eval_now=bool(args.eval_once))
+        if args.json:
+            print(json.dumps(alerts, default=repr))
+        else:
+            print(render_alerts(alerts))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_export(args) -> int:
+    from ray_tpu.util import otlp
+
+    offline = _offline(args)
+    ray_tpu = None
+    if not offline:
+        ray_tpu = _attach(args.address)
+    try:
+        doc, counts = otlp.export_cluster(
+            path=args.output, events_dir=args.events_dir, offline=offline
+        )
+        posted = otlp.post(doc) if (args.post or otlp.otlp_endpoint()) else {}
+        where = "offline, crash-flush only" if offline else "live cluster"
+        print(
+            f"wrote OTLP export to {args.output} ({where}): "
+            f"{counts['spans']} spans, {counts['events']} events, "
+            f"{counts['metrics']} metric series"
+        )
+        for path, status in posted.items():
+            print(f"  POST {path}: {status}")
+        return 0
+    finally:
+        if ray_tpu is not None:
+            ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +617,30 @@ def main(argv=None) -> int:
         help="build the trace offline from crash-flush JSONL (no cluster)",
     )
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("series", help="metric time-series history (sparklines)")
+    p.add_argument("metric", nargs="?", default=None, help="metric name (all if omitted)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="percentile window seconds (histograms)")
+    p.set_defaults(fn=cmd_series)
+
+    p = sub.add_parser("alerts", help="SLO rule engine state (burn-rate alerts)")
+    p.add_argument("--eval-once", action="store_true",
+                   help="force one evaluation pass before reporting (headless/CI)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "export", help="OTLP-JSON export of spans + events + metric series"
+    )
+    p.add_argument("-o", "--output", default="ray_tpu_otlp.json")
+    p.add_argument("--otlp", action="store_true",
+                   help="(default) OTLP JSON — flag kept for explicitness")
+    p.add_argument("--events-dir", default=None,
+                   help="offline: export crash-flush JSONL only (no cluster)")
+    p.add_argument("--post", action="store_true",
+                   help="also POST to RAY_TPU_OTLP_ENDPOINT")
+    p.set_defaults(fn=cmd_export)
 
     args = parser.parse_args(argv)
     return args.fn(args)

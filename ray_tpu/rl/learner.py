@@ -293,8 +293,12 @@ class LearnerGroup:
     def shutdown(self):
         import ray_tpu
 
+        from ray_tpu._private.log_util import warn_throttled
+
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort teardown, but not silent: a failed kill here
+                # is a leaked learner actor holding its device allocation
+                warn_throttled("rl learner group teardown", e)

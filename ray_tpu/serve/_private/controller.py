@@ -33,7 +33,8 @@ REPLICA_INIT_TIMEOUT_S = 120.0
 
 
 def desired_replicas(
-    cfg: AutoscalingConfig, metrics: list[dict], current: int
+    cfg: AutoscalingConfig, metrics: list[dict], current: int,
+    alerts: tuple = (),
 ) -> int:
     """Pure scaling decision from one round of replica metrics.
 
@@ -42,8 +43,11 @@ def desired_replicas(
     queue, invisible to ongoing counts alone), divided by the per-replica
     target.  A replica at/above the KV-utilization threshold adds one
     replica of upscale pressure on top — a memory-bound engine preempts
-    and thrashes long before its request count looks saturated.  Bounded
-    by [min_replicas, max_replicas]; delay/hysteresis is the caller's
+    and thrashes long before its request count looks saturated.  A FIRING
+    SLO alert labeled ``serve=upscale`` (the head's burn-rate engine —
+    e.g. TTFT p99 burning its budget) does the same: latency degradation
+    is upscale pressure even when request counts look fine.  Bounded by
+    [min_replicas, max_replicas]; delay/hysteresis is the caller's
     (``_autoscale``'s) job."""
     total_load = 0.0
     kv_max = 0.0
@@ -57,6 +61,8 @@ def desired_replicas(
         or cfg.min_replicas
     )
     if kv_max >= cfg.kv_utilization_threshold:
+        desired = max(desired, current + 1)
+    if any((a.get("labels") or {}).get("serve") == "upscale" for a in alerts):
         desired = max(desired, current + 1)
     return max(cfg.min_replicas, min(cfg.max_replicas, desired))
 
@@ -99,6 +105,9 @@ class ServeController:
         # overwrite dropped the first proxy's only handle, and the head
         # reaps handle-less actors, killing it mid-request
         self._proxy_mutex = threading.Lock()
+        # firing-SLO-alert cache for the autoscale hook: the reconcile loop
+        # runs every 0.25s and must not hammer the head's alert RPC
+        self._alerts_cache: tuple[float, list] = (0.0, [])
         self._shutdown = False
         self._reconciler = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._reconciler.start()
@@ -500,6 +509,29 @@ class ServeController:
 
     # -- autoscaling -------------------------------------------------------
 
+    _ALERTS_REFRESH_S = 5.0
+
+    def _firing_alerts(self) -> list[dict]:
+        """FIRING SLO alerts from the head's burn-rate engine, refreshed at
+        most every few seconds (best-effort: no alerts beats no autoscale
+        when the head is briefly unreachable)."""
+        ts, cached = self._alerts_cache
+        now = time.time()
+        if now - ts < self._ALERTS_REFRESH_S:
+            return cached
+        firing: list[dict] = []
+        try:
+            from ray_tpu._private.runtime import get_ctx
+
+            firing = [
+                a for a in get_ctx().call("alerts")
+                if a.get("status") == "FIRING"
+            ]
+        except Exception as e:
+            warn_throttled("serve controller: alert fetch", e)
+        self._alerts_cache = (now, firing)
+        return firing
+
     def _autoscale(self, state: _DeploymentState):
         import ray_tpu
 
@@ -521,7 +553,9 @@ class ServeController:
                 # count an unreachable replica as zero load, but surface it:
                 # persistently silent metrics skew autoscaling down
                 warn_throttled("serve controller: replica metrics", e)
-        desired = desired_replicas(cfg, metrics, current)
+        desired = desired_replicas(
+            cfg, metrics, current, alerts=tuple(self._firing_alerts())
+        )
         now = time.time()
         with self._lock:
             current = state.target_replicas

@@ -49,6 +49,28 @@ _DISPATCH_THREADS = 32  # blocking picks/lookups/fetches — never held per-requ
 _STREAM_WINDOW = 64  # max un-consumed chunks in flight per stream
 _UNARY_TIMEOUT_S = 60.0
 
+_request_counter = None
+_request_counter_lock = threading.Lock()
+
+
+def _count_request(status: int) -> None:
+    """Bump the ``serve_requests`` counter by status class. Feeds the
+    request-errors SLO (``util.slo.default_rules``) and the request-rate
+    line in ``obs top`` — the flight-recorder events alone can't, their
+    ring wraps."""
+    global _request_counter
+    if _request_counter is None:
+        with _request_counter_lock:
+            if _request_counter is None:
+                from ray_tpu.util.metrics import Counter
+
+                _request_counter = Counter(
+                    "serve_requests",
+                    "proxied HTTP requests by status class",
+                    tag_keys=("status",),
+                )
+    _request_counter.inc(tags={"status": f"{int(status) // 100}xx"})
+
 
 class _Resolution:
     """One in-flight unary request: its asyncio future plus the CURRENT
@@ -386,6 +408,7 @@ class ProxyActor:
             window.release()
             if first_kind == "error":
                 code = 404 if isinstance(first_val, KeyError) else 500
+                _count_request(code)
                 _events.record(
                     "proxy.response", request_id=request_id, status=code,
                     error=repr(first_val), streaming=True,
@@ -410,10 +433,12 @@ class ProxyActor:
                     await self._send(writer, conn, h11.Data(data=val))
                 elif kind == "end":
                     await self._send(writer, conn, h11.EndOfMessage())
+                    _count_request(200)
                     return True
                 else:  # mid-stream error: truncate
                     import traceback
 
+                    _count_request(500)
                     _events.record(
                         "proxy.stream_error", request_id=request_id,
                         error=repr(val),
@@ -481,12 +506,14 @@ class ProxyActor:
                         except (asyncio.TimeoutError, asyncio.CancelledError):
                             self._resolver.discard(res)  # free slot + tracking
                             raise
+                        _count_request(200)
                         _events.record(
                             "proxy.response", request_id=rid, status=200,
                             dur_s=round(time.time() - t_req, 6),
                         )
                         await self._respond(writer, conn, 200, result, request_id=rid)
                 except KeyError as e:
+                    _count_request(404)
                     _events.record("proxy.response", request_id=rid, status=404)
                     await self._respond(
                         writer, conn, 404, {"error": str(e)}, request_id=rid
@@ -494,6 +521,7 @@ class ProxyActor:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001
+                    _count_request(500)
                     _events.record(
                         "proxy.response", request_id=rid, status=500,
                         error=repr(e),

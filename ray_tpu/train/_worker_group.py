@@ -148,6 +148,8 @@ class WorkerGroup:
         ray_tpu.get([w.set_env.remote(e) for w, e in zip(self.workers, envs)])
 
     def shutdown(self):
+        from ray_tpu._private.log_util import warn_throttled
+
         try:
             ray_tpu.get([w.shutdown.remote() for w in self.workers], timeout=5.0)
         except Exception:
@@ -155,8 +157,10 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort teardown, but not silent: a kill that fails for
+                # any reason other than "already dead" means leaked workers
+                warn_throttled("train worker group teardown", e)
         from ray_tpu.util.placement_group import remove_placement_group
 
         try:

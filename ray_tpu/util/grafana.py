@@ -64,12 +64,57 @@ _LLM_PANELS = [
      "Accepted/proposed draft tokens of the last verify window."),
 ]
 
-# names the static LLM row already covers — the dynamic user-metric loop
-# skips them to avoid duplicate panels when the engine runs in-process
+def _slo_panels() -> list:
+    """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
+    panels interpolate the same threshold/objective/window the head's alert
+    engine evaluates (all env-tunable), so Grafana and ``obs alerts`` agree
+    on what 'burning' means even after an operator retunes the rules."""
+    from ray_tpu.util.slo import default_rules
+
+    panels = []
+    for rule in default_rules():
+        budget = max(1e-9, 1.0 - rule.objective)
+        window = f"[{max(int(rule.fast_window_s), 15)}s]"
+        if rule.kind == "histogram_burn":
+            m = f"ray_tpu_{rule.metric}"
+            expr = (
+                f'(1 - (rate({m}_bucket{{le="{rule.threshold:g}"}}{window}) '
+                f"/ rate({m}_count{window}))) / {budget:g}"
+            )
+            title = f"{rule.name} fast burn rate"
+        elif rule.kind == "counter_burn":
+            m = f"ray_tpu_{rule.metric}"
+            sel = ",".join(
+                f'{k}="{v}"' for k, v in (rule.bad_tags or {}).items()
+            )
+            expr = (
+                f"(sum(rate({m}{{{sel}}}{window})) "
+                f"/ sum(rate({m}{window}))) / {budget:g}"
+            )
+            title = f"{rule.name} burn rate"
+        else:  # gauge_threshold: show the gauge against its bound
+            expr = f"ray_tpu_{rule.metric}"
+            title = f"{rule.name} (fires ≥ {rule.threshold:g} for {rule.for_s:g}s)"
+        panels.append((title, expr, "short", rule.description or rule.name))
+    panels += [
+        ("Serve requests/s",
+         "sum(rate(ray_tpu_serve_requests[1m]))", "short",
+         "Proxied HTTP request throughput across status classes."),
+        ("Dropped spans/s",
+         "rate(ray_tpu_tracing_dropped_spans[5m])", "short",
+         "Spans evicted by the per-process retention cap "
+         "(RAY_TPU_TRACE_MAX_SPANS) — sustained drops mean raise the cap "
+         "or lower RAY_TPU_TRACE_SAMPLE."),
+    ]
+    return panels
+
+# names the static LLM/SLO rows already cover — the dynamic user-metric
+# loop skips them to avoid duplicate panels when the engine runs in-process
 _LLM_NAMES = {
     "llm_generated_tokens", "llm_running_requests", "llm_waiting_requests",
     "llm_kv_block_utilization", "llm_time_to_first_token_s",
     "llm_inter_token_latency_s", "llm_spec_acceptance_rate",
+    "serve_requests", "tracing_dropped_spans", "llm_finished_requests",
 }
 
 
@@ -118,7 +163,7 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     panels = []
     y = 0
     pid = 0
-    for title, expr, unit, desc in _CORE_PANELS + _LLM_PANELS:
+    for title, expr, unit, desc in _CORE_PANELS + _LLM_PANELS + _slo_panels():
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:

@@ -17,7 +17,7 @@ import json
 import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Optional, Sequence
 
 _FLUSH_INTERVAL_S = 2.0
@@ -26,6 +26,28 @@ _KV_PREFIX = "__metrics__/"
 _registry_lock = threading.Lock()
 _registry: list["Metric"] = []
 _flusher_started = False
+
+
+def _series_enabled() -> bool:
+    return os.environ.get("RAY_TPU_METRICS_SERIES", "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def _series_capacity() -> int:
+    try:
+        return max(8, int(os.environ.get("RAY_TPU_METRICS_SERIES_CAPACITY", "512")))
+    except ValueError:
+        return 512
+
+
+def _series_interval() -> float:
+    try:
+        return max(
+            0.05, float(os.environ.get("RAY_TPU_METRICS_SERIES_INTERVAL_S", "1.0"))
+        )
+    except ValueError:
+        return 1.0
 
 
 def _tag_key(tags: Optional[dict]) -> str:
@@ -218,6 +240,438 @@ def histogram_percentiles(
 
 
 # ---------------------------------------------------------------------------
+# time series: a bounded in-process ring per (metric, tagset)
+#
+# Every process samples its OWN registry on a fixed cadence into fixed-size
+# rings, and flush() ships only the not-yet-shipped samples to the head
+# (``series_push`` — the same mailbox rendezvous the snapshot KV uses), where
+# a bounded per-process store holds recent history.  ``collect_series()``
+# merges the per-process series into one cluster view; rates/percentiles are
+# derived at query time (``series_rate`` / ``series_window_delta`` /
+# ``series_percentiles_over_window``) with Prometheus-style counter-reset
+# handling, so ``obs top`` can show a real tokens/s and the SLO engine can
+# evaluate burn rates over real windows without any external TSDB.
+# ---------------------------------------------------------------------------
+
+_series_lock = threading.Lock()
+# name -> {"kind": str, "boundaries": list|None, "points": {tagset: deque}}
+# deque entries: (sample_seq, ts, value) — value is a float for
+# counters/gauges, the buckets+sum+count list for histograms
+_series: dict[str, dict] = {}
+_sample_seq = 0
+_shipped_seq = 0
+
+
+def _merged_local_snaps(snaps: list[dict]) -> dict[str, dict]:
+    """Fold one process's registry snapshots into one entry per metric NAME
+    (two same-name Metric objects in one process — e.g. re-created across
+    test runs — must produce ONE sample per tick, merged with collect()'s
+    semantics, not two appends that would corrupt the ring)."""
+    out: dict[str, dict] = {}
+    for snap in snaps:
+        name, kind = snap["name"], snap["kind"]
+        ent = out.setdefault(
+            name,
+            {"kind": kind, "boundaries": snap.get("boundaries"), "data": {}},
+        )
+        for tagset, val in snap["data"].items():
+            if kind == "gauge":
+                ent["data"][tagset] = val
+            elif kind == "counter":
+                ent["data"][tagset] = ent["data"].get(tagset, 0.0) + val
+            else:
+                prev = ent["data"].get(tagset)
+                ent["data"][tagset] = (
+                    [a + b for a, b in zip(prev, val)] if prev else list(val)
+                )
+    return out
+
+
+def sample_series_now(now: Optional[float] = None) -> int:
+    """Append one sample per (metric, tagset) to this process's rings.
+    Called by the flusher thread on its cadence; tests and ``obs top
+    --once`` call it directly for a deterministic sample."""
+    global _sample_seq
+    if not _series_enabled():
+        return 0
+    now = time.time() if now is None else now
+    with _registry_lock:
+        snaps = [m._snapshot() for m in _registry]
+    merged = _merged_local_snaps(snaps)
+    cap = _series_capacity()
+    with _series_lock:
+        _sample_seq += 1
+        seq = _sample_seq
+        for name, snap in merged.items():
+            ent = _series.setdefault(
+                name, {"kind": snap["kind"], "boundaries": None, "points": {}}
+            )
+            ent["kind"] = snap["kind"]
+            if snap.get("boundaries") is not None:
+                ent["boundaries"] = list(snap["boundaries"])
+            for tagset, val in snap["data"].items():
+                dq = ent["points"].get(tagset)
+                if dq is None or dq.maxlen != cap:
+                    dq = deque(dq or (), maxlen=cap)
+                    ent["points"][tagset] = dq
+                dq.append(
+                    (seq, now, list(val) if isinstance(val, list) else float(val))
+                )
+    return seq
+
+
+def get_local_series(name: Optional[str] = None) -> dict:
+    """This PROCESS's rings as plain lists (oldest first)."""
+    with _series_lock:
+        out = {}
+        for n, ent in _series.items():
+            if name is not None and n != name:
+                continue
+            out[n] = {
+                "kind": ent["kind"],
+                "boundaries": ent["boundaries"],
+                "points": {
+                    tagset: [[ts, v] for (_seq, ts, v) in dq]
+                    for tagset, dq in ent["points"].items()
+                },
+            }
+        return out
+
+
+def configure_series(capacity: Optional[int] = None) -> None:
+    """Resize the per-process rings (tests/tuning; drops nothing unless
+    shrinking)."""
+    if capacity is not None:
+        os.environ["RAY_TPU_METRICS_SERIES_CAPACITY"] = str(int(capacity))
+        with _series_lock:
+            for ent in _series.values():
+                for tagset, dq in list(ent["points"].items()):
+                    ent["points"][tagset] = deque(dq, maxlen=max(8, int(capacity)))
+
+
+def _reset_series_for_tests() -> None:
+    global _sample_seq, _shipped_seq
+    with _series_lock:
+        _series.clear()
+        _sample_seq = 0
+        _shipped_seq = 0
+
+
+_ship_lock = threading.Lock()
+
+
+def _ship_series() -> None:
+    """Push samples recorded since the last successful ship to the head's
+    SeriesStore. Best-effort, like the KV snapshot flush.
+
+    Delivery is IDEMPOTENT: rows carry their sample seq and the head drops
+    anything at/below its per-process watermark, so a push whose reply was
+    lost (head applied it, caller retries the backlog) cannot duplicate
+    rows; ``_ship_lock`` additionally serializes concurrent shippers (the
+    flusher thread racing a ``collect_series`` caller would otherwise have
+    the same backlog in flight twice)."""
+    global _shipped_seq
+    if not _series_enabled():
+        return
+    if not _ship_lock.acquire(blocking=False):
+        return  # another thread is shipping this same backlog right now
+    try:
+        with _series_lock:
+            if _sample_seq == _shipped_seq:
+                return
+            floor = _shipped_seq
+            top = _sample_seq
+            payload: dict[str, dict] = {}
+            for name, ent in _series.items():
+                rows = {}
+                for tagset, dq in ent["points"].items():
+                    new = [[seq, ts, v] for (seq, ts, v) in dq if seq > floor]
+                    if new:
+                        rows[tagset] = new
+                if rows:
+                    payload[name] = {"kind": ent["kind"], "points": rows}
+                    if ent["boundaries"] is not None:
+                        payload[name]["boundaries"] = ent["boundaries"]
+        if not payload:
+            with _series_lock:
+                _shipped_seq = max(_shipped_seq, top)
+            return
+        from ray_tpu._private.runtime import get_ctx
+
+        try:
+            ctx = get_ctx()
+            ctx.call(
+                "series_push",
+                proc=_process_tag(),
+                interval=_series_interval(),
+                series=payload,
+            )
+        except Exception:
+            return  # head gone / not initialized — retry backlog next flush
+        with _series_lock:
+            _shipped_seq = max(_shipped_seq, top)
+    finally:
+        _ship_lock.release()
+
+
+class SeriesStore:
+    """Head-side bounded store of per-process metric series.
+
+    ``push`` appends one process's incremental samples; each (proc, metric,
+    tagset) keeps at most ``capacity`` samples, so memory is bounded no
+    matter the uptime. ``raw()`` is the drain format ``collect_series``
+    merges client-side; the head's alert evaluator merges in-process."""
+
+    _MAX_PROCS = 256
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._capacity = capacity or _series_capacity()
+        # proc -> {"interval": float, "t": last-push, "metrics": {name: ent}}
+        self._procs: dict[str, dict] = {}
+
+    def push(self, proc: str, interval: float, series: dict) -> None:
+        with self._lock:
+            rec = self._procs.get(proc)
+            if rec is None:
+                if len(self._procs) >= self._MAX_PROCS:
+                    oldest = min(self._procs, key=lambda p: self._procs[p]["t"])
+                    del self._procs[oldest]
+                rec = self._procs[proc] = {
+                    "interval": interval, "metrics": {}, "seq": -1,
+                }
+            rec["interval"] = float(interval)
+            rec["t"] = time.time()
+            watermark = rec.get("seq", -1)
+            top = watermark
+            for name, ent in series.items():
+                dest = rec["metrics"].setdefault(
+                    name,
+                    {"kind": ent["kind"], "boundaries": ent.get("boundaries"),
+                     "points": {}},
+                )
+                dest["kind"] = ent["kind"]
+                if ent.get("boundaries") is not None:
+                    dest["boundaries"] = ent["boundaries"]
+                for tagset, rows in ent["points"].items():
+                    dq = dest["points"].get(tagset)
+                    if dq is None:
+                        dq = dest["points"][tagset] = deque(maxlen=self._capacity)
+                    for row in rows:
+                        if len(row) == 3:  # [seq, ts, v]: idempotent delivery
+                            seq, ts, v = row
+                            if seq <= watermark:
+                                continue  # re-delivered after a lost reply
+                            top = max(top, seq)
+                        else:  # bare [ts, v] (tests / external feeders)
+                            ts, v = row
+                        dq.append((float(ts), v))
+            rec["seq"] = top
+
+    def raw(self, name: Optional[str] = None) -> dict:
+        with self._lock:
+            out: dict[str, dict] = {}
+            for proc, rec in self._procs.items():
+                metrics = {}
+                for n, ent in rec["metrics"].items():
+                    if name is not None and n != name:
+                        continue
+                    metrics[n] = {
+                        "kind": ent["kind"],
+                        "boundaries": ent["boundaries"],
+                        "points": {
+                            tagset: [[ts, v] for ts, v in dq]
+                            for tagset, dq in ent["points"].items()
+                        },
+                    }
+                if metrics:
+                    out[proc] = {"interval": rec["interval"], "metrics": metrics}
+            return out
+
+    def merged(self, name: Optional[str] = None) -> dict:
+        return merge_proc_series(self.raw(name))
+
+
+def merge_proc_series(raw: dict) -> dict:
+    """Merge per-process series into one cluster view, binned on the
+    coarsest contributing sample interval: counters and histograms are
+    forward-filled per process then summed (a process that missed a bin
+    contributes its last known cumulative value, and a dead process's
+    contribution freezes instead of vanishing — the merged counter stays
+    monotonic through stragglers); gauges are last-write-wins by sample
+    time, mirroring ``collect()``. Returns ``{name: {"kind", "boundaries",
+    "series": {tagset: [(ts, value), ...]}}}``."""
+    # (name, tagset) -> list of (per-proc sorted samples); plus metadata
+    grouped: dict[str, dict] = {}
+    for proc, rec in raw.items():
+        interval = max(float(rec.get("interval", 1.0)), 0.05)
+        for name, ent in rec.get("metrics", {}).items():
+            g = grouped.setdefault(
+                name,
+                {"kind": ent["kind"], "boundaries": ent.get("boundaries"),
+                 "interval": interval, "tagsets": {}},
+            )
+            g["interval"] = max(g["interval"], interval)
+            if ent.get("boundaries") is not None:
+                g["boundaries"] = ent["boundaries"]
+            for tagset, rows in ent["points"].items():
+                g["tagsets"].setdefault(tagset, []).append(
+                    sorted((float(ts), v) for ts, v in rows)
+                )
+    out: dict[str, dict] = {}
+    for name, g in grouped.items():
+        series = {}
+        for tagset, proc_samples in g["tagsets"].items():
+            series[tagset] = _merge_one(proc_samples, g["kind"], g["interval"])
+        out[name] = {
+            "kind": g["kind"], "boundaries": g["boundaries"], "series": series,
+        }
+    return out
+
+
+def _merge_one(proc_samples: list[list], kind: str, width: float) -> list[tuple]:
+    if len(proc_samples) == 1:
+        return list(proc_samples[0])
+    bins = sorted({int(ts // width) for samples in proc_samples for ts, _v in samples})
+    merged: list[tuple] = []
+    cursors = [0] * len(proc_samples)
+    last_val: list = [None] * len(proc_samples)
+    for b in bins:
+        end = (b + 1) * width
+        bin_ts = None
+        gauge_pick = None  # (ts, value) with max ts in bin
+        for i, samples in enumerate(proc_samples):
+            c = cursors[i]
+            while c < len(samples) and samples[c][0] < end:
+                ts, v = samples[c]
+                last_val[i] = v
+                if ts >= b * width:
+                    bin_ts = ts if bin_ts is None else max(bin_ts, ts)
+                    if gauge_pick is None or ts >= gauge_pick[0]:
+                        gauge_pick = (ts, v)
+                c += 1
+            cursors[i] = c
+        if bin_ts is None:
+            continue  # no process sampled inside this bin
+        if kind == "gauge":
+            merged.append((bin_ts, gauge_pick[1]))
+        elif kind == "histogram":
+            total = None
+            for v in last_val:
+                if v is None:
+                    continue
+                total = list(v) if total is None else [a + b2 for a, b2 in zip(total, v)]
+            merged.append((bin_ts, total))
+        else:  # counter: sum of forward-filled cumulative values
+            merged.append((bin_ts, sum(v for v in last_val if v is not None)))
+    return merged
+
+
+# ---- query helpers over merged (ts, value) sample lists -------------------
+
+
+def series_rate(points: list) -> list[tuple]:
+    """Per-interval rate from consecutive cumulative samples, with counter
+    resets handled Prometheus-style (a decrease means the counter restarted
+    from zero, so the post-reset value IS the increase)."""
+    out = []
+    prev = None
+    for ts, v in points:
+        if prev is not None:
+            pts, pv = prev
+            dt = ts - pts
+            if dt > 0:
+                delta = v - pv
+                if delta < 0:
+                    delta = v
+                out.append((ts, delta / dt))
+        prev = (ts, v)
+    return out
+
+
+def latest_rate(points: list):
+    """Rate of the newest sample pair, or None with fewer than 2 samples —
+    the ``obs top`` contract (render ``—``, never a lifetime-average)."""
+    rates = series_rate(points[-2:] if len(points) >= 2 else points)
+    return rates[-1][1] if rates else None
+
+
+def series_window_delta(points: list, window_s: float, now: Optional[float] = None):
+    """Reset-aware increase of a cumulative counter over the trailing
+    window (the sample just before the window start is the baseline).
+    Returns None when the window holds no step."""
+    now = time.time() if now is None else now
+    start = now - window_s
+    total = None
+    prev = None
+    for ts, v in points:
+        if prev is not None and ts > start:
+            delta = v - prev
+            if delta < 0:
+                delta = v
+            total = delta if total is None else total + delta
+        prev = v
+    return total
+
+
+def hist_window_delta(points: list, window_s: float, now: Optional[float] = None):
+    """Elementwise increase of a histogram's buckets+sum+count vector over
+    the trailing window (reset-aware: a shrinking count restarts the
+    baseline). None when no in-window step exists."""
+    now = time.time() if now is None else now
+    start = now - window_s
+    total = None
+    prev = None
+    for ts, v in points:
+        if prev is not None and ts > start:
+            if v[-1] < prev[-1]:  # counter reset: the new vector IS the delta
+                delta = list(v)
+            else:
+                delta = [a - b for a, b in zip(v, prev)]
+            total = delta if total is None else [a + b for a, b in zip(total, delta)]
+        prev = v
+    return total
+
+
+def series_percentiles_over_window(
+    points: list,
+    boundaries: Sequence[float],
+    window_s: float,
+    qs: Sequence[float] = (0.5, 0.95, 0.99),
+    now: Optional[float] = None,
+) -> dict:
+    """Percentile summary of a histogram series restricted to the trailing
+    window — what ``obs series`` and the TTFT SLO rule evaluate."""
+    delta = hist_window_delta(points, window_s, now)
+    return _percentile_summary(tuple(boundaries or ()), delta, qs)
+
+
+def collect_series(name: Optional[str] = None) -> dict:
+    """Cluster-wide merged time series from the head's SeriesStore (after
+    shipping this process's own backlog). Same return shape as
+    ``merge_proc_series``. Deliberately does NOT take a fresh sample: the
+    background sampler's evenly spaced ticks are what make delta/dt rates
+    meaningful — a collect-time sample would end every series with a
+    near-zero interval and rate the newest pair at ~0."""
+    from ray_tpu._private.runtime import get_ctx
+
+    _ship_series()
+    try:
+        ctx = get_ctx()
+        raw = ctx.call("series_get", name=name)
+    except Exception:
+        raw = None
+    if raw is None:
+        raw = {
+            _process_tag(): {
+                "interval": _series_interval(),
+                "metrics": get_local_series(name),
+            }
+        }
+    return merge_proc_series(raw)
+
+
+# ---------------------------------------------------------------------------
 # publication + collection
 # ---------------------------------------------------------------------------
 
@@ -246,6 +700,7 @@ def flush() -> None:
         )
     except Exception:
         pass  # head gone (shutdown) — metrics are best-effort
+    _ship_series()
 
 
 def _ensure_flusher():
@@ -256,9 +711,18 @@ def _ensure_flusher():
         _flusher_started = True
 
     def loop():
+        # one thread does both jobs on their own cadences: sample the
+        # registry into the series rings every _series_interval() (env,
+        # re-read each tick so tests can retune a live process) and ship
+        # snapshots + new samples every _FLUSH_INTERVAL_S
+        last_flush = 0.0
         while True:
-            time.sleep(_FLUSH_INTERVAL_S)
-            flush()
+            time.sleep(_series_interval() if _series_enabled() else _FLUSH_INTERVAL_S)
+            sample_series_now()
+            now = time.monotonic()
+            if now - last_flush >= _FLUSH_INTERVAL_S:
+                last_flush = now
+                flush()
 
     threading.Thread(target=loop, daemon=True, name="metrics-flusher").start()
     atexit.register(flush)

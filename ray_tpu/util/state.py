@@ -82,6 +82,13 @@ def profile_workers(duration_s: float = 2.0, interval_ms: float = 10.0) -> dict:
     )
 
 
+def get_alerts(eval_now: bool = False) -> list[dict]:
+    """The head's SLO burn-rate engine state: one dict per rule with
+    ``status`` (OK/FIRING/RESOLVED), current ``value``, ``since``, and
+    ``labels``. ``eval_now`` forces an evaluation pass first."""
+    return _ctx().call("alerts", eval_now=eval_now)
+
+
 # ---------------------------------------------------------------------------
 # summaries (reference: `ray summary tasks/actors/objects`)
 # ---------------------------------------------------------------------------

@@ -36,15 +36,96 @@ import os
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Iterator, Optional
+
+
+def _env_max_spans() -> int:
+    """Span retention cap (``RAY_TPU_TRACE_MAX_SPANS``): always-on tracing
+    in a long-lived engine process must be bounded — before this cap the
+    span list grew without limit for the process's lifetime."""
+    try:
+        return max(16, int(os.environ.get("RAY_TPU_TRACE_MAX_SPANS", "8192")))
+    except ValueError:
+        return 8192
+
+
+def _env_sample_rate() -> float:
+    """Head-sampling rate (``RAY_TPU_TRACE_SAMPLE``, 0..1, default 1.0):
+    the keep/drop decision is made once per request id, deterministically
+    from the id itself, so every process in the request's path agrees
+    without coordination (no half-sampled traces)."""
+    try:
+        return min(1.0, max(0.0, float(os.environ.get("RAY_TPU_TRACE_SAMPLE", "1"))))
+    except ValueError:
+        return 1.0
+
 
 _local = threading.local()
 _lock = threading.Lock()
-_spans: list[dict] = []  # finished spans of THIS process
+# finished spans of THIS process: bounded drop-oldest ring
+_spans: deque = deque(maxlen=_env_max_spans())
+_dropped_spans = 0
+_drop_counter = None  # lazy metrics.Counter — created on first drop only
 
 
 def _now_us() -> float:
     return time.time() * 1e6
+
+
+def configure(max_spans: Optional[int] = None) -> None:
+    """Resize the span ring (tests/tuning; keeps the newest spans)."""
+    global _spans
+    if max_spans is not None:
+        with _lock:
+            _spans = deque(_spans, maxlen=max(16, int(max_spans)))
+
+
+def span_stats() -> dict:
+    with _lock:
+        return {
+            "capacity": _spans.maxlen,
+            "size": len(_spans),
+            "dropped": _dropped_spans,
+        }
+
+
+def _count_dropped_span() -> None:
+    # caller holds _lock; the metric is created lazily so processes that
+    # never hit the cap never pay for a metrics registry entry
+    global _dropped_spans, _drop_counter
+    _dropped_spans += 1
+    if _drop_counter is None:
+        try:
+            from ray_tpu.util.metrics import Counter
+
+            _drop_counter = Counter(
+                "tracing_dropped_spans",
+                "spans evicted by the per-process retention cap",
+            )
+        except Exception:
+            _drop_counter = False  # metrics unavailable: stats() still counts
+    if _drop_counter:
+        try:
+            _drop_counter.inc()
+        except Exception:
+            pass
+
+
+def trace_sampled(request_id: Optional[str]) -> bool:
+    """Head-sampling decision for a request id (None = unsampled-context
+    spans, always kept). Deterministic across processes: the id's leading
+    hex bits against the sample rate."""
+    rate = _env_sample_rate()
+    if rate >= 1.0 or not request_id:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        bits = int(request_id[:8], 16)
+    except ValueError:
+        bits = hash(request_id) & 0xFFFFFFFF
+    return bits / 0xFFFFFFFF < rate
 
 
 # ---------------------------------------------------------------------------
@@ -117,8 +198,11 @@ def span(name: str, **attributes: Any) -> Iterator[None]:
             if rid:
                 args.setdefault("request_id", rid)
             rec["args"] = args
-        with _lock:
-            _spans.append(rec)
+        if trace_sampled(rid):
+            with _lock:
+                if len(_spans) == _spans.maxlen:
+                    _count_dropped_span()
+                _spans.append(rec)
 
 
 def _jsonable(v: Any):

@@ -1,0 +1,516 @@
+"""Metrics time-series, SLO burn-rate alerting, and the alert plane.
+
+Covers the PR-5 observability tentpole:
+
+* time-series ring: wraparound bounds, counter-reset handling, cross-process
+  merge (forward-fill + sum for counters, last-write-wins for gauges);
+* burn-rate math golden tests (fast+slow window fire/resolve, flapping
+  hysteresis via resolve_after_s);
+* span retention caps (bounded deque, dropped-span accounting);
+* obs top rate derivation (`—` below 2 samples, delta/dt after);
+* serve autoscaler reacting to a firing upscale-labeled alert;
+* alert → flight-recorder → `obs alerts` e2e on a LIVE head with synthetic
+  TTFT degradation, through FIRING and back to RESOLVED.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import events as fr
+from ray_tpu._private.alerts import FIRING, OK, RESOLVED, AlertManager
+from ray_tpu.util import metrics as um
+from ray_tpu.util import slo
+
+
+def _counter_series(samples):
+    return {"kind": "counter", "boundaries": None, "series": {"": list(samples)}}
+
+
+def _gauge_series(samples):
+    return {"kind": "gauge", "boundaries": None, "series": {"": list(samples)}}
+
+
+def _hist(boundaries, per_bucket, s=0.0):
+    """buckets+sum+count vector in the metrics layout."""
+    return list(per_bucket) + [s, sum(per_bucket)]
+
+
+# ---------------------------------------------------------------------------
+# time-series ring
+# ---------------------------------------------------------------------------
+
+
+class TestSeriesRing:
+    def test_wraparound_bounds_memory(self, monkeypatch):
+        um._reset_series_for_tests()
+        monkeypatch.setenv("RAY_TPU_METRICS_SERIES_CAPACITY", "16")
+        g = um.Gauge("t_ring_gauge", "ring test")
+        for i in range(50):
+            g.set(float(i))
+            um.sample_series_now(now=1000.0 + i)
+        local = um.get_local_series("t_ring_gauge")
+        points = local["t_ring_gauge"]["points"][""]
+        assert len(points) == 16  # bounded despite 50 samples
+        # drop-oldest: the newest value survives, the oldest are gone
+        assert points[-1][1] == 49.0
+        assert points[0][1] == 34.0
+        um._reset_series_for_tests()
+
+    def test_counter_reset_handling(self):
+        # counter restarts from zero mid-window: the post-reset value IS the
+        # increase (Prometheus increase() semantics)
+        pts = [(0, 100.0), (1, 110.0), (2, 5.0), (3, 10.0)]
+        assert um.series_window_delta(pts, 10, now=3) == 10 + 5 + 5
+        rates = um.series_rate(pts)
+        assert [r for _t, r in rates] == [10.0, 5.0, 5.0]
+
+    def test_latest_rate_requires_two_samples(self):
+        assert um.latest_rate([]) is None
+        assert um.latest_rate([(0, 5.0)]) is None
+        assert um.latest_rate([(0, 5.0), (2, 9.0)]) == pytest.approx(2.0)
+
+    def test_hist_window_delta_and_reset(self):
+        b = (0.5, 1.0)
+        pts = [
+            (0, _hist(b, [10, 0, 0])),
+            (5, _hist(b, [20, 5, 0])),
+            (6, _hist(b, [1, 1, 0])),  # reset: counts shrank
+            (7, _hist(b, [2, 2, 0])),
+        ]
+        delta = um.hist_window_delta(pts, 100, now=7)
+        # 5..0 step: +10/+5; reset step contributes its full vector; last +1/+1
+        assert delta[0] == 10 + 1 + 1
+        assert delta[1] == 5 + 1 + 1
+
+    def test_merge_forward_fills_counters_across_procs(self):
+        now = 1000.0
+        raw = {
+            "pid-1": {"interval": 1.0, "metrics": {"c": {
+                "kind": "counter", "boundaries": None,
+                "points": {"": [[now, 10.0], [now + 1, 20.0], [now + 2, 30.0]]},
+            }}},
+            # pid-2 misses the middle bin: its last value forward-fills
+            "pid-2": {"interval": 1.0, "metrics": {"c": {
+                "kind": "counter", "boundaries": None,
+                "points": {"": [[now, 5.0], [now + 2, 15.0]]},
+            }}},
+        }
+        pts = um.merge_proc_series(raw)["c"]["series"][""]
+        assert [v for _t, v in pts] == [15.0, 25.0, 45.0]
+
+    def test_merge_gauges_last_write_wins(self):
+        now = 1000.0
+        raw = {
+            "pid-1": {"interval": 1.0, "metrics": {"g": {
+                "kind": "gauge", "boundaries": None,
+                "points": {"": [[now + 0.1, 1.0]]},
+            }}},
+            "pid-2": {"interval": 1.0, "metrics": {"g": {
+                "kind": "gauge", "boundaries": None,
+                "points": {"": [[now + 0.5, 7.0]]},
+            }}},
+        }
+        pts = um.merge_proc_series(raw)["g"]["series"][""]
+        assert pts[-1][1] == 7.0
+
+    def test_series_store_bounded_and_mergeable(self):
+        store = um.SeriesStore(capacity=8)
+        for i in range(30):
+            store.push("pid-9", 1.0, {"c": {
+                "kind": "counter", "points": {"": [[100.0 + i, float(i)]]},
+            }})
+        raw = store.raw()
+        assert len(raw["pid-9"]["metrics"]["c"]["points"][""]) == 8
+        merged = store.merged()
+        assert merged["c"]["kind"] == "counter"
+
+    def test_series_store_push_is_idempotent(self):
+        # a push whose reply was lost gets retried in full: the per-proc seq
+        # watermark must drop the re-delivered rows instead of duplicating
+        store = um.SeriesStore(capacity=32)
+        batch = {"c": {"kind": "counter", "points": {
+            "": [[1, 100.0, 1.0], [2, 101.0, 2.0]],
+        }}}
+        store.push("pid-9", 1.0, batch)
+        store.push("pid-9", 1.0, batch)  # retry after lost reply
+        pts = store.raw()["pid-9"]["metrics"]["c"]["points"][""]
+        assert pts == [[100.0, 1.0], [101.0, 2.0]]
+        # overlapping retry: old rows dropped, new row lands once
+        store.push("pid-9", 1.0, {"c": {"kind": "counter", "points": {
+            "": [[2, 101.0, 2.0], [3, 102.0, 5.0]],
+        }}})
+        pts = store.raw()["pid-9"]["metrics"]["c"]["points"][""]
+        assert pts == [[100.0, 1.0], [101.0, 2.0], [102.0, 5.0]]
+
+    def test_ship_then_collect_has_no_duplicates(self):
+        # end-to-end: flush() twice in a row (second ship has nothing new)
+        # must not duplicate rows in the head store
+        um._reset_series_for_tests()
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        try:
+            g = um.Gauge("t_dedup_gauge", "dedup test")
+            g.set(1.0)
+            um.sample_series_now(now=1000.0)
+            um.flush()
+            um.flush()
+            um.flush()
+            pts = um.collect_series("t_dedup_gauge")["t_dedup_gauge"][
+                "series"][""]
+            assert len([p for p in pts if p[0] == 1000.0]) == 1
+        finally:
+            ray_tpu.shutdown()
+            um._reset_series_for_tests()
+
+    def test_grafana_slo_panels_track_env_tuned_rules(self, monkeypatch):
+        from ray_tpu.util.grafana import _slo_panels
+
+        monkeypatch.setenv("RAY_TPU_SLO_TTFT_THRESHOLD_S", "1.0")
+        monkeypatch.setenv("RAY_TPU_SLO_TTFT_OBJECTIVE", "0.999")
+        monkeypatch.setenv("RAY_TPU_SLO_FAST_WINDOW_S", "120")
+        exprs = {title: expr for title, expr, _u, _d in _slo_panels()}
+        ttft = exprs["ttft-p99 fast burn rate"]
+        assert 'le="1"' in ttft and "[120s]" in ttft and "/ 0.001" in ttft
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (golden)
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_budget_burn_values(self):
+        # 1% errors on a 99% objective = exactly burning budget (1.0)
+        assert slo.budget_burn(1, 100, 0.99) == pytest.approx(1.0)
+        assert slo.budget_burn(50, 100, 0.99) == pytest.approx(50.0)
+        assert slo.budget_burn(0, 100, 0.99) == 0.0
+        assert slo.budget_burn(5, 0, 0.99) == 0.0  # no traffic, no burn
+
+    def _ttft_rule(self, **kw):
+        kw.setdefault("fast_window_s", 60)
+        kw.setdefault("slow_window_s", 300)
+        kw.setdefault("fast_burn", 14.4)
+        kw.setdefault("slow_burn", 6.0)
+        return slo.SLORule(
+            name="ttft", metric="ttft", kind="histogram_burn",
+            objective=0.99, threshold=1.0, **kw,
+        )
+
+    def _ttft_series(self, now, fast_bad, fast_good, old_bad, old_good):
+        """Two deltas: one landing in both windows (recent) and one only in
+        the slow window. Boundaries (0.5, 1.0): bucket 2 (overflow) is bad."""
+        b = (0.5, 1.0)
+        base = _hist(b, [0, 0, 0])
+        old = _hist(b, [0, old_good, old_bad])
+        recent = _hist(
+            b, [0, old_good + fast_good, old_bad + fast_bad]
+        )
+        return {
+            "ttft": {
+                "kind": "histogram", "boundaries": list(b),
+                "series": {"": [(now - 280, base), (now - 120, old), (now - 5, recent)]},
+            }
+        }
+
+    def test_fires_only_when_both_windows_burn(self):
+        now = 10_000.0
+        rule = self._ttft_rule()
+        # fast window burning (50% bad), slow window quiet → no fire
+        res = slo.evaluate_rule(
+            rule, self._ttft_series(now, fast_bad=50, fast_good=50,
+                                    old_bad=0, old_good=1000), now)
+        assert res["detail"]["fast_burn"] > rule.fast_burn
+        assert not res["breached"]
+        # both windows burning → fire
+        res = slo.evaluate_rule(
+            rule, self._ttft_series(now, fast_bad=50, fast_good=50,
+                                    old_bad=50, old_good=50), now)
+        assert res["breached"]
+
+    def test_quiet_fast_window_resolves_even_with_slow_residue(self):
+        now = 10_000.0
+        rule = self._ttft_rule()
+        # the outage is old: bad events only in the slow window
+        res = slo.evaluate_rule(
+            rule, self._ttft_series(now, fast_bad=0, fast_good=100,
+                                    old_bad=80, old_good=20), now)
+        assert not res["breached"]
+        assert res["detail"]["fast_burn"] < rule.fast_burn
+        assert res["detail"]["slow_burn"] > rule.slow_burn
+
+    def test_counter_burn_bad_tag_filter(self):
+        now = 10_000.0
+        rule = slo.SLORule(
+            name="err", metric="reqs", kind="counter_burn", objective=0.99,
+            bad_tags={"status": "5xx"}, fast_window_s=60, slow_window_s=300,
+            fast_burn=14.4, slow_burn=6.0,
+        )
+        ok_tag = json.dumps({"status": "2xx"})
+        bad_tag = json.dumps({"status": "5xx"})
+        merged = {"reqs": {"kind": "counter", "boundaries": None, "series": {
+            ok_tag: [(now - 280, 0.0), (now - 120, 50.0), (now - 5, 100.0)],
+            bad_tag: [(now - 280, 0.0), (now - 120, 50.0), (now - 5, 100.0)],
+        }}}
+        res = slo.evaluate_rule(rule, merged, now)
+        assert res["breached"]  # 50% 5xx in both windows
+        merged["reqs"]["series"][bad_tag] = [(now - 280, 0.0), (now - 5, 0.0)]
+        assert not slo.evaluate_rule(rule, merged, now)["breached"]
+
+    def test_gauge_threshold_requires_sustained_coverage(self):
+        now = 1000.0
+        rule = slo.SLORule(
+            name="kv", metric="kv", kind="gauge_threshold",
+            threshold=0.95, for_s=30.0,
+        )
+        # spiked 5s ago only: no sample older than the window at threshold
+        fresh = _gauge_series([(now - 40, 0.1), (now - 5, 0.99)])
+        assert not slo.evaluate_rule(rule, {"kv": fresh}, now)["breached"]
+        # pinned for the whole window (and before it)
+        pinned = _gauge_series(
+            [(now - 45, 0.98), (now - 20, 0.99), (now - 5, 0.99)]
+        )
+        assert slo.evaluate_rule(rule, {"kv": pinned}, now)["breached"]
+        # dipped mid-window → not sustained
+        dipped = _gauge_series(
+            [(now - 45, 0.98), (now - 20, 0.5), (now - 5, 0.99)]
+        )
+        assert not slo.evaluate_rule(rule, {"kv": dipped}, now)["breached"]
+
+    def test_no_data_never_breaches(self):
+        rule = self._ttft_rule()
+        res = slo.evaluate_rule(rule, {}, 1000.0)
+        assert not res["breached"] and res["detail"].get("no_data")
+
+
+# ---------------------------------------------------------------------------
+# alert manager state machine
+# ---------------------------------------------------------------------------
+
+
+class TestAlertManager:
+    def _rule(self, resolve_after=10.0):
+        return slo.SLORule(
+            name="g", metric="g", kind="gauge_threshold", threshold=1.0,
+            resolve_after_s=resolve_after,
+        )
+
+    def test_fire_and_resolve_with_hysteresis(self):
+        mgr = AlertManager([self._rule(resolve_after=10.0)])
+        hot = {"g": _gauge_series([(99.0, 5.0)])}
+        cold = {"g": _gauge_series([(99.0, 0.0)])}
+        assert mgr.state()[0]["status"] == OK
+        t = mgr.evaluate(hot, now=100.0)
+        assert t == [{"rule": "g", "to": FIRING, "value": 5.0}]
+        # clean evals inside the hysteresis window do NOT resolve (flapping)
+        assert mgr.evaluate(cold, now=104.0) == []
+        assert mgr.state()[0]["status"] == FIRING
+        # a re-breach resets the clean clock
+        assert mgr.evaluate(hot, now=106.0) == []
+        assert mgr.evaluate(cold, now=108.0) == []
+        assert mgr.evaluate(cold, now=117.0) == []  # only 9s clean
+        t = mgr.evaluate(cold, now=119.0)  # 11s clean → resolve
+        assert t and t[0]["to"] == RESOLVED
+        assert mgr.state()[0]["status"] == RESOLVED
+
+    def test_transitions_land_in_flight_recorder(self):
+        fr.clear()
+        mgr = AlertManager([self._rule(resolve_after=1.0)])
+        mgr.evaluate({"g": _gauge_series([(99.0, 5.0)])}, now=100.0)
+        mgr.evaluate({"g": _gauge_series([(99.0, 0.0)])}, now=102.0)
+        mgr.evaluate({"g": _gauge_series([(99.0, 0.0)])}, now=104.0)
+        types = [e["type"] for e in fr.snapshot() if e["type"].startswith("alert.")]
+        assert types == ["alert.fire", "alert.resolve"]
+
+    def test_broken_rule_isolated(self):
+        bad = slo.SLORule(name="bad", metric="g", kind="nonsense")
+        good = self._rule()
+        mgr = AlertManager([bad, good])
+        mgr.evaluate({"g": _gauge_series([(99.0, 5.0)])}, now=100.0)
+        states = {a["rule"]: a["status"] for a in mgr.state()}
+        assert states["g"] == FIRING
+        assert states["bad"] == OK
+        detail = [a for a in mgr.state() if a["rule"] == "bad"][0]["detail"]
+        assert "error" in detail
+
+
+# ---------------------------------------------------------------------------
+# span retention cap
+# ---------------------------------------------------------------------------
+
+
+class TestSpanRetention:
+    def test_bounded_with_drop_accounting(self):
+        from ray_tpu.util import tracing
+
+        tracing.clear()
+        tracing.configure(max_spans=32)
+        try:
+            before = tracing.span_stats()["dropped"]
+            for i in range(100):
+                with tracing.span("cap_test", i=i):
+                    pass
+            stats = tracing.span_stats()
+            assert len(tracing.get_spans()) <= 32
+            assert stats["dropped"] - before >= 68
+            # the newest spans survive (drop-oldest)
+            assert tracing.get_spans()[-1]["args"]["i"] == 99
+            # the dropped-span counter metric exists and counted
+            snap = {m.name: m for m in um._registry}
+            assert "tracing_dropped_spans" in snap
+        finally:
+            tracing.clear()
+            tracing.configure(max_spans=tracing._env_max_spans())
+
+    def test_head_sampling_deterministic(self, monkeypatch):
+        from ray_tpu.util import tracing
+
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "0.5")
+        rid = "00000000deadbeef"  # leading bits 0 → always sampled
+        assert tracing.trace_sampled(rid)
+        assert tracing.trace_sampled(rid)  # decision is stable
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "0")
+        assert not tracing.trace_sampled(rid)
+        assert tracing.trace_sampled(None)  # context-less spans always kept
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "1")
+        assert tracing.trace_sampled("ffffffffffffffff")
+
+
+# ---------------------------------------------------------------------------
+# obs top + serve hook
+# ---------------------------------------------------------------------------
+
+
+class TestObsSurfaces:
+    def test_series_rate_text_dash_below_two_samples(self):
+        from ray_tpu.obs import _series_rate_text
+
+        assert _series_rate_text({}, "x") == "—"
+        one = {"x": {"kind": "counter", "series": {"": [(0, 5.0)]}}}
+        assert _series_rate_text(one, "x") == "—"
+        two = {"x": {"kind": "counter", "series": {"": [(0, 5.0), (2, 9.0)]}}}
+        assert _series_rate_text(two, "x") == "2.0"
+
+    def test_render_series_and_alerts_text(self):
+        from ray_tpu.obs import render_alerts, render_series
+
+        ent = {"kind": "counter", "boundaries": None,
+               "series": {"": [(0, 0.0), (1, 10.0), (2, 30.0)]}}
+        text = render_series("c", ent, 60.0)
+        assert "last=20.0/s" in text
+        text = render_alerts([
+            {"rule": "ttft-p99", "status": "FIRING", "value": 20.0,
+             "since": time.time() - 5,
+             "detail": {"fast_burn": 20.0, "slow_burn": 8.0},
+             "labels": {"serve": "upscale"}},
+        ])
+        assert "FIRING" in text and "serve=upscale" in text
+
+    def test_autoscaler_upscales_on_firing_alert(self):
+        from ray_tpu.serve._private.common import AutoscalingConfig
+        from ray_tpu.serve._private.controller import desired_replicas
+
+        cfg = AutoscalingConfig(min_replicas=1, max_replicas=5,
+                                target_ongoing_requests=100)
+        metrics = [{"num_ongoing_requests": 1}]
+        assert desired_replicas(cfg, metrics, current=1) == 1
+        firing = ({"rule": "ttft-p99", "status": "FIRING",
+                   "labels": {"serve": "upscale"}},)
+        assert desired_replicas(cfg, metrics, current=1, alerts=firing) == 2
+        # non-upscale alerts don't scale
+        other = ({"rule": "request-errors", "status": "FIRING",
+                  "labels": {"severity": "page"}},)
+        assert desired_replicas(cfg, metrics, current=1, alerts=other) == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e on a live head: synthetic TTFT degradation → FIRING → RESOLVED
+# ---------------------------------------------------------------------------
+
+
+class TestAlertsE2E:
+    def test_fire_and_resolve_on_live_head(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_SLO_FAST_WINDOW_S", "2.0")
+        monkeypatch.setenv("RAY_TPU_SLO_SLOW_WINDOW_S", "4.0")
+        monkeypatch.setenv("RAY_TPU_SLO_RESOLVE_AFTER_S", "0.5")
+        monkeypatch.setenv("RAY_TPU_SLO_TTFT_THRESHOLD_S", "0.5")
+        monkeypatch.setenv("RAY_TPU_ALERTS_INTERVAL_S", "3600")  # manual ticks
+        um._reset_series_for_tests()
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            from ray_tpu._private.runtime import get_ctx
+            from ray_tpu.obs import render_alerts
+
+            ctx = get_ctx()
+            h = um.Histogram(
+                "llm_time_to_first_token_s", "ttft (e2e synthetic)"
+            )
+            # baseline sample (one healthy request so the series has a
+            # point to diff against), then synthetic degradation: every
+            # request blows the 0.5s TTFT bound
+            h.observe(0.01)
+            um.sample_series_now()
+            um.flush()
+            for _ in range(50):
+                h.observe(5.0)
+            um.sample_series_now()
+            um.flush()
+            alerts = ctx.call("alerts", eval_now=True)
+            by_rule = {a["rule"]: a for a in alerts}
+            assert by_rule["ttft-p99"]["status"] == "FIRING"
+            assert "FIRING" in render_alerts(alerts)
+            # the transition reached the flight recorder (head process ring
+            # → cluster drain)
+            evs = fr.collect_cluster_events()
+            fired = [e for e in evs if e.get("type") == "alert.fire"]
+            assert any(e.get("rule") == "ttft-p99" for e in fired)
+            # recovery: no new bad observations; wait out the fast window
+            # plus the hysteresis, shipping fresh (clean) samples meanwhile
+            deadline = time.time() + 20
+            status = None
+            while time.time() < deadline:
+                time.sleep(0.5)
+                um.sample_series_now()
+                um.flush()
+                alerts = ctx.call("alerts", eval_now=True)
+                status = {a["rule"]: a["status"] for a in alerts}["ttft-p99"]
+                if status == "RESOLVED":
+                    break
+            assert status == "RESOLVED"
+            evs = fr.collect_cluster_events()
+            assert any(
+                e.get("type") == "alert.resolve" and e.get("rule") == "ttft-p99"
+                for e in evs
+            )
+        finally:
+            ray_tpu.shutdown()
+            um._reset_series_for_tests()
+
+    def test_series_drain_through_head(self, monkeypatch):
+        """A worker-side metric's series reaches collect_series() through
+        the head store (the cluster-wide drain path obs top uses)."""
+        um._reset_series_for_tests()
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            @ray_tpu.remote
+            def bump(n):
+                from ray_tpu.util import metrics as wm
+
+                c = wm.Counter("t_drain_counter", "drain test")
+                c.inc(n)
+                wm.sample_series_now()
+                c.inc(n)
+                wm.sample_series_now()
+                wm.flush()
+                return True
+
+            assert ray_tpu.get(bump.remote(7))
+            merged = um.collect_series("t_drain_counter")
+            pts = merged["t_drain_counter"]["series"][""]
+            assert len(pts) >= 2
+            assert pts[-1][1] == pytest.approx(14.0)
+            assert um.latest_rate(pts) is not None
+        finally:
+            ray_tpu.shutdown()
+            um._reset_series_for_tests()
