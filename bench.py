@@ -93,43 +93,52 @@ def _section(sections: dict, name: str, fn):
 
 def main():
     sections: dict = {}
-    # core microbench first: it is CPU-only and must not run while this
-    # process holds the single-tenant TPU tunnel (import jax acquires it)
-    core = _section(sections, "core_microbench", _core_microbench) or {}
-    llm = _section(sections, "llm_serving", _llm_serving_bench) or {}
-    fit = _section(sections, "gptj_fit_proof", _gptj_fit_proof) or {}
-    train = _section(sections, "train_headline", _train_headline) or {}
+    core = {}
+    llm = {}
+    fit = {}
+    train = {}
+    silicon = {}
+    try:
+        # core microbench first: it is CPU-only and must not run while this
+        # process holds the single-tenant TPU tunnel (import jax acquires it)
+        core = _section(sections, "core_microbench", _core_microbench) or {}
+        llm = _section(sections, "llm_serving", _llm_serving_bench) or {}
+        fit = _section(sections, "gptj_fit_proof", _gptj_fit_proof) or {}
+        train = _section(sections, "train_headline", _train_headline) or {}
 
-    detail = dict(train.get("detail", {}))
-    detail["core"] = core
-    if llm:
-        # continuous-batching serving engine vs sequential static-batch
-        # decode under staggered arrivals + speculative-decode comparison
-        # (ray_tpu/llm/bench.py)
-        detail["llm_serving"] = llm
-    if fit:
-        detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
-        detail["gptj_6b_fit"] = fit
-    if train.get("on_tpu"):
-        # _train_headline's state is freed with its frame — the 6B forward
-        # gets the HBM back before this section allocates
-        silicon = _section(sections, "gptj_6b_silicon", _gptj_6b_silicon) or {}
-        detail.update(silicon)
-    detail["sections"] = sections
-    # the headline ALWAYS prints — a failed training section reports
-    # value 0 with its error recorded in sections, instead of zeroing the
-    # whole round by printing nothing
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_train_tokens_per_sec_per_chip",
-                "value": train.get("value", 0.0),
-                "unit": "tokens/s",
-                "vs_baseline": train.get("vs_baseline", 0.0),
-                "detail": detail,
-            }
+        if train.get("on_tpu"):
+            # _train_headline's state is freed with its frame — the 6B
+            # forward gets the HBM back before this section allocates
+            silicon = _section(sections, "gptj_6b_silicon", _gptj_6b_silicon) or {}
+    finally:
+        # the headline ALWAYS prints — even if a section escapes _section's
+        # isolation with a BaseException (the BENCH_r05 failure mode: one
+        # remote_compile infra flake, rc=1, and the whole round's
+        # trajectory was lost). Whatever sections completed go out.
+        detail = dict(train.get("detail", {}))
+        detail["core"] = core
+        if llm:
+            # continuous-batching serving engine vs sequential static-batch
+            # decode under staggered arrivals + speculative-decode
+            # comparison (ray_tpu/llm/bench.py)
+            detail["llm_serving"] = llm
+        if fit:
+            detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
+            detail["gptj_6b_fit"] = fit
+        if train.get("on_tpu"):
+            detail.update(silicon)
+        detail["sections"] = sections
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt_train_tokens_per_sec_per_chip",
+                    "value": train.get("value", 0.0),
+                    "unit": "tokens/s",
+                    "vs_baseline": train.get("vs_baseline", 0.0),
+                    "detail": detail,
+                }
+            )
         )
-    )
 
 
 def _train_headline() -> dict:

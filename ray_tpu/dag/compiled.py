@@ -179,8 +179,12 @@ class CompiledDAG:
         for ref in self._loop_refs:
             try:
                 ray_tpu.get(ref, timeout=10)
-            except Exception:
-                pass
+            except Exception as e:
+                from ray_tpu._private.log_util import warn_throttled
+
+                # expected when an exec-loop actor died mid-DAG, but a
+                # teardown that ALWAYS fails here means loops leaking
+                warn_throttled("compiled dag: exec-loop join", e)
         for ch in self._all_channels:
             ch.destroy()
 
@@ -207,5 +211,10 @@ class CompiledDAG:
             try:
                 ch.close()
                 ch.destroy()
-            except Exception:
-                pass
+            except Exception as e:
+                try:
+                    from ray_tpu._private.log_util import warn_throttled
+
+                    warn_throttled("compiled dag: channel teardown", e)
+                except Exception:  # raylint: disable=RL007
+                    pass  # interpreter teardown: even logging can fail

@@ -437,12 +437,16 @@ class ReadOp(PhysicalOp):
             self.finished = True
 
     def shutdown(self):
+        from ray_tpu._private.log_util import warn_throttled
+
         with self._slock:
             for rec in self._streams:
                 try:
                     rec["gen"].close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # the producer may already be dead (its items consumed);
+                    # log so a systematically failing dispose isn't silent
+                    warn_throttled("data read op: stream dispose", e)
             self._streams.clear()
 
 
@@ -492,11 +496,15 @@ class ActorMapOp(PhysicalOp):
         super().on_task_done(meta_ref, ctx)
 
     def shutdown(self):
+        from ray_tpu._private.log_util import warn_throttled
+
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort teardown (the actor may already be gone), but
+                # a kill that ALWAYS fails leaks pool actors — say so
+                warn_throttled("data actor-map op: actor kill", e)
 
 
 class LimitOp(PhysicalOp):

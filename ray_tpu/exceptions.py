@@ -120,3 +120,18 @@ class OutOfMemoryError(RayError):
     """The memory monitor killed this task's worker to relieve host memory
     pressure (reference: ``worker_killing_policy.h`` + OOM-killed task
     errors)."""
+
+
+class OverloadedError(RayError):
+    """The request was shed by deadline-aware admission control instead of
+    queued as doomed work (RESILIENCE.md): the serving engine's backlog ÷
+    service rate said the deadline could not be met.  The serve HTTP proxy
+    maps this to ``429 Too Many Requests`` with a ``Retry-After`` header
+    from ``retry_after_s``."""
+
+    def __init__(self, msg: str = "server overloaded", retry_after_s: float = 1.0):
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (OverloadedError, (self.args[0] if self.args else "", self.retry_after_s))

@@ -184,6 +184,33 @@ class KVBlockPool:
         with self._lock:
             return len(self._owned)
 
+    def audit(self) -> dict:
+        """Free-list ledger invariant check (the watchdog's leak audit):
+        every usable block is either free or owned exactly once, and every
+        id is in range.  Runs under the pool lock alone — safe while the
+        engine lock is wedged.  Returns counts plus the owner ids so the
+        caller can cross-check owners against live requests."""
+        with self._lock:
+            free = list(self._free)
+            owned = {k: list(v) for k, v in self._owned.items()}
+        usable = self.cfg.num_blocks - 1
+        owned_blocks = [b for bs in owned.values() for b in bs]
+        all_blocks = free + owned_blocks
+        duplicates = len(all_blocks) != len(set(all_blocks))
+        out_of_range = sum(
+            1 for b in all_blocks if not (1 <= b < self.cfg.num_blocks)
+        )
+        missing = usable - len(all_blocks)
+        return {
+            "ok": not duplicates and not out_of_range and missing == 0,
+            "free": len(free),
+            "owned": len(owned_blocks),
+            "owners": list(owned),
+            "missing": missing,          # >0 leaked, <0 double-counted
+            "duplicates": duplicates,
+            "out_of_range": out_of_range,
+        }
+
     def table_row(self, seq_id: Optional[str]) -> np.ndarray:
         """(max_blocks_per_seq,) int32 block table, padded with the trash
         block.  ``None`` (an inactive slot) is all-trash."""

@@ -46,6 +46,7 @@ from ray_tpu.llm.scheduler import (
     FINISH_DEADLINE,
     FINISH_LENGTH,
     FINISH_STOP,
+    FINISHED,
     PREFILL,
     RUNNING,
     Request,
@@ -101,6 +102,10 @@ def _metrics() -> dict:
             "tokens_per_step": Gauge(
                 "llm_tokens_per_step", "tokens emitted by the last decode step"
             ),
+            "shed": Counter(
+                "llm_shed_requests",
+                "requests rejected by deadline-aware admission (429 upstream)",
+            ),
         }
     return _METRICS
 
@@ -143,6 +148,19 @@ class EngineConfig:
     spec_draft_ctx: int = 16
     spec_min_accept: float = 0.3
     spec_backoff_max: int = 32
+    #: deadline-aware overload shedding (RESILIENCE.md): a submit carrying
+    #: ``deadline_s`` is REJECTED with ``OverloadedError`` (429 at the
+    #: proxy) when backlog ÷ observed service rate says the deadline
+    #: cannot be met — queueing doomed work only steals KV blocks and
+    #: decode slots from requests that could still make their deadlines.
+    #: Requests without a deadline are never shed.
+    shed: bool = True
+    #: engine watchdog (llm.watchdog): stall-detection deadline and check
+    #: cadence. The watchdog thread itself is started by the owner
+    #: (serve.llm replicas start one; bare engines opt in via
+    #: ``start_watchdog()``).
+    watchdog_stall_s: float = 30.0
+    watchdog_interval_s: float = 1.0
 
 
 class LLMEngine:
@@ -198,6 +216,17 @@ class LLMEngine:
         self._spec_draft_s = 0.0
         self._spec_skip = 0      # plain-decode steps left before re-probing
         self._spec_backoff = 0   # current backoff length (0 = speculating)
+        # liveness beat, read LOCK-FREE by the watchdog and stream_tokens'
+        # stall diagnosis (a wedged step holds the engine lock, so the
+        # observers must never need it): (monotonic t of the last completed
+        # step — idle ticks count, a wedge does not — , pending work then).
+        # One-tuple assignment keeps the read torn-free under the GIL.
+        self._beat: tuple[float, int] = (time.monotonic(), 0)
+        self._watchdog = None
+        # observed decode throughput (EWMA tokens/s) for deadline-aware
+        # admission: backlog ÷ rate estimates a new request's completion
+        self._rate = 0.0
+        self._rate_mark = (time.monotonic(), 0)  # (t, tokens_generated)
         # model-length cap: paged table width, and the learned positional
         # table for GPT (rotary GPT-J has no absolute cap of its own)
         self.max_model_len = cache_cfg.max_seq_len
@@ -214,12 +243,41 @@ class LLMEngine:
         prompt: list[int],
         params: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
+        resume_tokens: tuple = (),
     ) -> Request:
         """Queue a request; returns immediately (drive with ``step()`` or a
-        loop thread; consume with ``stream_tokens``)."""
+        loop thread; consume with ``stream_tokens``).
+
+        ``resume_tokens`` — tokens a previous replica already generated for
+        this request before dying (mid-stream failover, RESILIENCE.md).
+        They pre-fold into the request's output: the cache is rebuilt by
+        re-prefilling prompt + resumed tokens, generation continues at
+        output index ``len(resume_tokens)`` with the same per-index PRNG
+        keys, and only NEW tokens are streamed — token-identical to the
+        unkilled run under greedy and seeded sampling alike.
+
+        With a ``deadline_s`` and ``EngineConfig.shed`` on, admission is
+        deadline-aware: when queue backlog ÷ observed service rate says the
+        deadline cannot be met, the request is REJECTED with
+        ``ray_tpu.exceptions.OverloadedError`` (``retry_after_s`` attached)
+        instead of queued as doomed work.
+        """
         params = params or SamplingParams()
         if params.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if deadline_s is not None:
+            import math
+
+            # json.loads happily produces NaN/Infinity; a non-finite
+            # deadline would make every "now >= deadline" reap check False
+            # forever and poison the stream-timeout arithmetic downstream
+            if not math.isfinite(deadline_s):
+                raise ValueError(f"deadline_s must be finite, got {deadline_s}")
+        if len(resume_tokens) > params.max_tokens:
+            raise ValueError(
+                f"resume_tokens ({len(resume_tokens)}) exceeds max_tokens "
+                f"({params.max_tokens})"
+            )
         total = len(prompt) + params.max_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -242,15 +300,82 @@ class LLMEngine:
                 f"(num_blocks={self.pool.cfg.num_blocks}, block 0 reserved)"
             )
         deadline = time.time() + deadline_s if deadline_s is not None else None
-        req = Request(prompt, params, deadline=deadline)
+        req = Request(prompt, params, deadline=deadline, resume_tokens=resume_tokens)
         _events.record(
             "llm.submit", request_id=req.trace_id, engine_req=req.id,
             prompt_len=len(prompt), max_tokens=params.max_tokens,
+            resumed=len(req.out),
         )
+        # a resume that already satisfies its stopping condition finishes
+        # without touching the scheduler: the previous replica died between
+        # delivering the final token and the stream's "done" sentinel
+        done_reason = None
+        if req.out and req.out[-1] in params.stop_token_ids:
+            done_reason = FINISH_STOP
+        elif len(req.out) >= params.max_tokens:
+            done_reason = FINISH_LENGTH
+        if done_reason is not None:
+            req.state = FINISHED
+            req.finish_reason = done_reason
+            _events.record(
+                "llm.finish", request_id=req.trace_id, engine_req=req.id,
+                reason=done_reason, tokens_out=len(req.out),
+            )
+            req.stream.put(("done", done_reason))
+            return req
         with self._lock:
+            if self.cfg.shed and deadline_s is not None:
+                est = self._estimate_completion_s_locked(
+                    params.max_tokens - len(req.out)
+                )
+                if est is not None and est > deadline_s:
+                    from ray_tpu.exceptions import OverloadedError
+
+                    retry_after = max(0.1, round(est - deadline_s, 2))
+                    _events.record(
+                        "llm.shed", request_id=req.trace_id,
+                        engine_req=req.id, estimate_s=round(est, 3),
+                        deadline_s=deadline_s, retry_after_s=retry_after,
+                    )
+                    _metrics()["shed"].inc()
+                    raise OverloadedError(
+                        f"engine overloaded: estimated completion in "
+                        f"{est:.2f}s exceeds the {deadline_s:.2f}s deadline "
+                        f"(backlog at {self._rate:.1f} tokens/s)",
+                        retry_after_s=retry_after,
+                    )
             self._requests[req.id] = req
             self.scheduler.add(req)
+            # liveness beat: raise the pending count so the watchdog sees
+            # the new work, and if the engine was IDLE until now, restart
+            # the age clock — the stall timer must measure "work waited
+            # this long", not "the engine was idle this long before work
+            # arrived" (a stale timestamp here false-paged the stall SLO)
+            t, prev_pending = self._beat
+            self._beat = (
+                time.monotonic() if prev_pending == 0 else t,
+                self.scheduler.num_running + self.scheduler.num_waiting,
+            )
         return req
+
+    def _estimate_completion_s_locked(self, new_tokens: int) -> Optional[float]:
+        """Seconds until a request needing ``new_tokens`` more tokens would
+        finish, from the backlog of promised-but-ungenerated tokens and the
+        observed service rate. None (no shedding evidence) when there is no
+        backlog or no measured rate — an EMPTY engine never sheds, whatever
+        a stale rate says (it will finish a lone request as fast as it can;
+        the estimate only means something when the request must wait its
+        turn behind real work that keeps the rate sample fresh)."""
+        rate = self._rate
+        if rate <= 1e-6:
+            return None
+        backlog = sum(
+            max(r.params.max_tokens - len(r.out), 0)
+            for r in list(self.scheduler.waiting) + self.scheduler.running
+        )
+        if backlog <= 0:
+            return None
+        return (backlog + new_tokens) / rate
 
     def cancel(self, req_id: str) -> bool:
         """Flag a request for cancellation; the next step reaps it (frees
@@ -266,21 +391,61 @@ class LLMEngine:
             return self.scheduler.has_work()
 
     def stream_tokens(self, req: Request, timeout: float = 60.0) -> Iterator[int]:
-        """Yield the request's tokens as the engine produces them."""
+        """Yield the request's tokens as the engine produces them.
+
+        A timeout raises ``EngineStalledError`` (a ``TimeoutError``
+        subclass) carrying the stall diagnosis — last-step age, queue
+        depth, and KV utilization — gathered WITHOUT the engine lock, so
+        the diagnosis works precisely when the step loop is wedged holding
+        it."""
         import queue as _q
 
         while True:
             try:
                 kind, val = req.stream.get(timeout=timeout)
             except _q.Empty:
-                raise TimeoutError(
+                from ray_tpu.llm.watchdog import EngineStalledError
+
+                age, pending = self.progress()
+                kv = self.pool.utilization()
+                _events.record(
+                    "llm.watchdog.stall", request_id=req.trace_id,
+                    engine_req=req.id, source="stream_tokens",
+                    last_step_age_s=round(age, 3), queue_depth=pending,
+                    kv_utilization=round(kv, 4), timeout_s=timeout,
+                )
+                raise EngineStalledError(
                     f"no token from {req.id} within {timeout}s "
-                    f"(state={req.state})"
+                    f"(state={req.state})",
+                    last_step_age_s=age,
+                    queue_depth=pending,
+                    kv_utilization=kv,
                 ) from None
             if kind == "token":
                 yield val
             else:
                 return
+
+    def progress(self) -> tuple[float, int]:
+        """(seconds since the last completed step tick, pending work at
+        that tick) — lock-free, safe to call while a step is wedged."""
+        t, pending = self._beat
+        return time.monotonic() - t, pending
+
+    def start_watchdog(self):
+        """Start (once) the engine watchdog thread — stall detection,
+        deadline/cancel reaping that works around a wedged step loop, and
+        the KV-pool leak audit (``llm.watchdog`` module doc). Serve
+        replicas call this; bare engines may too."""
+        if self._watchdog is None:
+            from ray_tpu.llm.watchdog import EngineWatchdog
+
+            self._watchdog = EngineWatchdog(
+                self,
+                stall_deadline_s=self.cfg.watchdog_stall_s,
+                interval_s=self.cfg.watchdog_interval_s,
+            ).start()
+        return self._watchdog
 
     def generate(
         self,
@@ -341,6 +506,7 @@ class LLMEngine:
                 "steps": self._step_n,
                 "tokens_generated": self._tokens_generated,
                 "preemptions": self._preemptions,
+                "service_rate_tokens_per_s": self._rate,
             }
             if self._drafter is not None:
                 s["spec_proposed"] = self._spec_proposed
@@ -368,6 +534,7 @@ class LLMEngine:
             sched = self.scheduler
             if not sched.has_work():
                 self._publish_gauges()
+                self._beat = (time.monotonic(), 0)
                 return False
             self._step_n += 1
             m = _metrics()
@@ -401,17 +568,27 @@ class LLMEngine:
                 k: r for k, r in self._requests.items() if not r.finished
             }
             self._publish_gauges()
+            self._beat = (
+                time.monotonic(), sched.num_running + sched.num_waiting
+            )
             return did or sched.has_work()
 
     # -- internals (all called under the lock) -----------------------------
 
-    def _reap(self) -> None:
+    def _reap(self) -> int:
+        """Finish cancelled and deadline-blown requests (lock held). Also
+        the watchdog's locked reap path — ONE copy of the doomed-request
+        predicate. Returns how many were finished."""
         now = time.time()
+        n = 0
         for req in list(self.scheduler.waiting) + self.scheduler.running:
             if req.cancelled.is_set():
                 self.scheduler.finish(req, FINISH_CANCELLED)
+                n += 1
             elif req.deadline is not None and now >= req.deadline:
                 self.scheduler.finish(req, FINISH_DEADLINE)
+                n += 1
+        return n
 
     def _prefill_one(self) -> bool:
         """One chunk for the oldest admission still prefilling."""
@@ -664,3 +841,24 @@ class LLMEngine:
         if done > self._finished_published:
             m["finished"].inc(done - self._finished_published)
             self._finished_published = done
+        # service-rate EWMA for deadline-aware admission: sampled at most
+        # twice a second so one burst step doesn't whipsaw the estimate.
+        # Only GENERATING windows update the average — an idle window is
+        # not evidence of slowness, it is no evidence at all, so going
+        # idle RESETS the rate (decaying it instead leaves a tiny stale
+        # rate that would inflate estimates and spuriously shed the first
+        # requests of the next burst).
+        now = time.monotonic()
+        t0, n0 = self._rate_mark
+        if now - t0 >= 0.5:
+            new_tokens = self._tokens_generated - n0
+            if new_tokens > 0:
+                inst = new_tokens / (now - t0)
+                self._rate = (
+                    inst if self._rate <= 0 else 0.7 * self._rate + 0.3 * inst
+                )
+            elif not self.scheduler.has_work():
+                self._rate = 0.0
+            # work pending but zero tokens this window (long prefill,
+            # compile): keep the last measured rate
+            self._rate_mark = (now, self._tokens_generated)

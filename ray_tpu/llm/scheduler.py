@@ -69,13 +69,24 @@ class SamplingParams:
 
 class Request:
     """One generation request; carries its own stream queue so a serve
-    replica thread can iterate tokens while the engine thread steps."""
+    replica thread can iterate tokens while the engine thread steps.
+
+    ``resume_tokens`` is the mid-stream-failover handshake (RESILIENCE.md):
+    tokens a PREVIOUS replica already generated and delivered for this
+    request before dying. They pre-fold into ``out`` exactly like a
+    preemption's recompute — the re-prefill replays prompt + out to rebuild
+    the cache, generation continues at output index ``len(out)``, and the
+    per-token PRNG keys (``models.sampling``: fold_in(seed, output index))
+    make the continuation token-identical to the unkilled run. Only NEW
+    tokens are streamed; the resumed prefix counts toward ``max_tokens``.
+    """
 
     def __init__(
         self,
         prompt: list[int],
         params: SamplingParams,
         deadline: Optional[float] = None,  # absolute time.time() cutoff
+        resume_tokens: tuple = (),
     ):
         if not prompt:
             raise ValueError("prompt must contain at least one token")
@@ -91,7 +102,8 @@ class Request:
         self.arrival_t = time.time()
         self.state = WAITING
         self.finish_reason: Optional[str] = None
-        self.out: list[int] = []
+        self.out: list[int] = [int(t) for t in resume_tokens]
+        self.resumed_from = len(self.out)  # output index generation restarts at
         self.prefill_pos = 0          # prompt tokens already in the cache
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None

@@ -31,6 +31,16 @@ free from the same sort.
 Convention: ``temperature <= 0`` means greedy (argmax) for that row —
 the PRNG key is still consumed uniformly so a batch mixing greedy and
 sampled rows stays deterministic per-row regardless of its neighbors.
+
+The (seed, absolute output index) keying is ALSO the serve plane's
+mid-stream-failover guarantee (RESILIENCE.md): a replica that dies
+mid-stream is replaced by re-submitting prompt + delivered tokens
+(``LLMEngine.submit(resume_tokens=...)``), and because the token at
+output index ``i`` depends only on ``(seed, i, prefix)`` — never on
+which replica, verification window, or failover attempt produced it —
+the resumed stream is token-identical to the unkilled run, under greedy
+and seeded sampling alike. Any future sampling change MUST preserve
+this: key by absolute output position, not by step/window/attempt.
 """
 
 from __future__ import annotations

@@ -210,8 +210,11 @@ class Algorithm:
         weights (reference: EnvRunnerGroup._restored_workers path)."""
         try:
             ray_tpu.kill(self._runner_actors[index])
-        except Exception:
-            pass
+        except Exception as e:
+            from ray_tpu._private.log_util import warn_throttled
+
+            # usually already dead (that's why it's being replaced)
+            warn_throttled("rl algorithm: runner kill", e)
         cls = ray_tpu.remote(EnvRunner)
         kw = self._runner_kwargs()
         kw["worker_index"] = index
@@ -233,7 +236,13 @@ class Algorithm:
                     state = ray_tpu.get(other.get_connector_state.remote(), timeout=10)
                     actor.set_connector_state.remote(state)
                     break
-                except Exception:
+                except Exception as e:
+                    from ray_tpu._private.log_util import warn_throttled
+
+                    # this donor may be dead too — try the next survivor,
+                    # but don't let every-donor-failing go unreported (the
+                    # new runner would restart with cold normalizer state)
+                    warn_throttled("rl algorithm: connector-state clone", e)
                     continue
         self._runner_actors[index] = actor
 
@@ -274,11 +283,15 @@ class Algorithm:
         return result
 
     def stop(self):
+        from ray_tpu._private.log_util import warn_throttled
+
         for a in self._runner_actors:
             try:
                 ray_tpu.kill(a)
-            except Exception:
-                pass
+            except Exception as e:
+                # best-effort teardown, but leaking runner actors on every
+                # stop must not be silent
+                warn_throttled("rl algorithm: runner kill", e)
         lg = getattr(self, "learner_group", None)
         if lg is not None:
             lg.shutdown()

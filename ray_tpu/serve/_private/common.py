@@ -55,6 +55,22 @@ class DeploymentConfig:
     #: Reference: the reference proxy routes typed protos only
     #: (serve/_private/proxy.py:542); this is the no-codegen analog.
     grpc_codec: str = "bytes"
+    #: mid-stream failover contract (RESILIENCE.md): the name of a keyword
+    #: argument the deployment's streaming methods accept that carries the
+    #: items a previous replica already produced. When set, a streaming
+    #: call whose replica dies is re-submitted to a fresh replica with
+    #: ``<stream_resume_arg>=[items delivered so far]`` and the stream
+    #: RESUMES in place instead of erroring — the deployment must continue
+    #: from (not re-emit) the resumed prefix. None = streams fail over by
+    #: erroring (callers retry whole requests).
+    stream_resume_arg: Optional[str] = None
+    #: companion to ``stream_resume_arg``: the name of a RELATIVE-seconds
+    #: deadline kwarg. On failover the handle re-submits with this kwarg
+    #: REDUCED by the time already spent, so the client's declared wait
+    #: budget spans the whole request, not each attempt (a deadline that
+    #: reset on every replica death would let failovers extend it
+    #: indefinitely).
+    stream_deadline_arg: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -210,6 +210,38 @@ class ServeController:
     def get_pull_count(self) -> int:
         return self.replica_pulls
 
+    def get_stream_resume_arg(self, deployment_name: str) -> Optional[tuple]:
+        """The deployment's mid-stream-failover contract —
+        ``(stream_resume_arg, stream_deadline_arg)`` — or None when streams
+        are not resumable. Routers cache this once per handle; it never
+        changes for a deployed spec."""
+        with self._lock:
+            state = self._deployments.get(deployment_name)
+            if state is None:
+                return None
+            cfg = state.spec.config
+            if cfg.stream_resume_arg is None:
+                return None
+            return (cfg.stream_resume_arg, cfg.stream_deadline_arg)
+
+    def get_replica_actor_ids(
+        self, deployment_name: Optional[str] = None
+    ) -> dict[str, list[str]]:
+        """deployment -> [replica actor id hex, ...] for every (or one)
+        deployment — the serve-plane chaos killer targets these."""
+        with self._lock:
+            out: dict[str, list[str]] = {}
+            for name, state in self._deployments.items():
+                if deployment_name is not None and name != deployment_name:
+                    continue
+                ids = []
+                for r in state.replicas:
+                    aid = getattr(r.actor, "_actor_id", None)
+                    if aid is not None:
+                        ids.append(aid.hex() if isinstance(aid, bytes) else str(aid))
+                out[name] = ids
+            return out
+
     def get_version(self) -> int:
         return self._version
 

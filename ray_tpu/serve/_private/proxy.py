@@ -194,6 +194,30 @@ class _RefResolver:
             pass  # loop already closed (proxy stopping)
 
 
+def _error_status(exc) -> tuple[int, list[tuple[str, str]]]:
+    """HTTP status + extra headers for a request-path failure. 429 carries
+    ``Retry-After`` (seconds, ceil'd — the header is integer-valued) from
+    the shedding layer's estimate of when capacity frees up."""
+    from ray_tpu.exceptions import OverloadedError
+
+    import math
+
+    cause = getattr(exc, "cause", None)
+    if isinstance(exc, OverloadedError) or isinstance(cause, OverloadedError):
+        # the shedding layer's estimate rides retry_after_s — on the raw
+        # error directly, or on .cause when the error crossed an actor
+        # boundary (RayTaskError's as_instanceof_cause carries the original
+        # in .cause but not its attributes)
+        retry_s = getattr(exc, "retry_after_s", None)
+        if retry_s is None:
+            retry_s = getattr(cause, "retry_after_s", 1.0)
+        retry_after = max(1, math.ceil(retry_s))
+        return 429, [("retry-after", str(retry_after))]
+    if isinstance(exc, KeyError):
+        return 404, []
+    return 500, []
+
+
 def _parse_payload(body: bytes, ctype: str):
     """JSON stays JSON; anything else arrives as raw bytes (reference: the
     ASGI proxy hands the body through; JSON is a convenience)."""
@@ -279,7 +303,48 @@ class ProxyActor:
             self._handles[app] = ent
         return ent
 
-    def _route(self, app: str, payload, request_id: str):
+    #: longest the capacity probe will wait for a slot before declaring
+    #: overload, however generous the deadline — a capacity drought this
+    #: long with every replica at its admission cap IS overload, and
+    #: backpressuring the patient client (429 + Retry-After, they retry)
+    #: beats silently parking unbounded queue depth in the router
+    _SHED_PROBE_MAX_S = 2.0
+
+    def _shed_if_doomed(self, handle, app: str, deadline_s, request_id: str):
+        """Proxy-side deadline-aware admission (RESILIENCE.md): a request
+        that declares a deadline (``x-deadline-s`` header) and cannot get
+        an admission slot within a probe window scaled to that deadline
+        (half of it, capped at ``_SHED_PROBE_MAX_S``) is rejected with
+        429/Retry-After instead of parking in pick() behind work that
+        outlives it. A momentary full house at steady load clears within
+        the probe and admits normally — only a sustained drought sheds.
+        Requests without a deadline queue as before; an unknown replica
+        set (cold router) never sheds."""
+        if deadline_s is None:
+            return
+        budget = min(max(deadline_s, 0.0) * 0.5, self._SHED_PROBE_MAX_S)
+        deadline = time.monotonic() + budget
+        while True:
+            free = handle.free_capacity()
+            if free is None or free > 0:
+                return
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        from ray_tpu.exceptions import OverloadedError
+
+        _events.record(
+            "proxy.shed", request_id=request_id, app=app,
+            deadline_s=deadline_s, probe_s=round(budget, 3),
+        )
+        raise OverloadedError(
+            f"all {app!r} replicas held their admission caps for "
+            f"{budget:.2f}s and the request carries a {deadline_s}s "
+            "deadline",
+            retry_after_s=1.0,
+        )
+
+    def _route(self, app: str, payload, request_id: str, deadline_s=None):
         """Dispatch pool (ONE hop per request): route lookup + admission/
         pick may block. Returns ("stream", None) for streaming apps, else
         ("unary", un-settled DeploymentResponse) — the slot stays held until
@@ -291,12 +356,13 @@ class ProxyActor:
             handle, streaming = self._handle_for(app)
             if streaming:
                 return "stream", None
+            self._shed_if_doomed(handle, app, deadline_s, request_id)
             with _tracing.span("proxy_route", app=app):
                 return "unary", handle.remote(payload)
 
     def _run_stream(self, app: str, payload, loop, q: "asyncio.Queue",
                     cancel: threading.Event, window: threading.Semaphore,
-                    request_id: str = ""):
+                    request_id: str = "", deadline_s=None):
         """Dedicated thread per stream (long-lived by nature — must not
         occupy the dispatch pool): iterates the streaming generator with a
         bounded chunk window and stops (disposing the remote stream) when
@@ -313,6 +379,7 @@ class ProxyActor:
                 {"request_id": request_id} if request_id else None
             )
             handle, _ = self._handle_for(app)
+            self._shed_if_doomed(handle, app, deadline_s, request_id)
             gen = handle.options(stream=True).remote(payload)
             for item in gen:
                 if isinstance(item, (bytes, bytearray, memoryview)):
@@ -376,11 +443,12 @@ class ProxyActor:
             await writer.drain()
 
     async def _respond(self, writer, conn, code: int, body, ctype=None,
-                       request_id: str = ""):
+                       request_id: str = "", extra_headers=()):
         data, default_ctype = _encode_body(body)
         headers = [
             ("content-type", ctype or default_ctype),
             ("content-length", str(len(data))),
+            *extra_headers,
         ]
         if request_id:
             # clients correlate their response with `obs req <id>` by this
@@ -390,7 +458,7 @@ class ProxyActor:
         await self._send(writer, conn, h11.EndOfMessage())
 
     async def _respond_stream(self, writer, conn, app: str, payload, loop,
-                              request_id: str = ""):
+                              request_id: str = "", deadline_s=None):
         """Chunked transfer: h11 frames chunks automatically when no
         content-length is declared. Errors after the header cannot become a
         second response — truncate the stream (close) like the reference."""
@@ -399,7 +467,7 @@ class ProxyActor:
         window = threading.Semaphore(_STREAM_WINDOW)
         threading.Thread(
             target=self._run_stream,
-            args=(app, payload, loop, q, cancel, window, request_id),
+            args=(app, payload, loop, q, cancel, window, request_id, deadline_s),
             name="proxy-stream",
             daemon=True,
         ).start()
@@ -407,7 +475,7 @@ class ProxyActor:
             first_kind, first_val = await q.get()
             window.release()
             if first_kind == "error":
-                code = 404 if isinstance(first_val, KeyError) else 500
+                code, extra = _error_status(first_val)
                 _count_request(code)
                 _events.record(
                     "proxy.response", request_id=request_id, status=code,
@@ -415,7 +483,7 @@ class ProxyActor:
                 )
                 await self._respond(
                     writer, conn, code, {"error": repr(first_val)},
-                    request_id=request_id,
+                    request_id=request_id, extra_headers=extra,
                 )
                 return False
             headers = [
@@ -476,6 +544,18 @@ class ProxyActor:
                 # chains) or mint one; it rides the task specs downstream
                 # and echoes back in the response header
                 rid = headers.get("x-request-id") or _tracing.new_request_id()
+                # deadline-aware shedding opt-in: a client that can't use a
+                # late response declares how long it will wait. Hostile
+                # values (nan/inf/negative — float() accepts them all) are
+                # ignored rather than fed into probe-loop arithmetic.
+                import math
+
+                try:
+                    deadline_s = float(headers["x-deadline-s"])
+                    if not math.isfinite(deadline_s) or deadline_s <= 0:
+                        deadline_s = None
+                except (KeyError, ValueError):
+                    deadline_s = None
                 t_req = time.time()
                 _events.record(
                     "proxy.request", request_id=rid, app=app,
@@ -484,11 +564,13 @@ class ProxyActor:
                 try:
                     payload = _parse_payload(body, headers.get("content-type", ""))
                     kind, resp = await loop.run_in_executor(
-                        self._dispatch_pool, self._route, app, payload, rid
+                        self._dispatch_pool, self._route, app, payload, rid,
+                        deadline_s,
                     )
                     if kind == "stream":
                         ok = await self._respond_stream(
-                            writer, conn, app, payload, loop, request_id=rid
+                            writer, conn, app, payload, loop, request_id=rid,
+                            deadline_s=deadline_s,
                         )
                         if ok:
                             # failures already recorded proxy.response /
@@ -512,23 +594,20 @@ class ProxyActor:
                             dur_s=round(time.time() - t_req, 6),
                         )
                         await self._respond(writer, conn, 200, result, request_id=rid)
-                except KeyError as e:
-                    _count_request(404)
-                    _events.record("proxy.response", request_id=rid, status=404)
-                    await self._respond(
-                        writer, conn, 404, {"error": str(e)}, request_id=rid
-                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001
-                    _count_request(500)
+                    code, extra = _error_status(e)
+                    _count_request(code)
                     _events.record(
-                        "proxy.response", request_id=rid, status=500,
-                        error=repr(e),
+                        "proxy.response", request_id=rid, status=code,
+                        error=repr(e) if code != 404 else str(e),
                     )
                     try:
                         await self._respond(
-                            writer, conn, 500, {"error": repr(e)}, request_id=rid
+                            writer, conn, code,
+                            {"error": str(e) if code == 404 else repr(e)},
+                            request_id=rid, extra_headers=extra,
                         )
                     except h11.LocalProtocolError:
                         return  # headers already sent (stream): just close

@@ -93,11 +93,16 @@ def deployment(
     health_check_period_s: float = 1.0,
     graceful_shutdown_timeout_s: float = 10.0,
     grpc_codec: str = "bytes",
+    stream_resume_arg: Optional[str] = None,
+    stream_deadline_arg: Optional[str] = None,
 ) -> Union[Deployment, Callable[..., Deployment]]:
     """Reference: ``serve/api.py:246``. ``num_replicas="auto"`` enables
     autoscaling with defaults. ``grpc_codec`` sets the gRPC ingress payload
     contract: "bytes" (verbatim passthrough, default), "pickle" (opt-in for
-    trusted Python clients), or "json"."""
+    trusted Python clients), or "json". ``stream_resume_arg`` names the
+    kwarg that makes streaming calls RESUMABLE across replica death
+    (``DeploymentConfig.stream_resume_arg``; serve.llm sets
+    ``"resume_tokens"``)."""
     from ray_tpu.serve._private.grpc_proxy import CODECS
 
     if grpc_codec not in CODECS:
@@ -122,6 +127,8 @@ def deployment(
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {},
             grpc_codec=grpc_codec,
+            stream_resume_arg=stream_resume_arg,
+            stream_deadline_arg=stream_deadline_arg,
         )
         return Deployment(cls, name or getattr(target, "__name__", "deployment"), cfg)
 

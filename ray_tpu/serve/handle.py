@@ -130,6 +130,9 @@ class _Router:
         self._real_version = -1  # last version actually seen from the
         # controller — unlike _version it is never reset by drop(), so
         # exclusion bookkeeping survives cache invalidation
+        # mid-stream failover contract, fetched from the controller once
+        # per router (False = not yet fetched; None = deployment has none)
+        self._resume_arg: "object" = False
 
     def _controller(self):
         import ray_tpu
@@ -257,6 +260,43 @@ class _Router:
     def _replica_key(handle):
         return getattr(handle, "_actor_id", None) or id(handle)
 
+    def stream_contract(self):
+        """The deployment's mid-stream-failover contract —
+        ``(resume_arg, deadline_arg)`` or None (RESILIENCE.md) — cached
+        after one controller RPC."""
+        if self._resume_arg is False:
+            import ray_tpu
+
+            try:
+                got = ray_tpu.get(
+                    self._controller().get_stream_resume_arg.remote(
+                        self.deployment_name
+                    ),
+                    timeout=30,
+                )
+                self._resume_arg = tuple(got) if got is not None else None
+            except Exception:
+                return None  # controller briefly unreachable: retry next call
+        return self._resume_arg
+
+    def free_capacity(self) -> Optional[int]:
+        """Admission slots open across live replicas right now — the
+        proxy's deadline-aware shed probe. None when the replica set is
+        unknown (never shed on no evidence)."""
+        with self._lock:
+            if not self._replicas:
+                return None
+            live = [
+                i
+                for i in range(len(self._replicas))
+                if self._replica_key(self._replicas[i]) not in self._excluded
+            ]
+            if not live:
+                return None
+            return sum(
+                max(0, self._max_ongoing - self._inflight[i]) for i in live
+            )
+
     def mark_failed(self, replica):
         """Exclude a replica this router saw die — routing fails over NOW,
         before the controller's health check notices."""
@@ -281,30 +321,59 @@ class StreamingDeploymentResponse:
     """Iterates a streaming deployment call's items as they are produced
     (reference: serve's streaming DeploymentResponse over ASGI). Wraps the
     ObjectRefGenerator from ``num_returns="streaming"``; the router's
-    in-flight slot is held until the stream is exhausted or closed."""
+    in-flight slot is held until the stream is exhausted or closed.
 
-    def __init__(self, gen, router: "_Router", replica_idx: int, replica=None):
+    Mid-stream failover (RESILIENCE.md): when the deployment declares a
+    ``stream_resume_arg``, ``resume`` is a callable re-submitting the
+    request to a fresh replica with the items delivered so far — on
+    replica death the iterator journals what it already yielded, fails
+    over, and CONTINUES yielding from the successor stream in place, so
+    the consumer sees one uninterrupted, token-exact stream. Without a
+    resume contract, replica death raises (the pre-existing behavior)."""
+
+    def __init__(self, gen, router: "_Router", replica_idx: int, replica=None,
+                 resume=None):
         self._gen = gen
         self._router = router
         self._replica_idx = replica_idx
         self._replica = replica
+        self._resume = resume  # callable(items so far) -> successor response
         self._done = False
 
     def __iter__(self):
         import ray_tpu
         from ray_tpu.exceptions import RayActorError
 
+        cur = self
+        # items yielded since the CURRENT attempt began; the journal of
+        # earlier attempts lives in the resume closure's kwargs (each
+        # failover bakes its prefix into the next call's resume kwarg, so
+        # re-journaling it here would double-count)
+        emitted: list = []
         try:
-            for ref in self._gen:
-                yield ray_tpu.get(ref, timeout=60)
-        except RayActorError:
-            # replica died mid-stream: tell the router NOW so new requests
-            # fail over immediately (mirrors DeploymentResponse.result)
-            if self._replica is not None:
-                self._router.mark_failed(self._replica)
-            raise
+            while True:
+                try:
+                    for ref in cur._gen:
+                        item = ray_tpu.get(ref, timeout=60)
+                        emitted.append(item)
+                        yield item
+                    return
+                except RayActorError:
+                    # replica died mid-stream: tell the router NOW so new
+                    # requests fail over immediately (mirrors
+                    # DeploymentResponse.result)
+                    if cur._replica is not None:
+                        cur._router.mark_failed(cur._replica)
+                    else:
+                        cur._router.drop()
+                    if cur._resume is None:
+                        raise  # no resume contract / budget exhausted
+                    nxt = cur._resume(list(emitted))
+                    cur.close()
+                    cur = nxt
+                    emitted = []
         finally:
-            self.close()
+            cur.close()
 
     def close(self) -> None:
         if not self._done:
@@ -331,28 +400,35 @@ class DeploymentHandle:
         deployment_name: str,
         _model_id: Optional[str] = None,
         _stream: bool = False,
+        _resume: bool = True,
     ):
         self.deployment_name = deployment_name
         self._router: Optional[_Router] = None
         self._model_id = _model_id
         self._stream = _stream
+        self._resume = _resume
 
     def options(
         self,
         *,
         multiplexed_model_id: Optional[str] = None,
         stream: Optional[bool] = None,
+        resume: Optional[bool] = None,
     ) -> "DeploymentHandle":
         """A view of this handle with request options (reference:
         ``handle.options(multiplexed_model_id=..., stream=...)``). The view
         SHARES the router (in-flight accounting stays coherent).
         ``stream=True`` makes ``.remote()`` return a
         StreamingDeploymentResponse yielding items as the replica's
-        generator produces them."""
+        generator produces them. ``resume=False`` opts a streaming call out
+        of mid-stream failover even when the deployment declares a
+        ``stream_resume_arg`` (replica death then raises, the pre-resume
+        behavior)."""
         view = DeploymentHandle(
             self.deployment_name,
             _model_id=multiplexed_model_id if multiplexed_model_id is not None else self._model_id,
             _stream=self._stream if stream is None else stream,
+            _resume=self._resume if resume is None else resume,
         )
         view._router = self._get_router()
         return view
@@ -363,18 +439,25 @@ class DeploymentHandle:
             "deployment_name": self.deployment_name,
             "_model_id": self._model_id,
             "_stream": self._stream,
+            "_resume": self._resume,
         }
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
         self._model_id = state.get("_model_id")
         self._stream = state.get("_stream", False)
+        self._resume = state.get("_resume", True)
         self._router = None
 
     def _get_router(self) -> _Router:
         if self._router is None:
             self._router = _Router(self.deployment_name)
         return self._router
+
+    def free_capacity(self) -> Optional[int]:
+        """Open admission slots across live replicas (None = replica set
+        unknown) — the proxy's deadline-aware shed probe."""
+        return self._get_router().free_capacity()
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._remote("__call__", args, kwargs)
@@ -405,6 +488,34 @@ class DeploymentHandle:
             if _retries > 0
             else None
         )
+        # mid-stream failover: when the deployment declares a resume kwarg,
+        # the streaming response journals delivered items and re-submits to
+        # a fresh replica on death — the next attempt's resume kwarg carries
+        # this attempt's kwarg prefix plus everything newly delivered, so
+        # repeated failovers chain without re-sending or double-counting
+        resume = None
+        if self._stream and self._resume and _retries > 0:
+            contract = router.stream_contract()
+            if contract is not None:
+                resume_arg, deadline_arg = contract
+                prior = list(kwargs.get(resume_arg) or ())
+                t_attempt = time.monotonic()
+
+                def resume(emitted, _r=_retries):
+                    kw = dict(kwargs)
+                    kw[resume_arg] = prior + list(emitted)
+                    # the client's deadline budget spans the WHOLE request:
+                    # hand the successor only what remains of this
+                    # attempt's relative deadline (chained failovers each
+                    # decrement their own attempt's spend, so the budget
+                    # composes instead of resetting per replica death)
+                    if deadline_arg is not None:
+                        d = kw.get(deadline_arg)
+                        if isinstance(d, (int, float)) and d > 0:
+                            spent = time.monotonic() - t_attempt
+                            kw[deadline_arg] = max(0.05, d - spent)
+                    return self._remote(method, args, kw, _r - 1)
+
         for attempt in range(3):
             replica, idx = router.pick(model_id=self._model_id)
             try:
@@ -412,7 +523,9 @@ class DeploymentHandle:
                     gen = replica.handle_request_streaming.options(
                         num_returns="streaming"
                     ).remote(method, args, kwargs, self._model_id)
-                    return StreamingDeploymentResponse(gen, router, idx, replica=replica)
+                    return StreamingDeploymentResponse(
+                        gen, router, idx, replica=replica, resume=resume
+                    )
                 if self._model_id:
                     ref = replica.handle_request.remote(
                         method, args, kwargs, self._model_id

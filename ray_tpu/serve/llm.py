@@ -96,6 +96,10 @@ class LLMDeployment:
             cfg, params, engine_config,
             draft_model_cfg=draft_model_cfg, draft_params=draft_params,
         )
+        # per-engine watchdog (llm.watchdog): stall detection, wedge-proof
+        # deadline/cancel reaping, KV-pool leak audit — a serving replica
+        # always runs one
+        self._engine.start_watchdog()
         if warmup:
             # compile the prefill/decode/verify/sampling jits NOW, inside
             # replica creation, so serve.run's readiness gate covers
@@ -122,10 +126,43 @@ class LLMDeployment:
         stop_token_ids: tuple = (),
         seed: int = 0,
         deadline_s: Optional[float] = None,
+        resume_tokens: tuple = (),
     ):
         """Streaming generation: yields token ids as the engine samples
         them. Call with ``handle.options(stream=True)``; the generator
-        shape is what routes this through ``handle_request_streaming``."""
+        shape is what routes this through ``handle_request_streaming``.
+
+        ``prompt`` may also be a dict — ``{"prompt": [...], "max_tokens":
+        32, "temperature": 0.8, ...}`` — so HTTP callers (whose JSON body
+        arrives as the single positional payload) can set sampling knobs
+        and a ``deadline_s``; dict keys override the keyword defaults.
+
+        ``resume_tokens`` is the mid-stream failover journal (the handle
+        layer injects it via the deployment's ``stream_resume_arg``
+        contract): tokens a dead replica already delivered. Generation
+        continues AFTER them, token-identically (``LLMEngine.submit``).
+        A dict payload's own ``resume_tokens`` (a client-side resume)
+        concatenates with the handle-injected journal.
+        """
+        if isinstance(prompt, dict):
+            body = dict(prompt)
+            try:
+                prompt = body.pop("prompt")
+            except KeyError:
+                raise ValueError("dict payload requires a 'prompt' key") from None
+            max_tokens = body.pop("max_tokens", max_tokens)
+            temperature = body.pop("temperature", temperature)
+            top_k = body.pop("top_k", top_k)
+            top_p = body.pop("top_p", top_p)
+            stop_token_ids = body.pop("stop_token_ids", stop_token_ids)
+            seed = body.pop("seed", seed)
+            deadline_s = body.pop("deadline_s", deadline_s)
+            # client-resumed prefix first, then the failover journal
+            resume_tokens = tuple(body.pop("resume_tokens", ())) + tuple(
+                resume_tokens
+            )
+            if body:
+                raise ValueError(f"unknown payload keys: {sorted(body)}")
         params = SamplingParams(
             max_tokens=max_tokens,
             temperature=temperature,
@@ -134,7 +171,10 @@ class LLMDeployment:
             stop_token_ids=tuple(stop_token_ids),
             seed=seed,
         )
-        req = self._engine.submit([int(t) for t in prompt], params, deadline_s)
+        req = self._engine.submit(
+            [int(t) for t in prompt], params, deadline_s,
+            resume_tokens=tuple(int(t) for t in resume_tokens),
+        )
         # with an explicit deadline the engine itself ends the stream at
         # the deadline; the get-timeout only needs to outlast it
         timeout = (
@@ -189,6 +229,7 @@ def build_llm_app(
     max_ongoing_requests: int = 16,
     autoscaling_config=None,
     name: str = "LLMDeployment",
+    warmup: bool = True,
 ):
     """Bind an ``LLMDeployment`` application (deploy with ``serve.run``).
 
@@ -196,6 +237,15 @@ def build_llm_app(
     ``max_slots`` — the whole point of continuous batching is holding
     more concurrent streams than decode slots and letting the engine's
     queue absorb the difference (queue depth then drives autoscaling).
+
+    ``warmup=True`` (default) compiles inside replica ``__init__`` so the
+    readiness gate covers jit time and first requests stream at
+    steady-state latency. ``warmup=False`` trades that for FAST replica
+    (re)join: a replacement replica becomes routable in seconds and pays
+    compile inside its first request — the right trade when replicas
+    churn (chaos, spot preemption) and a mid-stream failover must find a
+    routable successor before the router's pick deadline, not after a
+    full warmup.
     """
     from ray_tpu.serve.api import deployment
 
@@ -205,7 +255,15 @@ def build_llm_app(
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
         autoscaling_config=autoscaling_config,
+        # mid-stream failover contract: a stream whose replica dies is
+        # re-submitted with resume_tokens=<delivered tokens> and resumes
+        # token-identically; deadline_s is re-submitted MINUS the time
+        # already spent, so failovers never extend a client's declared
+        # wait budget (RESILIENCE.md)
+        stream_resume_arg="resume_tokens",
+        stream_deadline_arg="deadline_s",
     )
     return dep.bind(
-        model=model, model_cfg=model_cfg, engine_config=engine_config, seed=seed
+        model=model, model_cfg=model_cfg, engine_config=engine_config,
+        seed=seed, warmup=warmup,
     )

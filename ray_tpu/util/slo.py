@@ -243,4 +243,20 @@ def default_rules() -> list[SLORule]:
             description="Paged-KV pool pinned at/above the threshold long "
                         "enough that preemption thrash is imminent.",
         ),
+        SLORule(
+            name="engine-stall",
+            metric="llm_watchdog_step_age_s",
+            kind="gauge_threshold",
+            # the watchdog (llm.watchdog) publishes the age of the last
+            # engine step while work is pending, 0 when idle/healthy — a
+            # sustained non-zero age is a wedged step loop, the whole
+            # replica's streams frozen at once
+            threshold=_envf("RAY_TPU_SLO_STALL_S", 30.0),
+            for_s=_envf("RAY_TPU_SLO_STALL_FOR_S", 10.0),
+            resolve_after_s=resolve,
+            labels={"severity": "page"},
+            description="LLM engine step loop has made no progress with "
+                        "work pending — streams are frozen; the watchdog's "
+                        "llm.watchdog.stall event carries the diagnosis.",
+        ),
     ]

@@ -261,6 +261,11 @@ class TestEngine:
 
     def test_deadline_reaps(self, tiny_params, default_engine):
         eng = default_engine
+        # zero the observed service rate: with rate evidence the engine
+        # would SHED this un-meetable deadline at submit (OverloadedError,
+        # tests/test_llm_robustness.py); this test covers the reap path —
+        # a request whose deadline blows after admission
+        eng._rate = 0.0
         req = eng.submit(_prompt(8), SamplingParams(max_tokens=30), deadline_s=0.0)
         eng.step()
         assert req.finished and req.finish_reason == "deadline"
