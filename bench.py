@@ -102,6 +102,7 @@ def main():
         # core microbench first: it is CPU-only and must not run while this
         # process holds the single-tenant TPU tunnel (import jax acquires it)
         core = _section(sections, "core_microbench", _core_microbench) or {}
+        core_obs = _section(sections, "core_obs_ab", _core_obs_ab) or {}
         llm = _section(sections, "llm_serving", _llm_serving_bench) or {}
         fit = _section(sections, "gptj_fit_proof", _gptj_fit_proof) or {}
         train = _section(sections, "train_headline", _train_headline) or {}
@@ -117,6 +118,10 @@ def main():
         # trajectory was lost). Whatever sections completed go out.
         detail = dict(train.get("detail", {}))
         detail["core"] = core
+        if core_obs:
+            # recorder+series ON vs OFF on the task/object hot path — the
+            # attribution probe for the r04 core-plane collapse (ROADMAP)
+            detail["core_obs_ab"] = core_obs
         if llm:
             # continuous-batching serving engine vs sequential static-batch
             # decode under staggered arrivals + speculative-decode
@@ -262,43 +267,99 @@ def _train_headline() -> dict:
     }
 
 
-def _core_microbench() -> dict:
-    """Runtime-core throughput next to the training metric (VERDICT asked
-    for the reference's ray_perf metric names in BENCH reporting). Runs in
-    a subprocess so a runtime-side failure can never cost the headline
-    number; returns {} on any problem."""
+def _run_bench_core(metric: str, extra_args=(), env_overrides=None, timeout=600) -> dict:
+    """Run ``bench_core.py`` in a CPU-only subprocess (it must never touch
+    the single-tenant TPU tunnel) and return the JSON record whose
+    ``metric`` matches — one scaffold for every core section, so the
+    emission protocol / env guards / diagnostics stay in one place.
+    Returns {} on any problem (a runtime-side failure never costs the
+    headline number)."""
     import os
     import subprocess
     import sys
 
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.update(env_overrides or {})
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "bench_core.py"
+            ),
+            *extra_args,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            rec = json.loads(line)
+            if rec.get("metric") == metric:
+                return rec
+    print(
+        f"[bench] bench_core {metric} produced no record (rc={out.returncode}): "
+        f"{out.stderr[-500:]}",
+        file=sys.stderr,
+    )
+    return {}
+
+
+def _core_microbench() -> dict:
+    """Runtime-core throughput next to the training metric (VERDICT asked
+    for the reference's ray_perf metric names in BENCH reporting)."""
+    import sys
+
     try:
-        env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
-        out = subprocess.run(
-            [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_core.py")],
-            capture_output=True,
-            text=True,
-            timeout=600,
-            env=env,
-        )
-        for line in reversed(out.stdout.splitlines()):
-            if line.startswith("{"):
-                rec = json.loads(line)
-                if rec.get("metric") == "core_microbench":
-                    detail = rec.get("detail", {})
-                    if rec.get("env"):
-                        # Contention context (cpu count, loadavg, spin
-                        # canary) so cross-round comparisons of the core
-                        # numbers are interpretable (VERDICT r4 #1a).
-                        detail["_env"] = rec["env"]
-                    return detail
-        print(
-            f"[bench] core microbench produced no metrics (rc={out.returncode}): "
-            f"{out.stderr[-500:]}",
-            file=sys.stderr,
-        )
-        return {}
+        rec = _run_bench_core("core_microbench")
+        detail = rec.get("detail", {})
+        if rec.get("env"):
+            # Contention context (cpu count, loadavg, spin canary) so
+            # cross-round comparisons of the core numbers are
+            # interpretable (VERDICT r4 #1a).
+            detail["_env"] = rec["env"]
+        return detail
     except Exception as e:
         print(f"[bench] core microbench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _core_obs_ab() -> dict:
+    """Observability-overhead A/B on the core task/object hot path
+    (ROADMAP "core-plane throughput regression"): run
+    ``bench_core.py --obs-ab`` twice in subprocesses — flight recorder +
+    metric time-series ON, then OFF (both knobs are import-time, so a
+    fresh process per arm is the only honest A/B) — and report both
+    numbers plus the ON/OFF ratio per microbench.  A ratio well below
+    1.0 says the recorder/series machinery owns that share of the r04
+    collapse; a ratio ≈ 1.0 acquits it.  CPU-only subprocesses for the
+    same tunnel-safety reason as the core microbench."""
+    import sys
+
+    def one_arm(obs_on: bool) -> dict:
+        flag = "1" if obs_on else "0"
+        rec = _run_bench_core(
+            "core_obs_ab", extra_args=("--obs-ab",),
+            env_overrides={"RAY_TPU_EVENTS": flag,
+                           "RAY_TPU_METRICS_SERIES": flag},
+            timeout=300,
+        )
+        return rec.get("detail", {})
+
+    try:
+        on = one_arm(True)
+        off = one_arm(False)
+        if not on or not off:
+            return {}
+        ratios = {
+            k: round(on[k] / off[k], 4)
+            for k in on
+            if k in off and off[k] > 0
+        }
+        return {"obs_on": on, "obs_off": off, "on_over_off_ratio": ratios}
+    except Exception as e:
+        print(f"[bench] core obs A/B failed: {e!r}", file=sys.stderr)
         return {}
 
 

@@ -217,5 +217,62 @@ def main() -> list[dict]:
     return results
 
 
+def obs_ab_main() -> dict:
+    """Core-plane observability A/B probe (``--obs-ab``): the
+    task-submission + object-plane microbenchmarks most implicated in the
+    BENCH_r04 4-8x core collapse, run ONCE under whatever
+    ``RAY_TPU_EVENTS`` / ``RAY_TPU_METRICS_SERIES`` the caller exported.
+    ``bench.py`` invokes this twice — obs ON and obs OFF — in separate
+    subprocesses (both knobs are read at import) and emits both numbers
+    in the round JSON, so the recorder/series share of any core
+    regression is attributable from the bench record alone, before the
+    dedicated perf PR profiles the hot path."""
+    import ray_tpu
+    from ray_tpu._private import events as _events
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    def tasks_sync(n=600):
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        return n
+
+    def tasks_async(n=3000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    small = np.zeros(1024, np.uint8)
+
+    def put_small(n=500):
+        for _ in range(n):
+            ray_tpu.put(small)
+        return n
+
+    results = [
+        timeit("obs_ab_tasks_sync", tasks_sync),
+        timeit("obs_ab_tasks_async", tasks_async),
+        timeit("obs_ab_put_calls_1kb", put_small),
+    ]
+    ray_tpu.shutdown()
+    rec = {
+        "metric": "core_obs_ab",
+        "events_enabled": _events.enabled(),
+        "series_enabled": os.environ.get("RAY_TPU_METRICS_SERIES", "1")
+        not in ("0", "false", "off"),
+        "detail": {r["metric"]: r["value"] for r in results},
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--obs-ab" in sys.argv:
+        obs_ab_main()
+    else:
+        main()

@@ -36,8 +36,9 @@ from ray_tpu import exceptions  # noqa: F401
 __version__ = "0.1.0"
 
 _LAZY_SUBMODULES = {
-    "train", "tune", "data", "serve", "rl", "util", "collective", "parallel",
-    "ops", "models", "accelerators", "cluster_utils", "dag", "workflow", "internal",
+    "train", "tune", "data", "serve", "rl", "rlhf", "util", "collective",
+    "parallel", "ops", "models", "accelerators", "cluster_utils", "dag",
+    "workflow", "internal",
 }
 
 

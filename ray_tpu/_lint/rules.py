@@ -362,12 +362,12 @@ class HostSyncInHotLoop(Rule):
     description = (
         "Device-to-host synchronization (.block_until_ready(), "
         "jax.device_get, np.asarray/np.array on device values) inside a "
-        "loop in a hot path (ops/, train/, rl/). Each call stalls the XLA "
-        "pipeline; hoist out of the loop or batch with jax.device_get on "
-        "the whole pytree once."
+        "loop in a hot path (ops/, train/, rl/, rlhf/). Each call stalls "
+        "the XLA pipeline; hoist out of the loop or batch with "
+        "jax.device_get on the whole pytree once."
     )
 
-    HOT_DIRS = ("ops", "train", "rl")
+    HOT_DIRS = ("ops", "train", "rl", "rlhf")
     _SYNC_NAMES = {
         "jax.device_get",
         "np.asarray",

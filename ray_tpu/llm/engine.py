@@ -227,6 +227,11 @@ class LLMEngine:
         # admission: backlog ÷ rate estimates a new request's completion
         self._rate = 0.0
         self._rate_mark = (time.monotonic(), 0)  # (t, tokens_generated)
+        # learner→engine weight sync (rlhf.sync): monotonic version of the
+        # params the jitted steps currently close over; update_weights
+        # hot-swaps between step() iterations and every submit stamps the
+        # version it was admitted under onto its Request
+        self._weights_version = 0
         # model-length cap: paged table width, and the learned positional
         # table for GPT (rotary GPT-J has no absolute cap of its own)
         self.max_model_len = cache_cfg.max_seq_len
@@ -301,6 +306,10 @@ class LLMEngine:
             )
         deadline = time.time() + deadline_s if deadline_s is not None else None
         req = Request(prompt, params, deadline=deadline, resume_tokens=resume_tokens)
+        # staleness stamp: the policy version this trajectory STARTS under
+        # (a mid-generation hot-swap is fine — per-token behavior logprobs
+        # stay exact regardless; the stamp drives the rlhf admission gate)
+        req.weights_version = self._weights_version
         _events.record(
             "llm.submit", request_id=req.trace_id, engine_req=req.id,
             prompt_len=len(prompt), max_tokens=params.max_tokens,
@@ -344,6 +353,10 @@ class LLMEngine:
                         f"(backlog at {self._rate:.1f} tokens/s)",
                         retry_after_s=retry_after,
                     )
+            # re-stamp under the lock: a push that landed between Request
+            # construction and admission is the version this trajectory
+            # actually starts decoding under
+            req.weights_version = self._weights_version
             self._requests[req.id] = req
             self.scheduler.add(req)
             # liveness beat: raise the pending count so the watchdog sees
@@ -389,6 +402,68 @@ class LLMEngine:
     def has_work(self) -> bool:
         with self._lock:
             return self.scheduler.has_work()
+
+    @property
+    def weights_version(self) -> int:
+        """Version of the params the engine currently decodes with."""
+        return self._weights_version
+
+    def update_weights(self, params: dict, version: Optional[int] = None) -> int:
+        """Hot-swap the model parameters between step() iterations WITHOUT
+        draining in-flight requests (the rlhf learner→engine sync path;
+        ``rlhf.sync.apply_weight_update`` wraps this for chunked
+        object-plane pushes).
+
+        The new pytree must match the current one's structure and leaf
+        shapes/dtypes — then the jitted step functions never retrace (they
+        cache on shape, and params are a traced argument, not a captured
+        constant). Leaves are ``device_put`` once here so steady-state
+        steps don't re-upload host arrays every call. In-flight requests
+        simply continue under the new weights from their next step —
+        exactly the semantics async RL wants (and their per-token behavior
+        logprobs were captured at sample time, so off-policy correction
+        stays exact across the swap).
+
+        ``version`` must be monotonically increasing (default: current+1).
+        Returns the installed version.
+        """
+        import jax
+
+        new = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        t0 = time.perf_counter()
+        with self._lock:
+            old_struct = jax.tree_util.tree_structure(self.runner.params)
+            new_struct = jax.tree_util.tree_structure(new)
+            if old_struct != new_struct:
+                raise ValueError(
+                    "update_weights pytree structure mismatch: "
+                    f"{new_struct} != {old_struct}"
+                )
+            for a, b in zip(
+                jax.tree_util.tree_leaves(self.runner.params),
+                jax.tree_util.tree_leaves(new),
+            ):
+                if a.shape != b.shape or a.dtype != b.dtype:
+                    raise ValueError(
+                        f"update_weights leaf mismatch: {b.shape}/{b.dtype} "
+                        f"!= {a.shape}/{a.dtype} (a retrace mid-traffic is "
+                        "never acceptable)"
+                    )
+            if version is None:
+                version = self._weights_version + 1
+            if version < self._weights_version:
+                raise ValueError(
+                    f"weights_version must not go backwards: "
+                    f"{version} < {self._weights_version}"
+                )
+            self.runner.params = new
+            self._weights_version = version
+            in_flight = self.scheduler.num_running + self.scheduler.num_waiting
+        _events.record(
+            "llm.weights_update", version=version,
+            apply_s=round(time.perf_counter() - t0, 6), in_flight=in_flight,
+        )
+        return version
 
     def stream_tokens(self, req: Request, timeout: float = 60.0) -> Iterator[int]:
         """Yield the request's tokens as the engine produces them.
@@ -482,7 +557,7 @@ class LLMEngine:
                 self._spec_skip = 0
                 self._spec_backoff = 0
                 S, W = self.cfg.max_slots, self.cfg.spec_k + 1
-                k, v, _, _ = self.runner.verify_step(
+                k, v, _, _, _ = self.runner.verify_step(
                     self.pool.k, self.pool.v,
                     np.zeros((S, W), np.int32),
                     np.zeros(S, np.int32),
@@ -507,6 +582,7 @@ class LLMEngine:
                 "tokens_generated": self._tokens_generated,
                 "preemptions": self._preemptions,
                 "service_rate_tokens_per_s": self._rate,
+                "weights_version": self._weights_version,
             }
             if self._drafter is not None:
                 s["spec_proposed"] = self._spec_proposed
@@ -617,18 +693,16 @@ class LLMEngine:
         if req.prefill_pos >= len(full):
             # final chunk: its last position's logits seed generation
             p = req.params
-            tok = int(
-                self._sample1(
-                    last_logits[None, :],
-                    np.asarray([p.seed & 0xFFFFFFFF], np.uint32),
-                    np.asarray([len(req.out)], np.int32),
-                    np.asarray([p.temperature], np.float32),
-                    np.asarray([p.top_k], np.int32),
-                    np.asarray([p.top_p], np.float32),
-                )[0]
+            tok, lp = self._sample1(
+                last_logits[None, :],
+                np.asarray([p.seed & 0xFFFFFFFF], np.uint32),
+                np.asarray([len(req.out)], np.int32),
+                np.asarray([p.temperature], np.float32),
+                np.asarray([p.top_k], np.int32),
+                np.asarray([p.top_p], np.float32),
             )
             req.state = RUNNING
-            self._emit(req, tok)
+            self._emit(req, int(tok[0]), float(lp[0]))
         return True
 
     def _grow_all(self, extra: int = 0) -> None:
@@ -681,18 +755,20 @@ class LLMEngine:
             # engine loop thread
             seeds[i] = p.seed & 0xFFFFFFFF
             counters[i] = len(req.out)
-        k, v, nxt = self.runner.decode_step(
+        k, v, nxt, logp = self.runner.decode_step(
             self.pool.k, self.pool.v, tokens, positions, tables,
             temp, top_k, top_p, seeds, counters,
         )
         self.pool.k, self.pool.v = k, v
-        nxt = np.asarray(nxt)  # ONE host sync for the whole batch
+        import jax
+
+        nxt, logp = jax.device_get((nxt, logp))  # ONE host sync for the batch
         for i, req in active:
             _events.record(
                 "llm.decode", request_id=req.trace_id, engine_req=req.id,
                 step=self._step_n, token=int(nxt[i]),
             )
-            self._emit(req, int(nxt[i]))
+            self._emit(req, int(nxt[i]), float(logp[i]))
         _metrics()["tokens_per_step"].set(len(active))
         return True
 
@@ -752,12 +828,12 @@ class LLMEngine:
             top_p[i] = p.top_p
             seeds[i] = p.seed & 0xFFFFFFFF
             counters[i] = len(req.out)
-        k, v, n_acc, out = self.runner.verify_step(
+        k, v, n_acc, out, out_lp = self.runner.verify_step(
             self.pool.k, self.pool.v, tokens, base_pos, tables,
             temp, top_k, top_p, seeds, counters,
         )
         self.pool.k, self.pool.v = k, v
-        n_acc, out = jax.device_get((n_acc, out))  # ONE host sync
+        n_acc, out, out_lp = jax.device_get((n_acc, out, out_lp))  # ONE host sync
         emitted = 0
         accepted = 0
         for i, req in active:
@@ -768,7 +844,7 @@ class LLMEngine:
                 step=self._step_n, proposed=kd, accepted=n,
             )
             for j in range(n + 1):
-                self._emit(req, int(out[i, j]))
+                self._emit(req, int(out[i, j]), float(out_lp[i, j]))
                 emitted += 1
                 if req.finished:
                     # stop token / length cap hit inside the window: the
@@ -807,9 +883,10 @@ class LLMEngine:
         )
         return True
 
-    def _emit(self, req: Request, tok: int) -> None:
-        """Record one sampled token: stream it, update latency metrics,
-        finish on stop token / max_tokens / model-length cap."""
+    def _emit(self, req: Request, tok: int, logp: float = float("nan")) -> None:
+        """Record one sampled token: stream it, capture its behavior
+        logprob, update latency metrics, finish on stop token /
+        max_tokens / model-length cap."""
         now = time.time()
         m = _metrics()
         if req.first_token_t is None:
@@ -823,6 +900,7 @@ class LLMEngine:
             m["itl"].observe(now - req.last_token_t)
         req.last_token_t = now
         req.out.append(tok)
+        req.out_logprobs.append(logp)
         req.stream.put(("token", tok))
         self._tokens_generated += 1
         m["tokens"].inc()

@@ -13,7 +13,9 @@ Three entry shapes, each jitted once per engine:
   heterogeneous sequences (different lengths, block tables, sampling
   params).  Inactive slots carry position 0 and an all-trash block table;
   their writes land in reserved block 0 and their sampled tokens are
-  discarded host-side.
+  discarded host-side.  Every sampled token returns with its behavior
+  logprob (``models.sampling`` logprob convention — the RLHF capture
+  path), as does every verified window position below.
 * ``prefill_chunk`` — (chunk,) tokens of ONE sequence at positions
   ``start..start+chunk`` (tail-padded; padded positions scatter to the
   trash block).  Returns the last valid position's logits so the final
@@ -47,7 +49,10 @@ import jax.numpy as jnp
 from ray_tpu._private import events as _events
 from ray_tpu.models.gpt import GPTConfig, _layernorm
 from ray_tpu.models.gptj import GPTJConfig
-from ray_tpu.models.sampling import sample_tokens, speculative_verify
+from ray_tpu.models.sampling import (
+    sample_tokens_logprobs,
+    speculative_verify_logprobs,
+)
 from ray_tpu.ops.paged_attention import (
     paged_attention,
     paged_prefill_attention_xla,
@@ -87,13 +92,19 @@ def _scatter_kv(pool_l: jax.Array, vals: jax.Array, phys: jax.Array, off: jax.Ar
 def _sample_rows(logits, seeds, counters, temp, top_k, top_p):
     """Per-row sampling with per-request determinism: row i's key derives
     from (seeds[i], counters[i]) only, so a request draws the same tokens
-    no matter which slot or step it lands in."""
+    no matter which slot or step it lands in.  Returns (tokens (n,),
+    logprobs (n,)) — the chosen-token behavior logprob rides along free
+    (``models.sampling`` module doc)."""
     keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(
         seeds, counters
     )
-    one = lambda lg, k, t, kk, pp: sample_tokens(
-        lg[None, :], k, t[None], kk[None], pp[None]
-    )[0]
+
+    def one(lg, k, t, kk, pp):
+        tok, lp = sample_tokens_logprobs(
+            lg[None, :], k, t[None], kk[None], pp[None]
+        )
+        return tok[0], lp[0]
+
     return jax.vmap(one)(logits, keys, temp, top_k, top_p)
 
 
@@ -101,8 +112,8 @@ def _verify_rows(logits, draft, seeds, counters, temp, top_k, top_p):
     """Per-slot speculative verification (same per-request determinism as
     ``_sample_rows``: window token i keys off (seed, counter + i)).
     logits: (S, W, V); draft: (S, W-1).  Returns (n_accepted (S,),
-    out_tokens (S, W))."""
-    return jax.vmap(speculative_verify)(
+    out_tokens (S, W), out_logprobs (S, W))."""
+    return jax.vmap(speculative_verify_logprobs)(
         logits, draft, seeds, counters, temp, top_k, top_p
     )
 
@@ -189,8 +200,13 @@ class PagedModelRunner:
             out = out + layer["attn_out"]["bias"].astype(dt)
         return out
 
-    def _embed(self, tokens, positions):
-        cfg, params = self.cfg, self.params
+    def _embed(self, params, tokens, positions):
+        # params flows through the TRACED argument, never self.params: the
+        # jitted executables cache across weight hot-swaps
+        # (LLMEngine.update_weights), so anything read from self here would
+        # bake the ORIGINAL weights into the compiled step as constants —
+        # a swap would then silently update only the layer stack
+        cfg = self.cfg
         dt = jnp.dtype(cfg.dtype)
         x = params["embed"]["tokens"][tokens].astype(dt)
         if self.arch == "gpt":
@@ -199,8 +215,7 @@ class PagedModelRunner:
             x = x + params["embed"]["pos"][pos].astype(dt)
         return x
 
-    def _lm_head(self, h):
-        params = self.params
+    def _lm_head(self, params, h):
         h = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
         logits = h.astype(jnp.float32) @ params["lm_head"]["kernel"]
         if self.arch == "gptj":
@@ -225,7 +240,7 @@ class PagedModelRunner:
     ):
         cfg = self.cfg
         bs = self.block_size
-        x = self._embed(tokens, positions)  # (S, d)
+        x = self._embed(params, tokens, positions)  # (S, d)
         phys = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
         off = positions % bs
         lengths = positions + 1
@@ -260,9 +275,9 @@ class PagedModelRunner:
         x, (k_pool, v_pool) = jax.lax.scan(
             one_layer, x, (params["blocks"], k_pool, v_pool)
         )
-        logits = self._lm_head(x)  # (S, V)
-        nxt = _sample_rows(logits, seeds, counters, temp, top_k, top_p)
-        return k_pool, v_pool, nxt
+        logits = self._lm_head(params, x)  # (S, V)
+        nxt, logp = _sample_rows(logits, seeds, counters, temp, top_k, top_p)
+        return k_pool, v_pool, nxt, logp
 
     def decode_step(self, k_pool, v_pool, tokens, positions, tables,
                     temp, top_k, top_p, seeds, counters):
@@ -296,7 +311,7 @@ class PagedModelRunner:
         tmax = tables.shape[1]
         positions = base_pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
         pos_flat = positions.reshape(-1)                     # (S*W,)
-        x = self._embed(tokens.reshape(-1), pos_flat)        # (S*W, d)
+        x = self._embed(params, tokens.reshape(-1), pos_flat)  # (S*W, d)
         # window positions can provisionally run past the table's reach
         # (a slot one emit away from the model-length cap still feeds k
         # drafts): clamp the gather and scatter the overflow to trash —
@@ -343,11 +358,11 @@ class PagedModelRunner:
         x, (k_pool, v_pool) = jax.lax.scan(
             one_layer, x, (params["blocks"], k_pool, v_pool)
         )
-        logits = self._lm_head(x).reshape(S, W, -1)          # (S, W, V)
-        n_acc, out = _verify_rows(
+        logits = self._lm_head(params, x).reshape(S, W, -1)  # (S, W, V)
+        n_acc, out, logp = _verify_rows(
             logits, tokens[:, 1:], seeds, counters, temp, top_k, top_p
         )
-        return k_pool, v_pool, n_acc, out
+        return k_pool, v_pool, n_acc, out, logp
 
     def verify_step(self, k_pool, v_pool, tokens, base_pos, tables,
                     temp, top_k, top_p, seeds, counters):
@@ -377,7 +392,7 @@ class PagedModelRunner:
         bs = self.block_size
         positions = start + jnp.arange(chunk, dtype=jnp.int32)
         valid = jnp.arange(chunk) < n_valid
-        x = self._embed(tokens, positions)  # (chunk, d)
+        x = self._embed(params, tokens, positions)  # (chunk, d)
         phys = jnp.where(valid, table[positions // bs], 0)  # padded → trash
         off = positions % bs
         runner = self
@@ -412,7 +427,7 @@ class PagedModelRunner:
             one_layer, x, (params["blocks"], k_pool, v_pool)
         )
         last = x[jnp.maximum(n_valid - 1, 0)]  # (d,)
-        logits = self._lm_head(last[None, :])[0]  # (V,)
+        logits = self._lm_head(params, last[None, :])[0]  # (V,)
         return k_pool, v_pool, logits
 
     def prefill_chunk(self, k_pool, v_pool, tokens, start, n_valid, table):

@@ -103,6 +103,16 @@ class Request:
         self.state = WAITING
         self.finish_reason: Optional[str] = None
         self.out: list[int] = [int(t) for t in resume_tokens]
+        # behavior logprob of out[i] under the distribution it was sampled
+        # from (models.sampling logprob convention), aligned 1:1 with
+        # ``out``. Resumed tokens were sampled by a DEAD replica — their
+        # logprobs are unknown here and recorded as NaN; every token this
+        # engine generates gets the exact captured value (the rlhf rollout
+        # path reads this list).
+        self.out_logprobs: list[float] = [float("nan")] * len(self.out)
+        # engine weights_version at submit (rlhf weight-sync staleness
+        # accounting; None until the engine stamps it)
+        self.weights_version: Optional[int] = None
         self.resumed_from = len(self.out)  # output index generation restarts at
         self.prefill_pos = 0          # prompt tokens already in the cache
         self.first_token_t: Optional[float] = None

@@ -41,6 +41,24 @@ which replica, verification window, or failover attempt produced it —
 the resumed stream is token-identical to the unkilled run, under greedy
 and seeded sampling alike. Any future sampling change MUST preserve
 this: key by absolute output position, not by step/window/attempt.
+
+Logprob capture (the ``ray_tpu.rlhf`` behavior-policy contract): every
+sampling entry point has a ``*_logprobs`` variant that also returns the
+log-probability of the CHOSEN token under the exact distribution it was
+drawn from — ``log_softmax`` of the temperature-scaled, top-k/top-p
+masked logits for sampled rows, ``log_softmax`` of the raw logits at the
+argmax for greedy rows (a point mass has no useful density; the raw
+model confidence is the informative number and is what a scorer
+recomputing ``log_softmax`` at the greedy id gets). ``token_logprobs``
+is the matching SCORING entry point: given token ids instead of a PRNG
+key it returns the same quantity, so an RLHF learner can evaluate its
+current policy on rollout tokens in exactly the units the engine
+captured behavior logprobs in — the importance ratio
+``exp(current - behavior)`` is then exact, whatever sampling knobs the
+rollout used. Since both are pure functions of (logits, knobs, id), the
+captured value at output index ``i`` inherits the failover contract
+above: identical across spec-decode window alignments, resumes, and
+replicas.
 """
 
 from __future__ import annotations
@@ -78,6 +96,50 @@ def _filtered_logits(logits, temp, kk, pp):
     )
 
 
+def _broadcast_knobs(b, temperature, top_k, top_p):
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    return temp, kk, pp
+
+
+def _chosen_logprob(logits, masked, temp, tok):
+    """Module-doc logprob convention: sampled rows score under the
+    filtered distribution they drew from, greedy rows under the raw
+    logits (log_softmax at the argmax id)."""
+    idx = tok[:, None]
+    lp_sampled = jnp.take_along_axis(
+        jax.nn.log_softmax(masked, axis=-1), idx, axis=-1
+    )[:, 0]
+    lp_greedy = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), idx, axis=-1
+    )[:, 0]
+    return jnp.where(temp > 0.0, lp_sampled, lp_greedy)
+
+
+def sample_tokens_logprobs(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """``sample_tokens`` that also returns each chosen token's logprob
+    ((batch,) float32) under the module-doc convention — the behavior
+    logprob the RLHF importance ratio needs, captured at zero extra
+    model cost (the softmax already exists on device)."""
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    temp, kk, pp = _broadcast_knobs(b, temperature, top_k, top_p)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _filtered_logits(logits, temp, kk, pp)
+    keys = jax.random.split(key, b)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    tok = jnp.where(temp > 0.0, sampled, greedy)
+    return tok, _chosen_logprob(logits, masked, temp, tok)
+
+
 def sample_tokens(
     logits: jax.Array,
     key: jax.Array,
@@ -93,17 +155,81 @@ def sample_tokens(
     ``key`` is one PRNG key for the whole call — rows draw from
     per-row splits so the same (key, row) pair always reproduces.
     """
+    return sample_tokens_logprobs(logits, key, temperature, top_k, top_p)[0]
+
+
+def token_logprobs(
+    logits: jax.Array,
+    tokens: jax.Array,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+) -> jax.Array:
+    """Score GIVEN token ids under the exact sampling distribution:
+    (batch, vocab) logits + (batch,) int32 ids -> (batch,) float32
+    logprobs, same convention as ``sample_tokens_logprobs`` (module doc).
+
+    This is the learner-side half of the RLHF importance ratio: the
+    engine captures behavior logprobs with ``sample_tokens_logprobs``;
+    the learner recomputes current-policy logprobs of the same tokens
+    with THIS function and the same knobs, so ``exp(cur - behavior)`` is
+    an exact density ratio. Differentiable w.r.t. ``logits`` (the
+    top-k/top-p mask is treated as constant, standard straight-through
+    practice for truncated-sampling objectives).
+
+    A token the filter masked out scores ``-inf``-like (≈ -1e30 shifted
+    by the log-normalizer): it had probability 0 under the behavior
+    distribution, which is exactly what the ratio math wants.
+    """
     logits = logits.astype(jnp.float32)
     b, v = logits.shape
-    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
-    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
-    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
-
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp, kk, pp = _broadcast_knobs(b, temperature, top_k, top_p)
     masked = _filtered_logits(logits, temp, kk, pp)
-    keys = jax.random.split(key, b)
-    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
-    return jnp.where(temp > 0.0, sampled, greedy)
+    return _chosen_logprob(logits, masked, temp, tokens.astype(jnp.int32))
+
+
+def speculative_verify_logprobs(
+    logits: jax.Array,
+    draft: jax.Array,
+    seed: jax.Array,
+    counter: jax.Array,
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+):
+    """``speculative_verify`` that also returns (w,) logprobs of the
+    emitted tokens — window index ``i``'s entry scores ``out[i]`` under
+    the exact per-index filtered distribution (same convention as
+    ``sample_tokens_logprobs``), so spec-decode rollouts capture behavior
+    logprobs identical to the plain decode path's (verification already
+    computes every per-index distribution; reading the chosen density is
+    free). Validity mirrors ``out``: entries past ``n_accepted`` are
+    conditioned on a rejected prefix and must be discarded with their
+    tokens."""
+    logits = logits.astype(jnp.float32)
+    w, v = logits.shape
+    kd = w - 1
+    temp, kk, pp = _broadcast_knobs(w, temperature, top_k, top_p)
+
+    base = jax.random.PRNGKey(seed)
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(base, counter + i)
+    )(jnp.arange(w, dtype=jnp.int32))  # (w, 2)
+
+    def one(lg, key, t, k_, p_):
+        tok, lp = sample_tokens_logprobs(
+            lg[None, :], key, t[None], k_[None], p_[None]
+        )
+        return tok[0], lp[0]
+
+    out, logp = jax.vmap(one)(logits, keys, temp, kk, pp)  # (w,), (w,)
+
+    if kd:
+        accept = draft == out[:kd]
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32))).astype(jnp.int32)
+    else:  # empty draft (w == 1): the window is just the bonus position
+        n_acc = jnp.int32(0)
+    return n_acc, out, logp
 
 
 def speculative_verify(
@@ -140,26 +266,7 @@ def speculative_verify(
     (seed, i, prefix): identical to non-speculative decode, whatever the
     drafter proposed and wherever the window boundaries fell.
     """
-    logits = logits.astype(jnp.float32)
-    w, v = logits.shape
-    kd = w - 1
-    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (w,))
-    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (w,))
-    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (w,))
-
-    base = jax.random.PRNGKey(seed)
-    keys = jax.vmap(
-        lambda i: jax.random.fold_in(base, counter + i)
-    )(jnp.arange(w, dtype=jnp.int32))  # (w, 2)
-    out = jax.vmap(
-        lambda lg, key, t, k_, p_: sample_tokens(
-            lg[None, :], key, t[None], k_[None], p_[None]
-        )[0]
-    )(logits, keys, temp, kk, pp)  # (w,) int32
-
-    if kd:
-        accept = draft == out[:kd]
-        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32))).astype(jnp.int32)
-    else:  # empty draft (w == 1): the window is just the bonus position
-        n_acc = jnp.int32(0)
+    n_acc, out, _ = speculative_verify_logprobs(
+        logits, draft, seed, counter, temperature, top_k, top_p
+    )
     return n_acc, out

@@ -1,0 +1,143 @@
+"""Versioned learner→engine weight sync over the object plane.
+
+The disaggregated async-RL wiring (LlamaRL / MindSpeed RL shape): the
+learner PUBLISHES a ``WeightUpdate`` — the parameter pytree flattened
+and chunked through ``ray_tpu.put`` — and every rollout engine APPLIES
+it between ``step()`` iterations via ``LLMEngine.update_weights``,
+without draining in-flight generation. Publication and application are
+deliberately decoupled:
+
+* ``publish_weights`` runs once per learner step on the driver/learner
+  side; chunking keeps each object under ``chunk_bytes`` so the shared
+  store never sees one giant blob, and the SAME refs fan out to every
+  engine (one serialization, N consumers — the object plane's whole
+  point).
+* ``apply_weight_update`` runs inside each consumer (rollout actor OR
+  serve replica — ``serve.llm.LLMDeployment.update_weights`` calls this
+  exact function, so raw-actor engines and serve-hosted engines share
+  one code path) and is the only place that fetches the chunks.
+
+Every trajectory an engine generates is stamped with the engine's
+``weights_version`` at submit; the learner's staleness gate
+(``rlhf.algorithm``) compares those stamps against its own version.
+
+Observability: ``rlhf.sync.push`` / ``rlhf.sync.apply`` flight-recorder
+events carry version + latency; ``rlhf_sync_seconds{phase=...}`` and
+``rlhf_sync_bytes`` make push/apply cost visible to ``obs series``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu._private import events as _events
+from ray_tpu.rlhf.metrics import rlhf_metrics
+
+
+@dataclasses.dataclass
+class WeightUpdate:
+    """One published parameter version. Pickles small: the arrays live in
+    the object store behind ``chunk_refs``; this manifest carries only
+    the version, the tree structure, and the refs."""
+
+    version: int
+    treedef: Any                 # jax PyTreeDef (pickles)
+    chunk_refs: list             # ObjectRefs, each -> list[np.ndarray]
+    chunk_sizes: list            # leaves per chunk (reassembly check)
+    nbytes: int
+    created_t: float
+
+    @property
+    def num_leaves(self) -> int:
+        return sum(self.chunk_sizes)
+
+
+def publish_weights(params, version: int, chunk_bytes: int = 8 << 20) -> WeightUpdate:
+    """Flatten ``params`` and put it into the object plane as ≤
+    ``chunk_bytes`` chunks. ONE ``device_get`` for the whole tree (the
+    learner's params are device arrays; per-leaf pulls would stall the
+    XLA pipeline once per leaf), then greedy chunking in leaf order."""
+    import jax
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    host = [np.asarray(a) for a in jax.device_get(leaves)]
+
+    chunk_refs: list = []
+    chunk_sizes: list = []
+    cur: list = []
+    cur_bytes = 0
+    total = 0
+    for leaf in host:
+        total += leaf.nbytes
+        if cur and cur_bytes + leaf.nbytes > chunk_bytes:
+            chunk_refs.append(ray_tpu.put(cur))
+            chunk_sizes.append(len(cur))
+            cur, cur_bytes = [], 0
+        cur.append(leaf)
+        cur_bytes += leaf.nbytes
+    if cur:
+        chunk_refs.append(ray_tpu.put(cur))
+        chunk_sizes.append(len(cur))
+
+    push_s = time.perf_counter() - t0
+    m = rlhf_metrics()
+    m["sync_s"].observe(push_s, tags={"phase": "push"})
+    m["sync_bytes"].inc(total)
+    m["version"].set(version)
+    _events.record(
+        "rlhf.sync.push", version=version, chunks=len(chunk_refs),
+        bytes=total, push_s=round(push_s, 6),
+    )
+    return WeightUpdate(
+        version=version, treedef=treedef, chunk_refs=chunk_refs,
+        chunk_sizes=chunk_sizes, nbytes=total, created_t=time.time(),
+    )
+
+
+def fetch_params(update: WeightUpdate, timeout: Optional[float] = 120.0):
+    """Materialize the published pytree (one batched get for all chunks)."""
+    import jax
+    import ray_tpu
+
+    chunks = ray_tpu.get(list(update.chunk_refs), timeout=timeout)
+    leaves: list = []
+    for chunk, expect in zip(chunks, update.chunk_sizes):
+        if len(chunk) != expect:
+            raise ValueError(
+                f"weight chunk carries {len(chunk)} leaves, manifest says "
+                f"{expect} (object-plane corruption or version skew)"
+            )
+        leaves.extend(chunk)
+    return jax.tree_util.tree_unflatten(update.treedef, leaves)
+
+
+def apply_weight_update(
+    engine, update, timeout: Optional[float] = 120.0
+) -> int:
+    """Fetch + hot-swap one engine. ``update`` is a ``WeightUpdate`` (the
+    normal push path) or a ``(params, version)`` tuple (tests / local
+    engines that skip the object plane). Returns the installed version;
+    a version the engine already has (duplicate delivery, e.g. a retried
+    push) is applied idempotently — ``LLMEngine.update_weights`` only
+    rejects going BACKWARDS."""
+    t0 = time.perf_counter()
+    if isinstance(update, WeightUpdate):
+        params, version = fetch_params(update, timeout=timeout), update.version
+    else:
+        params, version = update
+    installed = engine.update_weights(params, version)
+    apply_s = time.perf_counter() - t0
+    m = rlhf_metrics()
+    m["sync_s"].observe(apply_s, tags={"phase": "apply"})
+    _events.record(
+        "rlhf.sync.apply", version=installed,
+        apply_s=round(apply_s, 6),
+        in_flight=engine.stats().get("running", 0),
+    )
+    return installed

@@ -194,6 +194,33 @@ class LLMDeployment:
 
     # -- control plane -----------------------------------------------------
 
+    def update_weights(self, update, version=None, timeout: float = 120.0) -> int:
+        """Versioned weight hot-swap — the SAME push path raw actor
+        engines use (``rlhf.sync.apply_weight_update`` →
+        ``LLMEngine.update_weights``): accepts a published
+        ``rlhf.sync.WeightUpdate`` manifest (chunked object-plane refs)
+        or a raw params pytree + ``version``, and applies it between
+        engine steps WITHOUT draining in-flight streams. An RLHF learner
+        can therefore push to serve-hosted inference replicas and
+        dedicated rollout actors with one code path:
+
+            handle.update_weights.remote(weight_update).result()
+
+        Routes like any other handle call (one replica per call); push
+        once per replica — or use ``num_replicas=1`` engines for rollout
+        duty — when every replica must advance."""
+        from ray_tpu.rlhf.sync import WeightUpdate, apply_weight_update
+
+        if not isinstance(update, WeightUpdate):
+            # version=None lets LLMEngine.update_weights bump UNDER its
+            # lock — computing current+1 here would race a concurrent
+            # push into two different param sets sharing one version
+            update = (update, version)
+        return apply_weight_update(self._engine, update, timeout=timeout)
+
+    def weights_version(self) -> int:
+        return self._engine.weights_version
+
     def autoscaling_metrics(self) -> dict:
         """Saturation signals for replica autoscaling: ``queue_depth``
         (admission-bound) and ``kv_utilization`` (memory-bound) on top of

@@ -244,6 +244,24 @@ def default_rules() -> list[SLORule]:
                         "enough that preemption thrash is imminent.",
         ),
         SLORule(
+            name="rlhf-staleness",
+            metric="rlhf_weights_staleness",
+            kind="gauge_threshold",
+            # the async RLHF learner publishes the mean version-age of
+            # every batch it consumes; sustained high staleness means
+            # weight pushes are not landing on the rollout engines
+            # (object-plane backlog, dead rollout actor, learner
+            # outrunning generation) and the importance correction is
+            # carrying more off-policy drift than the trust region wants
+            threshold=_envf("RAY_TPU_SLO_RLHF_STALENESS", 8.0),
+            for_s=_envf("RAY_TPU_SLO_RLHF_STALENESS_FOR_S", 30.0),
+            resolve_after_s=resolve,
+            labels={"severity": "warn"},
+            description="RLHF trajectories consumed by the learner are "
+                        "persistently many weight versions stale — the "
+                        "rollout plane is falling behind the sync push.",
+        ),
+        SLORule(
             name="engine-stall",
             metric="llm_watchdog_step_age_s",
             kind="gauge_threshold",

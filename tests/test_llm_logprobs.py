@@ -1,0 +1,189 @@
+"""Logprob capture (the rlhf behavior-policy contract, models.sampling
+module doc): sampled + greedy decode return logprobs that exactly match
+recomputing log_softmax at the sampled ids, identical with spec decode
+on vs off, and stable across a mid-stream failover resume (the PR-6
+absolute-index PRNG contract extends to logprobs)."""
+
+import math
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine  # noqa: E402
+from ray_tpu.llm.scheduler import SamplingParams  # noqa: E402
+from ray_tpu.models.gpt import GPTConfig, gpt_forward, gpt_init  # noqa: E402
+from ray_tpu.models.sampling import (  # noqa: E402
+    sample_tokens,
+    sample_tokens_logprobs,
+    token_logprobs,
+)
+
+TINY = GPTConfig(
+    vocab_size=32, seq_len=96, d_model=32, n_layers=2, n_heads=2,
+    remat=False, fused_loss=False, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return gpt_init(jax.random.PRNGKey(0), TINY)
+
+
+def _engine(params, **over):
+    cfg = dict(
+        max_slots=2, num_blocks=64, block_size=4, max_blocks_per_seq=16,
+        prefill_chunk=8,
+    )
+    cfg.update(over)
+    return LLMEngine(TINY, params, EngineConfig(**cfg))
+
+
+def _run(engine, prompt, params, resume=()):
+    req = engine.submit(prompt, params, resume_tokens=resume)
+    while not req.finished:
+        if not engine.step():
+            break
+    return req
+
+
+# ---------------------------------------------------------------------------
+# unit: the sampling-layer contract
+# ---------------------------------------------------------------------------
+
+
+class TestSamplingLogprobs:
+    def test_greedy_matches_raw_log_softmax(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (4, 16)) * 3.0
+        tok, lp = sample_tokens_logprobs(logits, jax.random.PRNGKey(2))
+        ref = np.log(
+            np.exp(np.asarray(logits, np.float64))
+            / np.exp(np.asarray(logits, np.float64)).sum(-1, keepdims=True)
+        )
+        am = np.argmax(np.asarray(logits), axis=-1)
+        assert np.array_equal(np.asarray(tok), am)
+        np.testing.assert_allclose(
+            np.asarray(lp), ref[np.arange(4), am], atol=1e-5
+        )
+
+    def test_sampled_matches_filtered_log_softmax(self):
+        """Independent numpy recompute of the filtered distribution:
+        temperature-scale, keep top-k, renormalize — the captured logprob
+        is log_softmax of exactly that."""
+        logits = jax.random.normal(jax.random.PRNGKey(3), (8, 16)) * 2.0
+        temp, k = 1.3, 5
+        tok, lp = sample_tokens_logprobs(
+            logits, jax.random.PRNGKey(4), temperature=temp, top_k=k
+        )
+        scaled = np.asarray(logits, np.float64) / temp
+        for i in range(8):
+            row = scaled[i]
+            keep = np.argsort(-row)[:k]
+            assert int(tok[i]) in keep  # never samples a masked id
+            z = np.exp(row[keep] - row[keep].max())
+            p = z / z.sum()
+            ref = math.log(p[list(keep).index(int(tok[i]))])
+            assert abs(float(lp[i]) - ref) < 1e-5
+
+    def test_token_logprobs_scores_identically(self):
+        """The learner-side scorer returns the same number the sampler
+        captured — for every row, sampled and greedy alike."""
+        logits = jax.random.normal(jax.random.PRNGKey(5), (6, 16))
+        temps = jnp.asarray([0.0, 1.0, 0.7, 0.0, 2.0, 1.0])
+        tok, lp = sample_tokens_logprobs(
+            logits, jax.random.PRNGKey(6), temperature=temps, top_k=4
+        )
+        scored = token_logprobs(logits, tok, temperature=temps, top_k=4)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(scored), atol=1e-6)
+
+    def test_sample_tokens_unchanged_by_capture(self):
+        """The logprob path must not perturb token choice (sample_tokens
+        is the token-identity contract everything else pins against)."""
+        logits = jax.random.normal(jax.random.PRNGKey(7), (5, 16))
+        key = jax.random.PRNGKey(8)
+        t1 = sample_tokens(logits, key, temperature=0.9, top_p=0.8)
+        t2, _ = sample_tokens_logprobs(logits, key, temperature=0.9, top_p=0.8)
+        assert np.array_equal(np.asarray(t1), np.asarray(t2))
+
+    def test_masked_token_scores_filtered_out(self):
+        """Scoring an id the filter excluded reports ~p=0 (the honest
+        behavior-density for a token the policy could not have sampled)."""
+        logits = jnp.asarray([[5.0, 4.0, -1.0, -2.0]])
+        lp = token_logprobs(
+            logits, jnp.asarray([3]), temperature=1.0, top_k=2
+        )
+        assert float(lp[0]) < -1e20
+
+
+# ---------------------------------------------------------------------------
+# engine: capture matches a dense-forward recompute
+# ---------------------------------------------------------------------------
+
+
+def _dense_logprobs(params, prompt, out, temperature=0.0, top_k=0, top_p=1.0):
+    full = list(prompt) + list(out)
+    logits = gpt_forward(TINY, params, jnp.asarray([full], jnp.int32))[0]
+    pos = jnp.asarray([len(prompt) - 1 + i for i in range(len(out))])
+    return np.asarray(
+        token_logprobs(
+            logits[pos], jnp.asarray(out), temperature, top_k, top_p
+        )
+    )
+
+
+class TestEngineCapture:
+    @pytest.mark.parametrize(
+        "sp",
+        [
+            SamplingParams(max_tokens=10),
+            SamplingParams(max_tokens=10, temperature=1.0, seed=5),
+            SamplingParams(max_tokens=10, temperature=0.8, top_k=6, seed=9),
+        ],
+        ids=["greedy", "sampled", "topk"],
+    )
+    def test_matches_dense_recompute(self, tiny_params, sp):
+        eng = _engine(tiny_params)
+        req = _run(eng, [1, 2, 3], sp)
+        assert len(req.out_logprobs) == len(req.out)
+        ref = _dense_logprobs(
+            tiny_params, [1, 2, 3], req.out, sp.temperature, sp.top_k, sp.top_p
+        )
+        np.testing.assert_allclose(req.out_logprobs, ref, atol=2e-4)
+
+    def test_spec_decode_on_vs_off_identical(self, tiny_params):
+        """Spec decode must capture the SAME logprobs the plain path
+        captures — the verify path computes per-index distributions, so
+        the capture rides the same math. Repetitive prompt exercises
+        real acceptance."""
+        prompt = [1, 2, 3, 1, 2, 3, 1, 2, 3]
+        for sp in (
+            SamplingParams(max_tokens=16),
+            SamplingParams(max_tokens=16, temperature=1.0, seed=3),
+        ):
+            plain = _run(_engine(tiny_params), prompt, sp)
+            spec = _run(
+                _engine(tiny_params, spec_k=3), prompt, sp
+            )
+            assert spec.out == plain.out  # existing token-identity contract
+            np.testing.assert_allclose(
+                spec.out_logprobs, plain.out_logprobs, atol=1e-4
+            )
+
+    def test_failover_resume_logprob_stability(self, tiny_params):
+        """Absolute-index contract: resuming from a delivered prefix
+        reproduces the SAME logprobs at every new index; the resumed
+        (dead-replica) prefix reports NaN — unknown, never fabricated."""
+        sp = SamplingParams(max_tokens=12, temperature=1.0, seed=11)
+        orig = _run(_engine(tiny_params), [4, 5, 6], sp)
+        assert len(orig.out) == 12
+        cut = 5
+        resumed = _run(
+            _engine(tiny_params), [4, 5, 6], sp, resume=tuple(orig.out[:cut])
+        )
+        assert resumed.out == orig.out  # token identity (PR 6 contract)
+        assert all(math.isnan(x) for x in resumed.out_logprobs[:cut])
+        np.testing.assert_allclose(
+            resumed.out_logprobs[cut:], orig.out_logprobs[cut:], atol=1e-4
+        )
