@@ -95,6 +95,7 @@ def main():
     sections: dict = {}
     core = {}
     llm = {}
+    prefix = {}
     fit = {}
     train = {}
     silicon = {}
@@ -104,6 +105,7 @@ def main():
         core = _section(sections, "core_microbench", _core_microbench) or {}
         core_obs = _section(sections, "core_obs_ab", _core_obs_ab) or {}
         llm = _section(sections, "llm_serving", _llm_serving_bench) or {}
+        prefix = _section(sections, "llm_prefix", _llm_prefix_bench) or {}
         fit = _section(sections, "gptj_fit_proof", _gptj_fit_proof) or {}
         train = _section(sections, "train_headline", _train_headline) or {}
 
@@ -127,6 +129,10 @@ def main():
             # decode under staggered arrivals + speculative-decode
             # comparison (ray_tpu/llm/bench.py)
             detail["llm_serving"] = llm
+        if prefix:
+            # cross-request prefix cache on the shared-system-prompt
+            # workload: prefill-tokens-computed + warm TTFT, on vs off
+            detail["llm_prefix"] = prefix
         if fit:
             detail["gptj_6b_compiles"] = bool(fit.get("compiles"))
             detail["gptj_6b_fit"] = fit
@@ -376,7 +382,9 @@ def _llm_serving_bench() -> dict:
     try:
         env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
         out = subprocess.run(
-            [sys.executable, "-m", "ray_tpu.llm.bench"],
+            # just the serving benches — the prefix workload has its own
+            # section (_llm_prefix_bench) and must not run twice
+            [sys.executable, "-m", "ray_tpu.llm.bench", "--only", "serving"],
             capture_output=True,
             text=True,
             timeout=600,
@@ -412,6 +420,46 @@ def _llm_serving_bench() -> dict:
         return {}
     except Exception as e:
         print(f"[bench] llm serving bench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _llm_prefix_bench() -> dict:
+    """Cross-request prefix cache on the shared-system-prompt workload
+    (``python -m ray_tpu.llm.bench --only prefix``): N requests with a
+    common 256-token prefix, cache on vs off — prefill tokens computed,
+    warm-request TTFT, token-identity asserted in the subprocess.
+    CPU-only subprocess like the other llm sections."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.llm.bench", "--only", "prefix"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if not line.startswith("{"):
+                continue
+            rec = json.loads(line)
+            if rec.get("metric") == "llm_prefix_cache_warm_ttft_speedup":
+                return {
+                    "warm_ttft_speedup": rec["value"],
+                    **rec.get("detail", {}),
+                }
+        print(
+            f"[bench] llm prefix bench produced no metric (rc={out.returncode}): "
+            f"{out.stderr[-500:]}",
+            file=sys.stderr,
+        )
+        return {}
+    except Exception as e:
+        print(f"[bench] llm prefix bench failed: {e!r}", file=sys.stderr)
         return {}
 
 

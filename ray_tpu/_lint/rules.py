@@ -367,7 +367,7 @@ class HostSyncInHotLoop(Rule):
         "jax.device_get on the whole pytree once."
     )
 
-    HOT_DIRS = ("ops", "train", "rl", "rlhf")
+    HOT_DIRS = ("ops", "train", "rl", "rlhf", "llm")
     _SYNC_NAMES = {
         "jax.device_get",
         "np.asarray",

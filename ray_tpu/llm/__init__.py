@@ -21,5 +21,6 @@ streaming-generator machinery.
 from ray_tpu.llm.cache import CacheConfig, KVBlockPool  # noqa: F401
 from ray_tpu.llm.drafter import NGramDrafter, SmallModelDrafter  # noqa: F401
 from ray_tpu.llm.engine import EngineConfig, LLMEngine  # noqa: F401
+from ray_tpu.llm.prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from ray_tpu.llm.scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from ray_tpu.llm.watchdog import EngineStalledError, EngineWatchdog  # noqa: F401

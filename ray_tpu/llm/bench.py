@@ -15,6 +15,12 @@
   backoff bound on regression).  Both paths must produce byte-identical
   greedy tokens — asserted, or the comparison is comparing different
   work.
+* ``llm_prefix_cache_warm_ttft_speedup`` — the shared-system-prompt
+  workload (N requests with a common 256-token prefix, distinct
+  suffixes) through the prefix cache vs the same engine with the cache
+  off: prefill-tokens-computed and warm-request TTFT are the headline
+  numbers (the production chat regime the cache targets); outputs must
+  be token-identical across the two arms — asserted.
 
 Sized to run on CPU in seconds (the same comparison holds on TPU with
 the real model; the ratio is what travels).  ``--smoke`` shrinks the
@@ -78,7 +84,10 @@ def run_bench() -> dict:
             if now < arr:
                 time.sleep(arr - now)
             toks = decode(params, jnp.asarray([prompt], jnp.int32))
-            out.append(list(np.asarray(toks)[0, PROMPT_LEN:]))
+            # the per-request host sync IS the static baseline being
+            # measured: sequential whole-completion decode was the
+            # pre-ray_tpu.llm serving story this bench compares against
+            out.append(list(np.asarray(toks)[0, PROMPT_LEN:]))  # raylint: disable=RL006
         return time.perf_counter() - t0, out
 
     static_wall, static_out = min(
@@ -260,6 +269,89 @@ def run_spec_bench(smoke: bool = False) -> dict:
     }
 
 
+# -- cross-request prefix cache ----------------------------------------------
+
+PREFIX_SHARED_LEN = 256   # the common system-prompt/few-shot head
+PREFIX_SUFFIX_LEN = 16    # per-request distinct tail
+PREFIX_N = 8
+PREFIX_MAX_TOKENS = 8
+PREFIX_BLOCK = 16
+
+
+def run_prefix_bench(smoke: bool = False) -> dict:
+    """Shared-system-prompt workload: request 0 is COLD (it populates the
+    radix tree), requests 1..N-1 are WARM (their 256-token head matches).
+    Requests run one at a time so each TTFT is a clean prefill+first-step
+    measurement, not a batching artifact.  Reported: prefill tokens
+    actually computed (engine counter) and mean warm TTFT, cache on vs
+    off, with token-identity asserted between the arms."""
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+
+    cfg, params = _spec_model()
+    shared_len = 128 if smoke else PREFIX_SHARED_LEN
+    n_req = 4 if smoke else PREFIX_N
+    rng = np.random.RandomState(7)
+    shared = list(rng.randint(0, cfg.vocab_size, shared_len))
+    prompts = [
+        shared + list(rng.randint(0, cfg.vocab_size, PREFIX_SUFFIX_LEN))
+        for _ in range(n_req)
+    ]
+    total = shared_len + PREFIX_SUFFIX_LEN + PREFIX_MAX_TOKENS
+    bps = -(-(total + 1) // PREFIX_BLOCK)
+
+    def make_engine(cached: bool):
+        e = LLMEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=2, block_size=PREFIX_BLOCK,
+                # room for the resident shared prefix + two live tables
+                num_blocks=2 * bps + shared_len // PREFIX_BLOCK + 4,
+                max_blocks_per_seq=bps, prefill_chunk=32,
+                prefix_cache=cached,
+            ),
+        )
+        e.warmup()
+        return e
+
+    def run(engine):
+        outs, ttfts = [], []
+        p0 = engine.stats()["prefill_tokens_computed"]
+        for prompt in prompts:
+            req = engine.submit(prompt, SamplingParams(max_tokens=PREFIX_MAX_TOKENS))
+            while not req.finished:
+                engine.step()
+            outs.append(list(req.out))
+            ttfts.append(req.first_token_t - req.arrival_t)
+        prefill = engine.stats()["prefill_tokens_computed"] - p0
+        return outs, ttfts, prefill
+
+    on_out, on_ttft, on_prefill = run(make_engine(True))
+    off_out, off_ttft, off_prefill = run(make_engine(False))
+    # prefix reuse must be EXACT — or the TTFT comparison is meaningless
+    assert on_out == off_out, "prefix-cache on/off token mismatch"
+    warm_on = sum(on_ttft[1:]) / max(len(on_ttft) - 1, 1)
+    warm_off = sum(off_ttft[1:]) / max(len(off_ttft) - 1, 1)
+    return {
+        "metric": "llm_prefix_cache_warm_ttft_speedup",
+        "value": round(warm_off / max(warm_on, 1e-9), 3),
+        "unit": "x",
+        "vs_baseline": round(warm_off / max(warm_on, 1e-9), 3),
+        "detail": {
+            "requests": n_req,
+            "shared_prefix_tokens": shared_len,
+            "prefill_tokens_on": int(on_prefill),
+            "prefill_tokens_off": int(off_prefill),
+            "prefill_reduction": round(1.0 - on_prefill / max(off_prefill, 1), 3),
+            "ttft_cold_on_s": round(on_ttft[0], 4),
+            "ttft_warm_on_s": round(warm_on, 4),
+            "ttft_warm_off_s": round(warm_off, 4),
+            "smoke": smoke,
+        },
+    }
+
+
 def main(argv=None) -> list:
     import argparse
 
@@ -268,10 +360,27 @@ def main(argv=None) -> list:
         "--smoke", action="store_true",
         help="shrunken workloads for CI (seconds, looser signal)",
     )
+    ap.add_argument(
+        "--only", choices=("all", "serving", "continuous", "spec", "prefix"),
+        default="all",
+        help="run a subset instead of the full set (bench.py's llm_serving "
+        "section uses --only serving and its llm_prefix section --only "
+        "prefix, so neither pays for the other's workload)",
+    )
     args = ap.parse_args(argv)
+    benches = {
+        "continuous": run_bench,
+        "spec": lambda: run_spec_bench(smoke=args.smoke),
+        "prefix": lambda: run_prefix_bench(smoke=args.smoke),
+    }
+    groups = {
+        "all": list(benches),
+        "serving": ["continuous", "spec"],
+    }
+    names = groups.get(args.only, [args.only])
     records = []
-    for fn in (run_bench, lambda: run_spec_bench(smoke=args.smoke)):
-        rec = fn()
+    for name in names:
+        rec = benches[name]()
         print(json.dumps(rec), flush=True)
         records.append(rec)
     return records

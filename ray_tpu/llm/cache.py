@@ -18,13 +18,27 @@ corrupt live cache and the jitted step needs no data-dependent control
 flow.  Eviction under pressure is mechanism here (``free`` returns a
 sequence's blocks), policy in ``llm.scheduler`` (preempt-youngest,
 recompute on re-admission).
+
+Sharing (``llm.prefix_cache``): every allocated block carries a
+REFERENCE COUNT — one per owning sequence plus one while the prefix
+tree retains it (``cache_retain``/``cache_release``).  ``allocate`` can
+seed a sequence's table with already-resident ``shared`` blocks (the
+matched prefix), and a block returns to the free list only when its
+count reaches zero.  A block whose only reference is the cache's is
+*evictable* — reclaimable capacity the scheduler drains before it
+preempts live requests.  Copy-on-write is split: the LEDGER fork (a
+fresh exclusive block for the divergent tail) happens here, the device
+copy in ``model_runner.fork_blocks``.  Shared blocks are read-only by
+construction — prefill starts past the matched prefix and decode writes
+only at the sequence tail, so no jitted step ever scatters into a
+position a shared block covers.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -77,6 +91,12 @@ class KVBlockPool:
         # LIFO free list of physical block ids; 0 reserved (trash)
         self._free = list(range(cfg.num_blocks - 1, 0, -1))
         self._owned: dict[str, list[int]] = {}
+        # reference counts for every non-free block: one per owning
+        # sequence + one while the prefix tree retains it; a block is
+        # freed only at zero (llm.prefix_cache shares blocks across
+        # sequences, so ownership alone no longer implies exclusivity)
+        self._ref: dict[int, int] = {}
+        self._cache_held: set[int] = set()
 
     # -- capacity ----------------------------------------------------------
 
@@ -90,28 +110,58 @@ class KVBlockPool:
 
     @property
     def num_used_blocks(self) -> int:
+        """DISTINCT blocks referenced by at least one sequence (a block
+        shared by N sequences counts once; cache-only residents count
+        zero — they are reclaimable, not in use)."""
         with self._lock:
-            return sum(len(b) for b in self._owned.values())
+            return len({b for bs in self._owned.values() for b in bs})
+
+    @property
+    def num_cached_blocks(self) -> int:
+        with self._lock:
+            return len(self._cache_held)
+
+    @property
+    def num_evictable_blocks(self) -> int:
+        """Blocks whose ONLY reference is the prefix cache's — capacity
+        the scheduler can reclaim without preempting anyone."""
+        with self._lock:
+            return sum(1 for b in self._cache_held if self._ref.get(b) == 1)
 
     def utilization(self) -> float:
-        """Fraction of usable (non-reserved) blocks currently owned."""
+        """Fraction of usable (non-reserved) blocks currently owned by
+        live sequences.  Cache-only blocks are excluded on purpose: they
+        are evictable on demand, and counting them would page the
+        kv-pool-exhaustion SLO on a healthy warm cache."""
         usable = self.cfg.num_blocks - 1
         return self.num_used_blocks / max(usable, 1)
 
-    def can_allocate(self, n_tokens: int) -> bool:
+    def can_allocate(self, n_tokens: int, shared: int = 0) -> bool:
+        """True when a fresh allocation for ``n_tokens`` fits, with the
+        first ``shared`` blocks coming from the prefix cache (only the
+        remainder needs the free list)."""
         need = self.blocks_for(n_tokens)
         if need > self.cfg.max_blocks_per_seq:
             return False
         with self._lock:
-            return need <= len(self._free)
+            return need - shared <= len(self._free)
 
     # -- ledger ------------------------------------------------------------
 
-    def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
+    def allocate(
+        self, seq_id: str, n_tokens: int, shared: Sequence[int] = ()
+    ) -> list[int]:
         """Claim enough blocks for ``n_tokens``; raises if the sequence
         already owns blocks, exceeds the table width, or the pool is dry
-        (callers check ``can_allocate`` / preempt first)."""
+        (callers check ``can_allocate`` / preempt first).
+
+        ``shared`` — already-resident cache blocks forming the head of
+        the table (the matched prefix, in prompt order): each gains a
+        reference instead of leaving the free list.  Only the remainder
+        is drawn fresh.  All-or-nothing: validation precedes any
+        mutation, so a failed allocate changes no counts."""
         need = self.blocks_for(n_tokens)
+        shared = list(shared)
         with self._lock:
             if seq_id in self._owned:
                 raise ValueError(f"sequence {seq_id!r} already owns blocks")
@@ -120,12 +170,28 @@ class KVBlockPool:
                     f"{n_tokens} tokens need {need} blocks > "
                     f"max_blocks_per_seq={self.cfg.max_blocks_per_seq}"
                 )
-            if need > len(self._free):
+            if len(shared) >= need and shared:
+                raise ValueError(
+                    f"{len(shared)} shared blocks >= {need} needed: the "
+                    "tail block must be exclusive (prefill writes there)"
+                )
+            for b in shared:
+                if b not in self._cache_held or self._ref.get(b, 0) < 1:
+                    raise ValueError(
+                        f"shared block {b} is not cache-resident"
+                    )
+            fresh = need - len(shared)
+            if fresh > len(self._free):
                 raise MemoryError(
-                    f"paged KV pool exhausted: need {need} blocks, "
+                    f"paged KV pool exhausted: need {fresh} blocks, "
                     f"{len(self._free)} free"
                 )
-            blocks = [self._free.pop() for _ in range(need)]
+            for b in shared:
+                self._ref[b] += 1
+            new = [self._free.pop() for _ in range(fresh)]
+            for b in new:
+                self._ref[b] = 1
+            blocks = shared + new
             self._owned[seq_id] = blocks
             return list(blocks)
 
@@ -145,8 +211,23 @@ class KVBlockPool:
                 return True
             if extra > len(self._free):
                 return False
-            blocks.extend(self._free.pop() for _ in range(extra))
+            for _ in range(extra):
+                b = self._free.pop()
+                self._ref[b] = 1
+                blocks.append(b)
             return True
+
+    def _deref_locked(self, block: int) -> bool:
+        """Drop one reference (lock held); returns True when the block
+        actually hit zero and went back to the free list."""
+        n = self._ref.get(block, 0) - 1
+        if n > 0:
+            self._ref[block] = n
+            return False
+        self._ref.pop(block, None)
+        self._cache_held.discard(block)
+        self._free.append(block)
+        return True
 
     def shrink_to(self, seq_id: str, n_tokens: int) -> int:
         """Return the sequence's TAIL blocks beyond what ``n_tokens`` needs
@@ -167,45 +248,117 @@ class KVBlockPool:
                 return 0
             tail = blocks[keep:]
             del blocks[keep:]
-            self._free.extend(reversed(tail))
+            for b in reversed(tail):
+                self._deref_locked(b)
             return excess
 
     def free(self, seq_id: str) -> int:
-        """Return a sequence's blocks to the pool (idempotent); returns the
-        number of blocks released."""
+        """Drop the sequence's references (idempotent); returns how many
+        blocks actually reached zero and returned to the free list
+        (shared/cached blocks survive on their remaining references)."""
         with self._lock:
             blocks = self._owned.pop(seq_id, None)
             if not blocks:
                 return 0
-            self._free.extend(reversed(blocks))
-            return len(blocks)
+            return sum(1 for b in reversed(blocks) if self._deref_locked(b))
 
     def owner_count(self) -> int:
         with self._lock:
             return len(self._owned)
 
+    def blocks_of(self, seq_id: str) -> list[int]:
+        """Copy of the sequence's block list (table order)."""
+        with self._lock:
+            blocks = self._owned.get(seq_id)
+            if blocks is None:
+                raise KeyError(f"unknown sequence {seq_id!r}")
+            return list(blocks)
+
+    # -- prefix-cache residency (llm.prefix_cache) -------------------------
+
+    def cache_retain(self, block: int) -> bool:
+        """Take the prefix tree's reference on an allocated block (False
+        if the block is free/unknown — a freed block cannot resurrect, or
+        already retained — one tree node per block)."""
+        with self._lock:
+            if block not in self._ref or block in self._cache_held:
+                return False
+            self._cache_held.add(block)
+            self._ref[block] += 1
+            return True
+
+    def cache_release(self, block: int) -> bool:
+        """Drop the prefix tree's reference (eviction/flush); frees the
+        block when no sequence still holds it."""
+        with self._lock:
+            if block not in self._cache_held:
+                return False
+            self._cache_held.discard(block)
+            return self._deref_locked(block)
+
+    def ref(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    def is_cache_held(self, block: int) -> bool:
+        with self._lock:
+            return block in self._cache_held
+
+    def is_evictable(self, block: int) -> bool:
+        """Only the cache references it: reclaimable without preemption."""
+        with self._lock:
+            return block in self._cache_held and self._ref.get(block) == 1
+
+    def cache_held_blocks(self) -> set:
+        with self._lock:
+            return set(self._cache_held)
+
     def audit(self) -> dict:
         """Free-list ledger invariant check (the watchdog's leak audit):
-        every usable block is either free or owned exactly once, and every
-        id is in range.  Runs under the pool lock alone — safe while the
-        engine lock is wedged.  Returns counts plus the owner ids so the
-        caller can cross-check owners against live requests."""
+        free + exclusively-owned + shared-with-refcount + cache-only must
+        still PARTITION the usable blocks, every id must be in range, and
+        every refcount must equal its observable references (#owning
+        sequences + 1 if cache-held).  Runs under the pool lock alone —
+        safe while the engine lock is wedged.  Returns counts plus the
+        owner ids so the caller can cross-check owners against live
+        requests (and the prefix tree via ``PrefixCache.audit``)."""
         with self._lock:
             free = list(self._free)
             owned = {k: list(v) for k, v in self._owned.items()}
+            cache_held = set(self._cache_held)
+            ref = dict(self._ref)
         usable = self.cfg.num_blocks - 1
-        owned_blocks = [b for bs in owned.values() for b in bs]
-        all_blocks = free + owned_blocks
+        owner_count: dict[int, int] = {}
+        for bs in owned.values():
+            for b in bs:
+                owner_count[b] = owner_count.get(b, 0) + 1
+        live = set(owner_count) | cache_held
+        # a shared block appears ONCE in the live set — the partition is
+        # over distinct blocks, the sharing is what the refcounts carry
+        all_blocks = free + sorted(live)
         duplicates = len(all_blocks) != len(set(all_blocks))
         out_of_range = sum(
             1 for b in all_blocks if not (1 <= b < self.cfg.num_blocks)
         )
         missing = usable - len(all_blocks)
+        ref_errors = sum(
+            1
+            for b in live
+            if ref.get(b, 0)
+            != owner_count.get(b, 0) + (1 if b in cache_held else 0)
+        ) + sum(1 for b in ref if b not in live)
         return {
-            "ok": not duplicates and not out_of_range and missing == 0,
+            "ok": not duplicates and not out_of_range and missing == 0
+            and ref_errors == 0,
             "free": len(free),
-            "owned": len(owned_blocks),
+            "owned": len(owner_count),
             "owners": list(owned),
+            "shared": sum(1 for n in owner_count.values() if n > 1),
+            "cached": len(cache_held),
+            "cached_only": sum(
+                1 for b in cache_held if b not in owner_count
+            ),
+            "ref_errors": ref_errors,
             "missing": missing,          # >0 leaked, <0 double-counted
             "duplicates": duplicates,
             "out_of_range": out_of_range,

@@ -48,6 +48,18 @@ class NGramDrafter:
     continues the period.  No match anywhere: propose the last token
     repeated (cheap, and correct for degenerate single-token loops).
 
+    Cross-request prefix awareness: when the engine runs a prefix cache
+    (``llm.prefix_cache``), it points ``corpus`` at
+    ``PrefixCache.paths`` — a bounded list of recently-used radix paths
+    (other requests' cached prompt prefixes).  A context whose local
+    lookup finds no confident match re-runs the n-gram search over those
+    shared paths: chat traffic repeats across requests at least as much
+    as within one, so the future a local scan can't see often sits on a
+    path some OTHER request already prefilled.  Corpus matches require
+    n >= 2 (a lone cross-request token is pure noise) and report
+    confident; drafts remain throughput-only — verification keeps the
+    output exact whatever the corpus proposes.
+
     ``last_matched`` records, per context of the latest ``propose`` call,
     whether a CONFIDENT match backed the proposal: an n-gram of length
     >= 2, or a single-token match immediately adjacent to the tail (the
@@ -77,9 +89,12 @@ class NGramDrafter:
         #: a constant per-step bound.
         self.scan_window = scan_window
         self.last_matched = np.zeros(0, bool)
+        #: optional zero-arg callable returning a list of token sequences
+        #: to extend the lookup across requests (the engine wires
+        #: ``PrefixCache.paths`` here when a prefix cache is active)
+        self.corpus = None
 
-    def _propose_one(self, ctx: Sequence[int]) -> tuple[list[int], bool]:
-        ctx = list(ctx[-self.scan_window :])
+    def _local_match(self, ctx: list) -> tuple[list[int], bool]:
         n_ctx = len(ctx)
         for n in range(min(self.max_ngram, n_ctx - 1), 0, -1):
             pat = list(ctx[-n:])
@@ -98,8 +113,57 @@ class NGramDrafter:
                     return out, confident
         return [int(ctx[-1])] * self.k, False
 
+    def _corpus_match(self, ctx: list, corpus: list) -> list:
+        """Rightmost n-gram match (n >= 2 only — cross-request single
+        tokens are noise) over the shared radix paths; returns the k-token
+        continuation or None.  Continuations running off a path's end
+        self-extend periodically, same as the local scan."""
+        n_ctx = len(ctx)
+        for n in range(min(self.max_ngram, n_ctx), 1, -1):
+            pat = list(ctx[-n:])
+            for seq in corpus:
+                seq = list(seq[-self.scan_window :])
+                for pos in range(len(seq) - n - 1, -1, -1):
+                    if seq[pos : pos + n] == pat:
+                        ext = list(seq)
+                        out = []
+                        cur = pos + n
+                        for _ in range(self.k):
+                            tok = ext[cur]
+                            out.append(int(tok))
+                            ext.append(tok)
+                            cur += 1
+                        return out
+        return None
+
+    def _propose_one(
+        self, ctx: Sequence[int], corpus_fn=None
+    ) -> tuple[list[int], bool]:
+        ctx = list(ctx[-self.scan_window :])
+        out, confident = self._local_match(ctx)
+        if confident:
+            return out, True
+        if corpus_fn is not None:
+            shared = self._corpus_match(ctx, corpus_fn())
+            if shared is not None:
+                return shared, True
+        return out, confident
+
     def propose(self, contexts: list[Sequence[int]]) -> np.ndarray:
-        rows = [self._propose_one(c) for c in contexts]
+        # the corpus (PrefixCache.paths: lock + tree walk) is fetched
+        # LAZILY, once, and only if some row's local match is
+        # unconfident — propose runs every decode step under the engine
+        # lock, and steady-state repetitive decode (all rows locally
+        # confident) must not pay the cache walk at all
+        fetched: list = []
+
+        def corpus_fn():
+            if not fetched:
+                fetched.append(self.corpus() or [])
+            return fetched[0]
+
+        fn = corpus_fn if self.corpus is not None else None
+        rows = [self._propose_one(c, fn) for c in contexts]
         self.last_matched = np.asarray([m for _, m in rows], bool)
         return np.asarray(
             [p for p, _ in rows], np.int32

@@ -4,9 +4,14 @@ One engine owns one model (GPT or GPT-J params), one paged KV pool, and
 one scheduler.  ``step()`` is the whole design:
 
 1. reap cancellations and blown deadlines;
-2. admit waiting requests into free decode slots (FIFO, memory-gated);
+2. admit waiting requests into free decode slots (FIFO, memory-gated,
+   and — with the prefix cache on — CACHE-AWARE: the longest cached
+   prefix is shared into the new block table, LRU cache eviction runs
+   before anyone is preempted, and queued copy-on-write forks are
+   applied as one batched device copy);
 3. run ONE chunked-prefill piece for the oldest still-prefilling
-   admission — interleaved with, never instead of, decode;
+   admission — interleaved with, never instead of, decode; completed
+   prompt blocks are inserted into the prefix tree as they fill;
 4. run ONE batched decode step across every running slot (single jitted
    call, static slot count), sample per-slot tokens (per-request
    temperature/top-k/top-p/seed), stream them out, finish requests that
@@ -71,6 +76,11 @@ def _metrics() -> dict:
 
         _METRICS = {
             "tokens": Counter("llm_generated_tokens", "tokens sampled by the engine"),
+            "prefill_tokens": Counter(
+                "llm_prefill_tokens",
+                "prompt tokens actually computed by prefill (a prefix-cache "
+                "hit skips the matched head, so this is the MISS work)",
+            ),
             "steps": Counter("llm_engine_steps", "engine step-loop iterations"),
             "finished": Counter(
                 "llm_finished_requests", "requests finished for any reason"
@@ -134,7 +144,17 @@ class EngineConfig:
     again — a regime change (output entering a repetitive stretch) is
     picked back up at the next probe, while steady low acceptance decays
     to plain-decode cost plus one probe in ``spec_backoff_max``.  Both
-    step shapes are jitted once; toggling never retraces."""
+    step shapes are jitted once; toggling never retraces.
+
+    Prefix cache: ``prefix_cache`` (default ON) shares KV blocks across
+    requests through a radix tree over block contents
+    (``llm.prefix_cache``): admission matches the longest cached prefix
+    and prefills only the uncached suffix, with copy-on-write forks on
+    intra-block divergence (``prefix_cow_min_tokens`` sets the minimum
+    intra-block match worth a device block copy).  Outputs are
+    token-identical with the cache on or off — prefix reuse is exact,
+    never approximate — and cached blocks are evicted LRU-first under
+    pool pressure before any live request is preempted."""
 
     max_slots: int = 4
     num_blocks: int = 128
@@ -148,6 +168,8 @@ class EngineConfig:
     spec_draft_ctx: int = 16
     spec_min_accept: float = 0.3
     spec_backoff_max: int = 32
+    prefix_cache: bool = True
+    prefix_cow_min_tokens: int = 1
     #: deadline-aware overload shedding (RESILIENCE.md): a submit carrying
     #: ``deadline_s`` is REJECTED with ``OverloadedError`` (429 at the
     #: proxy) when backlog ÷ observed service rate says the deadline
@@ -191,7 +213,16 @@ class LLMEngine:
             head_dim=model_cfg.head_dim,
             dtype=model_cfg.dtype,
         )
-        self.scheduler = Scheduler(self.pool, self.cfg.max_slots)
+        self.prefix_cache = None
+        if self.cfg.prefix_cache:
+            from ray_tpu.llm.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                self.pool, cow_min_tokens=self.cfg.prefix_cow_min_tokens
+            )
+        self.scheduler = Scheduler(
+            self.pool, self.cfg.max_slots, prefix_cache=self.prefix_cache
+        )
         self._drafter = None
         if self.cfg.spec_k > 0:
             from ray_tpu.llm.drafter import make_drafter
@@ -205,10 +236,18 @@ class LLMEngine:
                 draft_params=draft_params,
                 draft_ctx=self.cfg.spec_draft_ctx,
             )
+            # prefix-aware drafting: the n-gram drafter extends its
+            # lookup past the local prompt into the shared radix paths —
+            # a warm request's continuation often already sits on a path
+            # another request prefilled (drafts affect throughput only;
+            # verification keeps output exact either way)
+            if self.prefix_cache is not None and hasattr(self._drafter, "corpus"):
+                self._drafter.corpus = self.prefix_cache.paths
         self._lock = threading.Lock()
         self._requests: dict[str, Request] = {}
         self._step_n = 0
         self._tokens_generated = 0
+        self._prefill_tokens = 0
         self._preemptions = 0
         self._finished_published = 0  # scheduler.finish_count already counted
         self._spec_proposed = 0
@@ -458,6 +497,12 @@ class LLMEngine:
                 )
             self.runner.params = new
             self._weights_version = version
+            # cached prefix KV was computed under the OLD weights: flush
+            # the tree so no new request seeds from it (in-flight
+            # requests keep their own references — same mid-swap
+            # semantics as their continued decode under new weights)
+            if self.prefix_cache is not None:
+                self.prefix_cache.flush(reason="weights_update")
             in_flight = self.scheduler.num_running + self.scheduler.num_waiting
         _events.record(
             "llm.weights_update", version=version,
@@ -549,6 +594,14 @@ class LLMEngine:
         dummy batch's all-zero block tables route every provisional write
         to the reserved trash block — real pool contents are untouched."""
         self.generate([0], SamplingParams(max_tokens=2))
+        if self.prefix_cache is not None:
+            # compile the CoW fork jit with trash→trash lanes (block 0
+            # copied onto itself: identity, real pool contents untouched)
+            with self._lock:
+                z = np.zeros(self.cfg.max_slots, np.int32)
+                self.pool.k, self.pool.v = self.runner.fork_blocks(
+                    self.pool.k, self.pool.v, z, z
+                )
         if self._drafter is not None:
             with self._lock:
                 self._spec_skip = 1 << 30  # force the plain-decode path
@@ -580,10 +633,13 @@ class LLMEngine:
                 "free_blocks": self.pool.num_free_blocks,
                 "steps": self._step_n,
                 "tokens_generated": self._tokens_generated,
+                "prefill_tokens_computed": self._prefill_tokens,
                 "preemptions": self._preemptions,
                 "service_rate_tokens_per_s": self._rate,
                 "weights_version": self._weights_version,
             }
+            if self.prefix_cache is not None:
+                s["prefix_cache"] = self.prefix_cache.stats()
             if self._drafter is not None:
                 s["spec_proposed"] = self._spec_proposed
                 s["spec_accepted"] = self._spec_accepted
@@ -628,6 +684,7 @@ class LLMEngine:
             with tracing.span("llm_engine_step", **attrs):
                 self._reap()
                 sched.admit()
+                self._apply_cow()
                 did = self._prefill_one()
                 if self._drafter is not None and self._spec_skip == 0:
                     did = self._spec_decode_all(spec_info) or did
@@ -666,6 +723,26 @@ class LLMEngine:
                 n += 1
         return n
 
+    def _apply_cow(self) -> None:
+        """Drain the scheduler's queued copy-on-write forks (cache-aware
+        admissions that diverged inside a cached block): one batched
+        device copy duplicates each src block into the request's fresh
+        dst block BEFORE any prefill chunk attends through it."""
+        pend = self.scheduler.pending_cow
+        if not pend:
+            return
+        self.scheduler.pending_cow = []
+        F = self.cfg.max_slots
+        for start in range(0, len(pend), F):
+            batch = pend[start : start + F]
+            src = np.zeros(F, np.int32)
+            dst = np.zeros(F, np.int32)
+            for j, (s, d, _rid) in enumerate(batch):
+                src[j], dst[j] = s, d
+            self.pool.k, self.pool.v = self.runner.fork_blocks(
+                self.pool.k, self.pool.v, src, dst
+            )
+
     def _prefill_one(self) -> bool:
         """One chunk for the oldest admission still prefilling."""
         pre = [r for r in self.scheduler.slots if r is not None and r.state == PREFILL]
@@ -674,7 +751,8 @@ class LLMEngine:
         req = min(pre, key=lambda r: self.scheduler._admitted_at.get(r.id, 0))
         chunk = self.cfg.prefill_chunk
         # a preempted request replays prompt + already-generated tokens to
-        # rebuild its cache; a fresh one just prefills its prompt
+        # rebuild its cache; a fresh one just prefills its prompt — and a
+        # prefix-cache hit starts past the matched prefix either way
         full = req.prompt + req.out
         piece = full[req.prefill_pos : req.prefill_pos + chunk]
         n_valid = len(piece)
@@ -686,10 +764,23 @@ class LLMEngine:
         )
         self.pool.k, self.pool.v = k, v
         req.prefill_pos += n_valid
+        self._prefill_tokens += n_valid
+        _metrics()["prefill_tokens"].inc(n_valid)
         _events.record(
             "llm.prefill_chunk", request_id=req.trace_id, engine_req=req.id,
             pos=req.prefill_pos, of=len(full), n=n_valid,
         )
+        if self.prefix_cache is not None:
+            # register the now-complete PROMPT blocks (generated tokens
+            # never enter the tree — only prompt content is matchable);
+            # the admission epoch keeps a request whose prefill straddled
+            # a weight-swap flush from re-inserting old-weight KV
+            self.prefix_cache.insert(
+                req.prompt,
+                self.pool.blocks_of(req.id),
+                limit=min(req.prefill_pos, len(req.prompt)),
+                epoch=req.cache_epoch,
+            )
         if req.prefill_pos >= len(full):
             # final chunk: its last position's logits seed generation
             p = req.params

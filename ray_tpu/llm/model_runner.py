@@ -108,6 +108,18 @@ def _sample_rows(logits, seeds, counters, temp, top_k, top_p):
     return jax.vmap(one)(logits, keys, temp, top_k, top_p)
 
 
+def _fork_impl(k_pool, v_pool, src, dst):
+    """Copy-on-write block fork for the prefix cache: duplicate whole
+    physical blocks across every layer — ``pool[:, dst[i]] = pool[:,
+    src[i]]``.  A block copy is a memmove; recomputing the same positions
+    through the model is L layer matmuls — the fork wins by orders of
+    magnitude.  Unused lanes pad with (0, 0): trash copied onto trash,
+    harmless and value-deterministic even with duplicate dst indices."""
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+    return k_pool, v_pool
+
+
 def _verify_rows(logits, draft, seeds, counters, temp, top_k, top_p):
     """Per-slot speculative verification (same per-request determinism as
     ``_sample_rows``: window token i keys off (seed, counter + i)).
@@ -143,6 +155,7 @@ class PagedModelRunner:
             self._prefill_impl, donate_argnums=(1, 2), static_argnames=("chunk",)
         )
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1, 2))
+        self._fork = jax.jit(_fork_impl, donate_argnums=(0, 1))
         self._compiled: set = set()  # (fn, shape-key)s already traced
 
     def _note_compile(self, fn: str, key: Any, t0: float) -> None:
@@ -372,6 +385,20 @@ class PagedModelRunner:
             temp, top_k, top_p, seeds, counters,
         )
         self._note_compile("verify", tuple(jnp.shape(tokens)), t0)
+        return out
+
+    # -- copy-on-write block fork (llm.prefix_cache) -----------------------
+
+    def fork_blocks(self, k_pool, v_pool, src, dst):
+        """Duplicate physical blocks ``src[i] → dst[i]`` across all
+        layers (``(F,)`` int32 each, pad unused lanes with 0→0).  The
+        engine calls this right after a cache-aware admission whose
+        prompt diverges INSIDE a cached block: the copy makes the shared
+        prefix positions of the fork valid, and prefill resumes at the
+        divergence point."""
+        t0 = time.perf_counter()
+        out = self._fork(k_pool, v_pool, src, dst)
+        self._note_compile("fork", len(src), t0)
         return out
 
     # -- prefill chunk -----------------------------------------------------

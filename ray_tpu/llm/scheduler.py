@@ -7,7 +7,17 @@ the scheduler's job is to keep those slots full:
 
 * **admission** — FIFO: a waiting request takes a free slot when the pool
   can cover its prompt plus one generated block (headroom so a fresh
-  admission can't instantly deadlock on its first decode step).
+  admission can't instantly deadlock on its first decode step).  With a
+  prefix cache (``llm.prefix_cache``) admission is CACHE-AWARE: the
+  longest cached prefix is matched at admit, its blocks are shared into
+  the new table, only the uncached suffix is charged to chunked prefill
+  (``prefill_pos`` starts at the match), and an intra-block divergence
+  queues a copy-on-write fork (``pending_cow``) the engine applies
+  before the first prefill chunk.
+* **cache eviction before preemption** — when the pool is dry, capacity
+  held only by the prefix tree (finished requests' cached prefixes) is
+  reclaimed LRU-first; live requests are preempted only when the cache
+  has nothing left to give.
 * **chunked prefill** — an admitted request prefills
   ``prefill_chunk``-sized pieces, one chunk per engine step, interleaved
   with decode for the already-running slots — long prompts never stall
@@ -115,6 +125,10 @@ class Request:
         self.weights_version: Optional[int] = None
         self.resumed_from = len(self.out)  # output index generation restarts at
         self.prefill_pos = 0          # prompt tokens already in the cache
+        # prefix-cache flush epoch at admission: a weight swap mid-prefill
+        # bumps the cache's epoch, and this request's (partly old-weight)
+        # blocks then must not enter the tree (prefix_cache.insert)
+        self.cache_epoch = 0
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None
         self.cancelled = threading.Event()
@@ -135,15 +149,21 @@ class Scheduler:
     """Slot + block bookkeeping. NOT thread-safe on its own — the engine
     serializes access under its step lock."""
 
-    def __init__(self, pool: KVBlockPool, max_slots: int):
+    def __init__(self, pool: KVBlockPool, max_slots: int, prefix_cache=None):
         self.pool = pool
         self.max_slots = max_slots
+        self.prefix_cache = prefix_cache  # llm.prefix_cache.PrefixCache | None
         self.waiting: deque[Request] = deque()
         self.slots: list[Optional[Request]] = [None] * max_slots
         self._admit_seq = itertools.count()
         self._admitted_at: dict[str, int] = {}  # request id -> admission tick
         self.preempt_count = 0
         self.finish_count = 0  # lifetime finishes (engine rates this per step)
+        # copy-on-write forks queued by cache-aware admission:
+        # (src_block, dst_block, request_id) — the engine drains these
+        # right after admit() (same lock, same step), device-copying
+        # src→dst before any prefill chunk reads the forked block
+        self.pending_cow: list[tuple[int, int, str]] = []
 
     # -- queries -----------------------------------------------------------
 
@@ -169,7 +189,14 @@ class Scheduler:
 
     def admit(self) -> list[Request]:
         """Move waiting → slots while a slot is free and the pool can cover
-        prompt + one generation block. Returns the newly admitted."""
+        prompt + one generation block. Returns the newly admitted.
+
+        With a prefix cache, the longest cached prefix of the replay
+        sequence (prompt + already-generated tokens — recompute and
+        failover-resume prefixes match too, content is content) is shared
+        into the table and ``prefill_pos`` starts past it; a pool
+        shortfall first reclaims cache-only blocks (LRU), protecting the
+        blocks this very admission is about to share."""
         admitted = []
         while self.waiting:
             free = [i for i, r in enumerate(self.slots) if r is None]
@@ -182,19 +209,49 @@ class Scheduler:
             need_tokens = min(
                 req.seq_len + self.pool.cfg.block_size, self.pool.cfg.max_seq_len
             )
-            if not self.pool.can_allocate(need_tokens):
-                break  # FIFO head blocked on memory: don't starve it by skipping
+            match = None
+            shared: list[int] = []
+            if self.prefix_cache is not None:
+                match = self.prefix_cache.match(req.prompt + req.out)
+                shared = list(match.blocks)
+            if not self.pool.can_allocate(need_tokens, shared=len(shared)):
+                # reclaim cache-only residents before declaring pressure;
+                # the matched blocks (and CoW source) must survive the
+                # sweep — they may themselves be cache-only right now
+                deficit = (
+                    self.pool.blocks_for(need_tokens)
+                    - len(shared)
+                    - self.pool.num_free_blocks
+                )
+                if self.prefix_cache is not None and deficit > 0:
+                    protect = set(shared)
+                    if match is not None and match.cow_src is not None:
+                        protect.add(match.cow_src)
+                    self.prefix_cache.evict(deficit, protect=frozenset(protect))
+                if not self.pool.can_allocate(need_tokens, shared=len(shared)):
+                    break  # FIFO head blocked on memory: don't starve it
             self.waiting.popleft()
-            self.pool.allocate(req.id, need_tokens)
+            blocks = self.pool.allocate(req.id, need_tokens, shared=shared)
             slot = free[0]
             self.slots[slot] = req
             req.state = PREFILL
-            req.prefill_pos = 0
+            req.prefill_pos = match.matched if match is not None else 0
+            if self.prefix_cache is not None:
+                req.cache_epoch = self.prefix_cache.epoch
+            if match is not None and match.cow_src is not None:
+                # the forked block sits right after the shared prefix;
+                # its first cow_tokens positions become valid at copy time
+                self.pending_cow.append(
+                    (match.cow_src, blocks[len(shared)], req.id)
+                )
+            if self.prefix_cache is not None:
+                self.prefix_cache.record(req, match, len(req.prompt) + len(req.out))
             self._admitted_at[req.id] = next(self._admit_seq)
             admitted.append(req)
             _events.record(
                 "llm.admit", request_id=req.trace_id, engine_req=req.id,
                 slot=slot, seq_len=req.seq_len,
+                cached_tokens=req.prefill_pos,
                 wait_s=round(time.time() - req.arrival_t, 6),
             )
         return admitted
@@ -208,6 +265,9 @@ class Scheduler:
         itself had to be preempted (nobody younger to evict)."""
         target = min(req.seq_len + extra, self.pool.cfg.max_seq_len)
         while not self.pool.grow_to(req.id, target):
+            # cheapest capacity first: cached blocks nobody is running on
+            if self.prefix_cache is not None and self.prefix_cache.evict(1) > 0:
+                continue
             victim = self._youngest_running(exclude=req.id)
             if victim is None:
                 self.preempt(req)
@@ -235,6 +295,7 @@ class Scheduler:
             self.slots[slot] = None
         self.pool.free(req.id)
         self._admitted_at.pop(req.id, None)
+        self._drop_pending_cow(req.id)
         self.preempt_count += 1
         req.prefill_pos = 0
         req.state = WAITING
@@ -254,6 +315,7 @@ class Scheduler:
             pass
         self.pool.free(req.id)
         self._admitted_at.pop(req.id, None)
+        self._drop_pending_cow(req.id)
         req.state = FINISHED
         req.finish_reason = reason
         self.finish_count += 1
@@ -263,6 +325,14 @@ class Scheduler:
             dur_s=round(time.time() - req.arrival_t, 6),
         )
         req.stream.put(("done", reason))
+
+    def _drop_pending_cow(self, req_id: str) -> None:
+        """A request leaving its slot (preempt/finish) before the engine
+        drained its fork: the dst block just went back to the pool, the
+        copy must not happen (defensive — the engine drains forks in the
+        same step as admission, but reap runs first next step)."""
+        if self.pending_cow:
+            self.pending_cow = [c for c in self.pending_cow if c[2] != req_id]
 
     def _slot_of(self, req: Request) -> Optional[int]:
         for i, r in enumerate(self.slots):

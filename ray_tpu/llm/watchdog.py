@@ -22,12 +22,17 @@ per-engine daemon thread built around that constraint:
   and flags the request cancelled so the scheduler finishes it properly
   if the step loop ever revives.  A stream caller never hangs on a
   request the deadline already killed.
-* **KV leak audit** — ``KVBlockPool.audit()`` checks the free-list ledger
-  invariant (free + owned == usable, no duplicate or out-of-range ids)
-  under the pool lock alone; with the engine lock the watchdog also
-  cross-checks that every block owner is a live slot-holding request.
-  A violation is a ``llm.watchdog.leak`` event + counter — leaked blocks
-  are the silent capacity death of a long-running replica.
+* **KV leak audit** — ``KVBlockPool.audit()`` checks the refcounted
+  free-list ledger invariant (free + exclusively-owned +
+  shared-with-refcount + cache-only still partition the usable blocks;
+  no duplicate, out-of-range, or ref-inconsistent ids) under the pool
+  lock alone, and ``PrefixCache.audit()`` cross-checks the radix tree
+  against the pool's cache-held set (no dangling tree references after
+  eviction, no retained block without a node); with the engine lock the
+  watchdog also cross-checks that every block owner is a live
+  slot-holding request.  A violation is a ``llm.watchdog.leak`` event +
+  counter — leaked blocks are the silent capacity death of a
+  long-running replica.
 
 ``EngineStalledError`` (raised by ``LLMEngine.stream_tokens`` on token
 timeout) carries the same lock-free diagnosis so a caller's timeout names
@@ -207,9 +212,13 @@ class EngineWatchdog:
             # the wedge case: the step loop owns the lock and is not
             # moving — unblock doomed requests' CONSUMERS without touching
             # scheduler state (pool-only audit still runs: its lock is
-            # never held across device calls)
+            # never held across device calls, and the prefix-tree
+            # cross-check needs only the cache + pool locks)
             unblocked = self._unblock_doomed()
-            audit = self._check_audit(self.engine.pool.audit(), orphans=())
+            audit = self._check_audit(
+                self.engine.pool.audit(), orphans=(),
+                cache_audit=self._cache_audit(),
+            )
         if reaped or unblocked:
             m["reaped"].inc(reaped + unblocked)
         return {
@@ -267,6 +276,13 @@ class EngineWatchdog:
             _events.record("llm.watchdog.reap", n=n, mode="emergency")
         return n
 
+    def _cache_audit(self):
+        """Prefix-tree ↔ pool cross-check (``PrefixCache.audit``): no
+        dangling tree references after eviction, no cache-held pool block
+        without a node.  None when the engine runs without a cache."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        return cache.audit() if cache is not None else None
+
     def _audit_locked(self) -> dict:
         """Pool-ledger audit plus the owner cross-check that needs the
         engine lock: every block owner must be a request holding a slot
@@ -276,12 +292,17 @@ class EngineWatchdog:
             r.id for r in self.engine.scheduler.slots if r is not None
         }
         orphans = tuple(o for o in pool_audit["owners"] if o not in slot_ids)
-        return self._check_audit(pool_audit, orphans)
+        return self._check_audit(pool_audit, orphans, self._cache_audit())
 
-    def _check_audit(self, pool_audit: dict, orphans: tuple) -> dict:
+    def _check_audit(
+        self, pool_audit: dict, orphans: tuple, cache_audit=None
+    ) -> dict:
         m = _metrics()
-        ok = pool_audit["ok"] and not orphans
+        cache_ok = cache_audit is None or cache_audit["ok"]
+        ok = pool_audit["ok"] and not orphans and cache_ok
         result = dict(pool_audit, orphans=list(orphans), ok=ok)
+        if cache_audit is not None:
+            result["prefix_cache"] = cache_audit
         m["audit_ok"].set(1.0 if ok else 0.0)
         if not ok and not self._leaked:
             self.leak_count += 1
@@ -291,7 +312,14 @@ class EngineWatchdog:
                 missing=pool_audit.get("missing", 0),
                 duplicates=pool_audit.get("duplicates", False),
                 out_of_range=pool_audit.get("out_of_range", 0),
+                ref_errors=pool_audit.get("ref_errors", 0),
                 orphans=list(orphans)[:8],
+                cache_dangling=(
+                    len(cache_audit["dangling"]) if cache_audit else 0
+                ),
+                cache_unindexed=(
+                    len(cache_audit["unindexed"]) if cache_audit else 0
+                ),
             )
         self._leaked = not ok
         return result

@@ -224,14 +224,24 @@ class LLMDeployment:
     def autoscaling_metrics(self) -> dict:
         """Saturation signals for replica autoscaling: ``queue_depth``
         (admission-bound) and ``kv_utilization`` (memory-bound) on top of
-        the running count the controller already polls."""
+        the running count the controller already polls.
+        ``prefix_hit_rate`` rides along informationally — the
+        cross-request prefix cache (``llm.prefix_cache``) is per-replica,
+        so routing that keeps a tenant's traffic on one replica (session
+        affinity, a ROADMAP item) shows up directly as a higher hit rate
+        here.  Note ``kv_utilization`` counts only blocks live requests
+        hold: cache-only residents are evictable on demand and never
+        create upscale pressure."""
         s = self._engine.stats()
-        return {
+        m = {
             "queue_depth": s["queue_depth"],
             "kv_utilization": s["kv_utilization"],
             "running": s["running"],
             "waiting": s["waiting"],
         }
+        if "prefix_cache" in s:
+            m["prefix_hit_rate"] = s["prefix_cache"]["hit_rate"]
+        return m
 
     def stats(self) -> dict:
         return self._engine.stats()

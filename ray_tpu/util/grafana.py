@@ -64,6 +64,33 @@ _LLM_PANELS = [
      "Accepted/proposed draft tokens of the last verify window."),
 ]
 
+
+def _prefix_panels() -> list:
+    """Cross-request prefix-cache row, DERIVED from the metric family
+    ``llm.prefix_cache`` exports (``prefix_cache.METRIC_NAMES`` is the
+    contract; tests cross-check this row against it so the dashboard
+    can't silently drift from the code): hit rate, hit/miss token rates,
+    eviction pressure, resident tree size."""
+    return [
+        ("Prefix cache hit rate", "ray_tpu_llm_prefix_cache_hit_rate",
+         "percentunit",
+         "Lifetime hit_tokens / (hit+miss) — prompt tokens served from "
+         "cached KV instead of prefill."),
+        ("Prefix hit tokens/s",
+         "rate(ray_tpu_llm_prefix_cache_hit_tokens[1m])", "short",
+         "Prompt tokens/s whose prefill was skipped via cached blocks."),
+        ("Prefix miss tokens/s",
+         "rate(ray_tpu_llm_prefix_cache_miss_tokens[1m])", "short",
+         "Prompt tokens/s actually prefilled (compare llm_prefill_tokens)."),
+        ("Prefix evictions/s",
+         "rate(ray_tpu_llm_prefix_cache_evicted_blocks[1m])", "short",
+         "Cached blocks reclaimed under KV pressure — sustained rate "
+         "means the tree is thrashing; grow the pool."),
+        ("Prefix cache blocks", "ray_tpu_llm_prefix_cache_blocks", "short",
+         "KV blocks resident in the radix tree."),
+    ]
+
+
 def _slo_panels() -> list:
     """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
     panels interpolate the same threshold/objective/window the head's alert
@@ -115,6 +142,9 @@ _LLM_NAMES = {
     "llm_kv_block_utilization", "llm_time_to_first_token_s",
     "llm_inter_token_latency_s", "llm_spec_acceptance_rate",
     "serve_requests", "tracing_dropped_spans", "llm_finished_requests",
+    "llm_prefix_cache_hit_tokens", "llm_prefix_cache_miss_tokens",
+    "llm_prefix_cache_evicted_blocks", "llm_prefix_cache_hit_rate",
+    "llm_prefix_cache_blocks", "llm_prefill_tokens",
 }
 
 
@@ -163,7 +193,8 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     panels = []
     y = 0
     pid = 0
-    for title, expr, unit, desc in _CORE_PANELS + _LLM_PANELS + _slo_panels():
+    for title, expr, unit, desc in (_CORE_PANELS + _LLM_PANELS
+                                    + _prefix_panels() + _slo_panels()):
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:
