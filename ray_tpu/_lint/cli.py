@@ -12,8 +12,13 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from ray_tpu._lint import baseline as baseline_mod
-from ray_tpu._lint.core import all_rules, display_path_for, run_paths
+from ray_tpu._lint.core import all_rules, display_path_for, get_rule, run_paths
 from ray_tpu._lint.imports_check import check_imports
+
+
+def _rule_name(rule_id: str) -> str:
+    rule = get_rule(rule_id)
+    return rule.name if rule is not None else ""
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -26,8 +31,12 @@ def _build_parser() -> argparse.ArgumentParser:
         help="files or directories to lint (default: the ray_tpu package)",
     )
     p.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt",
-        help="output format",
+        "--format", choices=("text", "json", "github"), default="text", dest="fmt",
+        help="output format (github = workflow-command inline annotations)",
+    )
+    p.add_argument(
+        "--profile", action="store_true",
+        help="print wall-time per phase (parse / index / each rule) to stderr",
     )
     p.add_argument(
         "--baseline", metavar="PATH", default=None,
@@ -131,13 +140,24 @@ def main(argv: Optional[Sequence] = None) -> int:
             return d + "/" if Path(p).is_dir() else d
         return (Path(p).resolve().name + "/") if Path(p).is_dir() else Path(p).as_posix()
 
+    prof: Optional[dict] = {} if args.profile else None
     try:
         violations = run_paths(
-            paths, select=select, ignore=ignore, display_root=display_root
+            paths, select=select, ignore=ignore, display_root=display_root,
+            profile=prof,
         )
     except (FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if prof is not None:
+        print(
+            f"raylint profile: {prof['files']} files, "
+            f"parse {prof['parse_s']}s, index {prof['index_s']}s, "
+            f"total {prof['total_s']}s",
+            file=sys.stderr,
+        )
+        for rid, secs in prof["rules_s"].items():
+            print(f"  {rid}: {secs}s", file=sys.stderr)
 
     if args.write_baseline:
         if baseline_path.is_file():
@@ -191,6 +211,21 @@ def main(argv: Optional[Sequence] = None) -> int:
                 },
                 indent=2,
             )
+        )
+    elif args.fmt == "github":
+        # GitHub workflow commands: rendered as inline PR annotations when
+        # printed from an Actions step. Newlines in the message must be
+        # %0A-escaped per the workflow-command spec.
+        for v in violations:
+            msg = v.message.replace("%", "%25").replace("\n", "%0A")
+            print(
+                f"::error file={v.path},line={v.line},"
+                f"col={max(v.col, 1)},title={v.rule} {_rule_name(v.rule)}::"
+                f"{msg}"
+            )
+        print(
+            f"raylint: {len(violations)} violation"
+            f"{'s' if len(violations) != 1 else ''}"
         )
     else:
         for v in violations:

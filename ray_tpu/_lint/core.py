@@ -63,6 +63,19 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """A whole-program rule: phase 1 builds one :class:`ProjectIndex` over
+    every scanned file, phase 2 calls :meth:`check_project` once per run.
+    Violations still anchor to a (file, line) so inline suppressions and
+    baseline fingerprints work exactly like per-file rules."""
+
+    def check(self, ctx: "FileContext") -> Iterator[Violation]:
+        return iter(())
+
+    def check_project(self, index) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -332,11 +345,27 @@ def run_paths(
     select: Optional[Iterable] = None,
     ignore: Optional[Iterable] = None,
     display_root: Optional[Path] = None,
+    profile: Optional[dict] = None,
 ) -> List[Violation]:
     """Lint every python file under ``paths``; returns violations that are not
-    suppressed by inline comments (baseline filtering is the caller's job)."""
+    suppressed by inline comments (baseline filtering is the caller's job).
+
+    Two phases: per-file rules run over each :class:`FileContext`; then,
+    when any :class:`ProjectRule` is selected, a :class:`ProjectIndex` is
+    built over ALL parsed files and the cross-module rules run against it.
+    Pass a dict as ``profile`` to receive wall-time per phase and per rule
+    (the CLI's ``--profile``)."""
+    import time as _time
+
+    t_start = _time.perf_counter()
     rules = _selected_rules(select, ignore)
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
     violations: list[Violation] = []
+    contexts: list[FileContext] = []
+    rule_times: dict[str, float] = {r.id: 0.0 for r in rules}
+
+    t0 = _time.perf_counter()
     for abs_path, display in iter_python_files(paths, display_root=display_root):
         try:
             source = abs_path.read_text(encoding="utf-8", errors="replace")
@@ -355,10 +384,40 @@ def run_paths(
                 )
             )
             continue
-        ctx = FileContext(abs_path, display, source, tree)
-        for rule in rules:
+        contexts.append(FileContext(abs_path, display, source, tree))
+    t_parse = _time.perf_counter() - t0
+
+    for ctx in contexts:
+        for rule in file_rules:
+            t0 = _time.perf_counter()
             for v in rule.check(ctx):
                 if not ctx.is_suppressed(v):
                     violations.append(v)
+            rule_times[rule.id] += _time.perf_counter() - t0
+
+    t_index = 0.0
+    if project_rules:
+        from ray_tpu._lint.index import build_index
+
+        t0 = _time.perf_counter()
+        index = build_index(contexts, display_root=display_root)
+        t_index = _time.perf_counter() - t0
+        by_display = {ctx.display_path: ctx for ctx in contexts}
+        for rule in project_rules:
+            t0 = _time.perf_counter()
+            for v in rule.check_project(index):
+                ctx = by_display.get(v.path)
+                if ctx is None or not ctx.is_suppressed(v):
+                    violations.append(v)
+            rule_times[rule.id] += _time.perf_counter() - t0
+
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    if profile is not None:
+        profile.update(
+            files=len(contexts),
+            parse_s=round(t_parse, 4),
+            index_s=round(t_index, 4),
+            rules_s={k: round(v, 4) for k, v in sorted(rule_times.items())},
+            total_s=round(_time.perf_counter() - t_start, 4),
+        )
     return violations

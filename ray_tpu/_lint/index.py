@@ -1,0 +1,1177 @@
+"""raylint phase 1: the project index.
+
+Single-pass per-file visitors (RL001-RL008) cannot see the bug classes the
+runtime actually grew: params baked into jitted executables because a traced
+function read ``self.params`` at trace time (the PR 7 hot-swap bug), lock
+cycles that span ``llm/engine.py`` → ``llm/prefix_cache.py`` →
+``llm/cache.py``, blocking device syncs under locks a watchdog thread also
+wants, and metric/event name drift between code, registries and docs.
+
+This module builds the whole-program index those rules need:
+
+* a **per-module symbol table** — imports (absolute and relative),
+  module-level functions/classes, module globals with a coarse mutability
+  kind (``lock`` for ``threading.Lock()``-style bindings);
+* a **per-class attribute table** — every ``self.<attr> = ...`` with where
+  it was assigned (``__init__`` vs elsewhere) and a coarse kind
+  (``static`` literal config / ``mutable`` array-dict-list state /
+  ``unknown``), plus ``attr → project class`` resolution from constructor
+  calls, annotations, and constructor *call sites* in other modules
+  (``EngineWatchdog(self, ...)`` inside an ``LLMEngine`` method binds the
+  watchdog's ``engine`` attribute to ``LLMEngine``);
+* a **jit registry** — every function handed to ``jax.jit``/``jit``/
+  ``pjit``/``shard_map`` via decorator, ``self._step = jax.jit(self._fn)``
+  assignment, inline call, or a ``functools.partial`` wrapper, with its
+  ``static_argnums``/``static_argnames``;
+* **per-function acquired-lock sets** — every ``with <lock>:`` /
+  ``.acquire()``, resolvable to a global owner node (``LLMEngine._lock``,
+  not ``self._lock``), whether the acquire is bounded (``timeout=`` /
+  non-blocking — a bounded acquire cannot deadlock), and which locks were
+  held at every call site and blocking-operation site;
+* **thread targets** — functions handed to ``threading.Thread(target=...)``
+  (the roots of the daemon-reachability closure RL011 uses);
+* **emitted observability names** — string literals passed to
+  ``events.record``/``events.emit`` and to the ``Counter``/``Gauge``/
+  ``Histogram`` constructors, declared ``METRIC_NAMES``/``EVENT_NAMES``
+  registries, ``LOCK_ORDER`` declarations, ``ray_tpu_``-prefixed metric
+  references inside string literals (grafana/SLO PromQL), and backticked
+  names from the repo's observability docs (``DOC_FILES``).
+
+Everything here is a *documented heuristic* over the AST — no imports are
+executed, and unresolvable dynamic constructs are skipped
+(under-approximation: a rule can miss, it must not invent). Phase 2 lives
+in ``rules.py`` (RL009-RL012), which consumes :class:`ProjectIndex`
+through the transitive queries at the bottom of this file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from ray_tpu._lint.core import FileContext
+
+# anchored on a word start so 'clock'/'block'/'unlock' don't match (kept in
+# sync with RL005's per-class heuristic)
+LOCK_ATTR_RE = re.compile(r"(?:^|_)(lock|rlock|mutex|cv|cond)s?$", re.I)
+
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+
+#: attribute / parameter names that mean "model state", not config — the
+#: PR 7 bug class is exactly a traced function reading one of these
+MUTABLE_STATE_NAMES = {"params", "weights", "buffers", "variables", "opt_state"}
+
+#: constructors whose result is array data (state, never static config)
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full", "array", "asarray", "arange"}
+
+_STATIC_ANNOTATIONS = {"int", "str", "bool", "float", "tuple"}
+_MUTABLE_ANNOTATIONS = {"dict", "list", "set", "bytearray", "ndarray", "array"}
+
+#: blocking operations for RL011: device syncs, unbounded queue/future
+#: waits and network IO — anything that can park a thread indefinitely
+#: while it holds a lock
+_BLOCKING_CALLS = {
+    "jax.device_get": "device sync",
+    "jax.device_put": "device transfer",
+    "jax.block_until_ready": "device sync",
+    "socket.create_connection": "network IO",
+    "urllib.request.urlopen": "network IO",
+    "requests.get": "network IO",
+    "requests.post": "network IO",
+    "requests.request": "network IO",
+}
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+#: repo docs that count as observability-name documentation for RL012
+DOC_FILES = ("OBSERVABILITY.md", "RESILIENCE.md")
+
+#: module basenames whose string literals are dashboard/alert row sources —
+#: a ``ray_tpu_<metric>`` token there is a PromQL reference RL012 checks
+#: against the exported names. Elsewhere the prefix is overwhelmingly a
+#: path/tempdir name, not a query.
+PROMQL_SOURCE_MODULES = ("grafana", "slo", "dashboard")
+
+_DOC_NAME_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_.*{}]*)`")
+_PROM_REF_RE = re.compile(r"ray_tpu_([a-z][a-z0-9_]*)")
+
+
+def dotted_parts(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``('self', 'pool', '_lock')`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _const_kind(node: ast.AST) -> Optional[str]:
+    """'static' for literal config values, 'mutable' for container/array
+    displays, None when the expression says nothing."""
+    if isinstance(node, ast.Constant):
+        # None is a placeholder ("filled in later"), not config evidence
+        return None if node.value is None else "static"
+    if isinstance(node, ast.Tuple):
+        if all(_const_kind(e) == "static" for e in node.elts):
+            return "static"
+        return None
+    if isinstance(
+        node, (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+    ):
+        return "mutable"
+    if isinstance(node, ast.Call):
+        d = dotted_parts(node.func)
+        if d and (d[-1] in _ARRAY_CTORS or d[-1] in ("dict", "list", "set")):
+            return "mutable"
+    return None
+
+
+def _annotation_kind(ann: Optional[ast.AST]) -> Optional[str]:
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Subscript):  # dict[...] / list[...] / Optional[...]
+        d = dotted_parts(ann.value)
+    else:
+        d = dotted_parts(ann)
+    name = d[-1] if d else (ann.value if isinstance(ann, ast.Constant) else None)
+    if not isinstance(name, str):
+        return None
+    low = name.lower()
+    if low in _STATIC_ANNOTATIONS:
+        return "static"
+    if low in _MUTABLE_ANNOTATIONS:
+        return "mutable"
+    return None
+
+
+@dataclasses.dataclass
+class LockAcq:
+    """One lock acquisition: raw expression chain + anchor; the global node
+    key is resolved lazily via ``ProjectIndex.lock_key``."""
+
+    chain: Tuple[str, ...]
+    node: ast.AST
+    bounded: bool           # timeout= / non-blocking — cannot deadlock
+    via_with: bool
+    held: Tuple[Tuple[str, ...], ...] = ()   # chains held when acquiring
+
+
+@dataclasses.dataclass
+class CallSite:
+    chain: Tuple[str, ...]
+    node: ast.Call
+    held: Tuple[Tuple[str, ...], ...]   # lock chains held at this call
+
+
+@dataclasses.dataclass
+class BlockOp:
+    label: str
+    kind: str
+    node: ast.AST
+    held: Tuple[Tuple[str, ...], ...]
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit``/``pjit``/``shard_map`` wrapping."""
+
+    target_chain: Optional[Tuple[str, ...]]  # the function being traced
+    node: ast.AST                            # anchor for diagnostics
+    wrapper: str                             # jit / pjit / shard_map
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    decorator_of: Optional[str] = None       # FuncInfo key when via decorator
+
+
+@dataclasses.dataclass
+class EmitSite:
+    name: str
+    kind: str                # 'event' | 'metric'
+    node: ast.AST
+
+
+class FuncInfo:
+    """Everything the cross-module rules need to know about one def (or
+    the module top-level scope, ``qualname == '<module>'``). The scan
+    DESCENDS into nested defs — a closure inside a traced function runs
+    at trace time, so its reads/calls belong to the enclosing scope."""
+
+    def __init__(self, node, ctx: FileContext, module: str, cls: Optional["ClassInfo"]):
+        self.node = node
+        self.ctx = ctx
+        self.module = module
+        self.cls = cls
+        self.name = getattr(node, "name", "<module>")
+        self.qualname = (
+            ctx.qualname(node) if not isinstance(node, ast.Module) else "<module>"
+        )
+        self.self_name: Optional[str] = None
+        args = getattr(node, "args", None)
+        if cls is not None and args is not None and args.args and not any(
+            isinstance(d, ast.Name) and d.id == "staticmethod"
+            for d in getattr(node, "decorator_list", [])
+        ):
+            self.self_name = args.args[0].arg
+        self.acquisitions: List[LockAcq] = []
+        self.calls: List[CallSite] = []
+        self.blocking: List[BlockOp] = []
+        self.self_reads: List[Tuple[str, ast.AST]] = []   # self.<attr> loads
+        self.jit_sites: List[JitSite] = []
+        self.thread_targets: List[Tuple[Tuple[str, ...], bool]] = []
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    def display(self) -> str:
+        return f"{self.ctx.display_path}:{self.qualname}"
+
+
+class ClassInfo:
+    def __init__(self, node: ast.ClassDef, ctx: FileContext, module: str):
+        self.node = node
+        self.ctx = ctx
+        self.module = module
+        self.name = node.name
+        self.methods: dict[str, FuncInfo] = {}
+        # attr -> list of (in_init, kind-or-None, value node-or-None)
+        self.attr_assigns: dict[str, list] = {}
+        # attr -> (module, class) of a resolved project class
+        self.attr_classes: dict[str, Tuple[str, str]] = {}
+        # __init__ param name -> coarse kind from annotation/default
+        self.init_params: dict[str, Optional[str]] = {}
+        # attr -> the __init__ param it was assigned from
+        self.attr_from_param: dict[str, str] = {}
+        # __init__ param -> (module, class) from annotations + call sites
+        self.param_classes: dict[str, Tuple[str, str]] = {}
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.name)
+
+    def attr_kind(self, attr: str) -> str:
+        """'static' | 'mutable' | 'unknown' — the RL009 classification.
+
+        mutable wins: array/container evidence, a ``params``-ish name, a
+        mutable annotation on the source parameter, or any reassignment
+        outside ``__init__`` (here or cross-module) marks the attribute
+        as state a traced read would bake stale. 'unknown' does NOT fire
+        — the rule under-approximates rather than guessing."""
+        kinds = set()
+        for in_init, kind, _node in self.attr_assigns.get(attr, []):
+            if kind in ("static", "mutable"):
+                kinds.add(kind)
+            if not in_init and kind != "jit_wrapper":
+                kinds.add("mutable")  # reassigned after construction
+        if attr in MUTABLE_STATE_NAMES:
+            kinds.add("mutable")
+        src = self.attr_from_param.get(attr)
+        if src is not None:
+            ann = self.init_params.get(src)
+            if ann:
+                kinds.add(ann)
+            if src in MUTABLE_STATE_NAMES:
+                kinds.add("mutable")
+        if "mutable" in kinds:
+            return "mutable"
+        if "static" in kinds:
+            return "static"
+        return "unknown"
+
+
+class ModuleInfo:
+    def __init__(self, ctx: FileContext, module: str):
+        self.ctx = ctx
+        self.module = module
+        self.imports: dict[str, str] = {}      # local name -> dotted target
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}   # module-level defs
+        self.globals: dict[str, str] = {}      # name -> kind (incl. 'lock')
+        self.registries: dict[str, Tuple[list, ast.AST]] = {}
+        self.lock_orders: List[Tuple[list, ast.AST]] = []
+        self.string_prom_refs: List[Tuple[str, ast.AST]] = []
+        self.scope: Optional[FuncInfo] = None  # module top-level pseudo-func
+
+
+def module_name_for(display_path: str) -> str:
+    p = display_path[:-3] if display_path.endswith(".py") else display_path
+    mod = p.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+# --------------------------------------------------------------- file scan
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """One walk per scope: lock nesting, call sites, blocking ops, self
+    reads, jit sites, thread targets. Descends into nested defs (they are
+    part of the enclosing scope's trace-/run-time behavior) but not into
+    sibling top-level defs when scanning the module scope."""
+
+    def __init__(self, info: FuncInfo, index: "ProjectIndex"):
+        self.info = info
+        self.index = index
+        self.held: list[Tuple[str, ...]] = []
+        self.self_aliases = {info.self_name} if info.self_name else set()
+        # `sched = self.scheduler` — local handles onto member objects;
+        # calls through them resolve like the spelled-out attribute chain
+        self.attr_aliases: dict[str, Tuple[str, ...]] = {}
+        self.root = info.node
+        self.module_scope = isinstance(info.node, ast.Module)
+
+    # -- helpers --
+
+    def _is_lockish(self, chain: Tuple[str, ...]) -> bool:
+        return bool(LOCK_ATTR_RE.search(chain[-1]))
+
+    def _self_chain(self, chain: Tuple[str, ...]) -> Optional[Tuple[str, ...]]:
+        """Normalize an alias-rooted chain (``runner.arch`` after
+        ``runner = self``, ``sched.admit`` after ``sched =
+        self.scheduler``) to its ``('self', ...)`` spelling; None when
+        not self-rooted."""
+        if not chain:
+            return None
+        if chain[0] in self.self_aliases:
+            return ("self",) + chain[1:]
+        alias = self.attr_aliases.get(chain[0])
+        if alias is not None:
+            return alias + chain[1:]
+        return None
+
+    def _norm(self, chain: Tuple[str, ...]) -> Tuple[str, ...]:
+        """Chain as stored: alias-resolved, rooted at the REAL self param
+        name so ``resolve_call``/``lock_key`` anchor it."""
+        norm = self._self_chain(chain)
+        if norm is None:
+            return chain
+        root = self.info.self_name or "self"
+        return (root,) + norm[1:]
+
+    # -- structure --
+
+    def visit_FunctionDef(self, node):
+        if node is self.root or not self.module_scope:
+            self.generic_visit(node)
+        # module scope skips top-level defs: they get their own FuncInfo
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass  # class bodies are scanned via their methods' FuncInfos
+
+    def visit_Assign(self, node):
+        v = node.value
+        if isinstance(v, ast.Name) and v.id in self.self_aliases:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.self_aliases.add(tgt.id)
+        vchain = dotted_parts(v)
+        if vchain is not None and len(vchain) == 2:
+            vnorm = self._self_chain(vchain)
+            if vnorm is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.attr_aliases[tgt.id] = vnorm
+        for tgt in node.targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    self._record_attr_assign(elt, None)
+            else:
+                self._record_attr_assign(tgt, v)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._record_attr_assign(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record_attr_assign(node.target, None)
+        self.generic_visit(node)
+
+    def _record_attr_assign(self, tgt: ast.AST, value: Optional[ast.AST]) -> None:
+        if not isinstance(tgt, ast.Attribute):
+            return  # rebinding a local (even a self-alias) mutates no attr
+        chain = dotted_parts(tgt)
+        if not chain:
+            return
+        norm = self._self_chain(chain)
+        cls = self.info.cls
+        if norm is not None and len(norm) == 2 and cls is not None:
+            in_init = self.info.name == "__init__"
+            kind = _const_kind(value) if value is not None else None
+            if value is not None and self.index._jit_site_from_call(value) is not None:
+                kind = "jit_wrapper"
+            cls.attr_assigns.setdefault(norm[1], []).append((in_init, kind, value))
+            if in_init and isinstance(value, ast.Name):
+                cls.attr_from_param.setdefault(norm[1], value.id)
+            if in_init and isinstance(value, ast.Call):
+                # resolved in _finalize: the constructed class may live in
+                # a module that has not been scanned yet
+                ctor = dotted_parts(value.func)
+                if ctor:
+                    self.index._deferred_attr_ctors.append(
+                        (cls, norm[1], self.info, ctor)
+                    )
+        elif norm is not None and len(norm) == 3:
+            # cross-object mutation: `self.runner.params = ...` marks the
+            # attribute mutable on the RESOLVED class (finalize pass)
+            self.index._deferred_mutations.append((self.info, norm))
+
+    def visit_With(self, node):
+        acquired = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            chain = dotted_parts(item.context_expr)
+            chain = self._norm(chain) if chain else chain
+            if chain and self._is_lockish(chain):
+                self.info.acquisitions.append(
+                    LockAcq(
+                        chain=chain, node=node, bounded=False, via_with=True,
+                        held=tuple(self.held),
+                    )
+                )
+                self.held.append(chain)
+                acquired += 1
+        for child in node.body:
+            self.visit(child)
+        for _ in range(acquired):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        chain = dotted_parts(node.func)
+        chain = self._norm(chain) if chain else chain
+        if chain:
+            if (
+                chain[-1] == "acquire"
+                and len(chain) > 1
+                and self._is_lockish(chain[:-1])
+            ):
+                bounded = any(kw.arg == "timeout" for kw in node.keywords)
+                if (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value is False
+                ):
+                    bounded = True
+                if any(
+                    kw.arg == "blocking"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords
+                ):
+                    bounded = True
+                if len(node.args) >= 2:
+                    bounded = True  # acquire(blocking, timeout)
+                self.info.acquisitions.append(
+                    LockAcq(
+                        chain=chain[:-1], node=node, bounded=bounded,
+                        via_with=False, held=tuple(self.held),
+                    )
+                )
+            if chain[-1] == "Thread":
+                target = None
+                daemon = False
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = dotted_parts(kw.value)
+                    elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                if target is not None:
+                    self.info.thread_targets.append((target, daemon))
+            site = self.index._jit_site_from_call(node)
+            if site is not None:
+                self.info.jit_sites.append(site)
+            label = self.index._blocking_label(chain, node)
+            if label is not None:
+                self.info.blocking.append(
+                    BlockOp(
+                        label=label[0], kind=label[1], node=node,
+                        held=tuple(self.held),
+                    )
+                )
+            emit = self.index._emit_from_call(chain, node, self.info)
+            if emit is not None:
+                self.index.emits.append((emit, self.info))
+            self.info.calls.append(
+                CallSite(chain=chain, node=node, held=tuple(self.held))
+            )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Load):
+            chain = dotted_parts(node)
+            if chain:
+                norm = self._self_chain(chain)
+                if norm is not None and len(norm) >= 2:
+                    self.info.self_reads.append((norm[1], node))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------- the index
+
+
+class ProjectIndex:
+    """Whole-program facts for phase-2 rules. Build once per run via
+    :func:`build_index`; every query is read-only."""
+
+    def __init__(
+        self, contexts: Sequence[FileContext], display_root: Optional[Path] = None
+    ):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.classes: dict[Tuple[str, str], ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.emits: List[Tuple[EmitSite, FuncInfo]] = []
+        self.jit_sites: List[Tuple[JitSite, FuncInfo]] = []
+        self.doc_names: set = set()
+        self.display_root = display_root
+        self._deferred_mutations: list = []
+        self._deferred_attr_ctors: list = []
+        self._deferred_param_anns: list = []
+        self._locks_memo: dict[str, frozenset] = {}
+        self._block_memo: dict[str, list] = {}
+        for ctx in contexts:
+            self._scan_file(ctx)
+        self._finalize()
+        self._load_docs()
+
+    # -- construction ------------------------------------------------------
+
+    def _scan_file(self, ctx: FileContext) -> None:
+        module = module_name_for(ctx.display_path)
+        mi = ModuleInfo(ctx, module)
+        self.modules[module] = mi
+        is_pkg = ctx.display_path.endswith("__init__.py")
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        mi.imports[a.asname] = a.name
+                    else:
+                        mi.imports[a.name.split(".")[0]] = a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    base = node.module or ""
+                else:
+                    parts = module.split(".")
+                    keep = max(len(parts) - node.level + (1 if is_pkg else 0), 0)
+                    anchor = parts[:keep]
+                    base = ".".join(anchor + ([node.module] if node.module else []))
+                for a in node.names:
+                    mi.imports[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                self._scan_module_assign(mi, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_class(mi, stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, stmt, cls=None)
+        # the module top-level scope as a pseudo-function (module-level
+        # jit wrapping, thread spawns, emissions)
+        scope = FuncInfo(ctx.tree, ctx, module, cls=None)
+        mi.scope = scope
+        self.functions[scope.key] = scope
+        _FunctionScanner(scope, self).visit(ctx.tree)
+        promql_module = module.rsplit(".", 1)[-1] in PROMQL_SOURCE_MODULES
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                text = node.value
+                if not (
+                    promql_module
+                    or "rate(" in text
+                    or "histogram_quantile" in text
+                ):
+                    continue
+                for m in _PROM_REF_RE.finditer(text):
+                    nxt = text[m.end(): m.end() + 1]
+                    # a token flowing into a filename/path is not a query
+                    if nxt in (".", "/", "-") or m.group(1).endswith("_"):
+                        continue
+                    mi.string_prom_refs.append((m.group(1), node))
+
+    def _scan_module_assign(self, mi: ModuleInfo, stmt: ast.Assign) -> None:
+        names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        v = stmt.value
+        kind = _const_kind(v)
+        if isinstance(v, ast.Call):
+            d = dotted_parts(v.func)
+            if d and d[-1] in ("Lock", "RLock", "Condition", "Semaphore"):
+                kind = "lock"
+        for name in names:
+            if kind:
+                mi.globals[name] = kind
+            if name in ("METRIC_NAMES", "EVENT_NAMES") and isinstance(
+                v, (ast.Tuple, ast.List, ast.Set)
+            ):
+                vals = [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                mi.registries[name] = (vals, stmt)
+            if name == "LOCK_ORDER" and isinstance(v, (ast.Tuple, ast.List)):
+                vals = [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                mi.lock_orders.append((vals, stmt))
+
+    def _scan_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(node, mi.ctx, mi.module)
+        mi.classes[node.name] = ci
+        self.classes[ci.key] = ci
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, stmt, cls=ci)
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        args = init.node.args
+        dmap: dict[str, ast.AST] = {}
+        pos_defaults = list(args.defaults)
+        for arg, d in zip(args.args[len(args.args) - len(pos_defaults):], pos_defaults):
+            dmap[arg.arg] = d
+        for arg, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                dmap[arg.arg] = d
+        every = (list(args.args) + list(args.kwonlyargs))[1:]
+        for a in every:
+            kind = _annotation_kind(a.annotation)
+            if kind is None and a.arg in dmap:
+                dk = _const_kind(dmap[a.arg])
+                kind = dk if dk in ("static", "mutable") else None
+            ci.init_params[a.arg] = kind
+            if a.annotation is not None:
+                self._deferred_param_anns.append((ci, a.arg, a.annotation))
+
+    def _add_function(self, mi: ModuleInfo, node, cls: Optional[ClassInfo]) -> None:
+        info = FuncInfo(node, mi.ctx, mi.module, cls)
+        if cls is not None:
+            cls.methods[node.name] = info
+        else:
+            mi.functions[node.name] = info
+        self.functions[info.key] = info
+        for dec in node.decorator_list:
+            site = self._jit_decorator(dec, info)
+            if site is not None:
+                self.jit_sites.append((site, info))
+        _FunctionScanner(info, self).visit(node)
+
+    def _finalize(self) -> None:
+        # attr → class from __init__ constructor calls and annotations,
+        # deferred past the scan so resolution order cannot depend on the
+        # file walk order
+        for cls, attr, info, ctor in self._deferred_attr_ctors:
+            ck = self._resolve_class_chain(ctor, info)
+            if ck is not None:
+                cls.attr_classes.setdefault(attr, ck)
+        for ci, pname, ann in self._deferred_param_anns:
+            mi = self.modules.get(ci.module)
+            if mi is None:
+                continue
+            ck = self._class_from_annotation(ann, mi)
+            if ck is not None:
+                ci.param_classes.setdefault(pname, ck)
+        # ctor-callsite param→class inference; two sweeps so attr_classes
+        # resolved in sweep 1 feed argument chains resolved in sweep 2
+        for _ in range(2):
+            for info in list(self.functions.values()):
+                for call in info.calls:
+                    target = self._resolve_class_chain(call.chain, info)
+                    if target is None:
+                        continue
+                    ci = self.classes.get(target)
+                    if ci is None:
+                        continue
+                    init = ci.methods.get("__init__")
+                    if init is None:
+                        continue
+                    pos_params = [a.arg for a in init.node.args.args[1:]]
+                    bindings: list[Tuple[str, ast.AST]] = []
+                    for i, arg in enumerate(call.node.args):
+                        if i < len(pos_params):
+                            bindings.append((pos_params[i], arg))
+                    for kw in call.node.keywords:
+                        if kw.arg:
+                            bindings.append((kw.arg, kw.value))
+                    for pname, expr in bindings:
+                        ck = self._class_of_expr(expr, info)
+                        if ck is not None:
+                            ci.param_classes.setdefault(pname, ck)
+            for ci in self.classes.values():
+                for attr, pname in ci.attr_from_param.items():
+                    if pname in ci.param_classes:
+                        ci.attr_classes.setdefault(attr, ci.param_classes[pname])
+        # cross-object mutations: self.<x>.<attr> = ... marks <attr>
+        # mutable on the resolved class of <x>
+        for info, norm in self._deferred_mutations:
+            if info.cls is None:
+                continue
+            ck = info.cls.attr_classes.get(norm[1])
+            if ck is None:
+                continue
+            owner = self.classes.get(ck)
+            if owner is not None:
+                owner.attr_assigns.setdefault(norm[2], []).append(
+                    (False, "mutable", None)
+                )
+        # jit sites recorded inside function bodies
+        for info in self.functions.values():
+            for site in info.jit_sites:
+                self.jit_sites.append((site, info))
+
+    def _load_docs(self) -> None:
+        roots = []
+        if self.display_root is not None:
+            roots.append(Path(self.display_root))
+        else:
+            # no explicit repo root (library callers, the self-host test):
+            # walk up from the first scanned file to the nearest directory
+            # holding any of the observability docs
+            for mi in self.modules.values():
+                start = Path(mi.ctx.path).resolve().parent
+                for d in (start, *start.parents):
+                    if any((d / name).is_file() for name in DOC_FILES):
+                        roots.append(d)
+                        break
+                break
+        for root in roots:
+            for name in DOC_FILES:
+                p = root / name
+                try:
+                    text = p.read_text(encoding="utf-8", errors="replace")
+                except OSError:
+                    continue
+                for m in _DOC_NAME_RE.finditer(text):
+                    self.doc_names.add(m.group(1))
+
+    # -- scan-time helpers (called by _FunctionScanner) --------------------
+
+    def _jit_site_from_call(self, node: ast.AST) -> Optional[JitSite]:
+        """``jax.jit(fn, ...)`` / ``shard_map(fn, mesh=...)``, unwrapping a
+        ``functools.partial(fn, ...)`` first argument."""
+        if not isinstance(node, ast.Call):
+            return None
+        chain = dotted_parts(node.func)
+        if not chain or chain[-1] not in _JIT_WRAPPERS or not node.args:
+            return None
+        target = node.args[0]
+        if isinstance(target, ast.Call):
+            inner = dotted_parts(target.func)
+            if inner and inner[-1] == "partial" and target.args:
+                target = target.args[0]
+        return JitSite(
+            target_chain=dotted_parts(target),
+            node=node,
+            wrapper=chain[-1],
+            static_argnums=_kw_int_tuple(node, "static_argnums"),
+            static_argnames=_kw_str_tuple(node, "static_argnames"),
+        )
+
+    def _jit_decorator(self, dec: ast.AST, info: FuncInfo) -> Optional[JitSite]:
+        chain = dotted_parts(dec.func if isinstance(dec, ast.Call) else dec)
+        if chain and chain[-1] in _JIT_WRAPPERS:
+            return JitSite(
+                target_chain=None,
+                node=dec,
+                wrapper=chain[-1],
+                static_argnums=(
+                    _kw_int_tuple(dec, "static_argnums")
+                    if isinstance(dec, ast.Call) else ()
+                ),
+                static_argnames=(
+                    _kw_str_tuple(dec, "static_argnames")
+                    if isinstance(dec, ast.Call) else ()
+                ),
+                decorator_of=info.key,
+            )
+        # @partial(jax.jit, static_argnums=...)
+        if isinstance(dec, ast.Call) and chain and chain[-1] == "partial" and dec.args:
+            inner = dotted_parts(dec.args[0])
+            if inner and inner[-1] in _JIT_WRAPPERS:
+                return JitSite(
+                    target_chain=None,
+                    node=dec,
+                    wrapper=inner[-1],
+                    static_argnums=_kw_int_tuple(dec, "static_argnums"),
+                    static_argnames=_kw_str_tuple(dec, "static_argnames"),
+                    decorator_of=info.key,
+                )
+        return None
+
+    def _blocking_label(self, chain, node: ast.Call):
+        dotted = ".".join(chain)
+        if dotted in _BLOCKING_CALLS:
+            return dotted, _BLOCKING_CALLS[dotted]
+        last = chain[-1]
+        if last == "block_until_ready":
+            return f"{dotted}()", "device sync"
+        if (
+            last == "get"
+            and len(chain) > 1
+            and (
+                "queue" in chain[-2].lower()
+                or "stream" in chain[-2].lower()
+                or chain[-2].lower().endswith("q")
+            )
+        ):
+            # a BLOCKING queue.get() has no positional args — dict.get(key)
+            # and queue.get(block, timeout) forms are not unbounded waits
+            if not node.args and not any(
+                kw.arg == "timeout" for kw in node.keywords
+            ):
+                return f"{dotted}()", "unbounded queue wait"
+            return None
+        if last == "result" and not node.args and not any(
+            kw.arg == "timeout" for kw in node.keywords
+        ):
+            return f"{dotted}()", "unbounded future wait"
+        return None
+
+    def _emit_from_call(
+        self, chain, node: ast.Call, info: FuncInfo
+    ) -> Optional[EmitSite]:
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            return None
+        first = node.args[0].value
+        if not isinstance(first, str):
+            return None
+        last = chain[-1]
+        if last in ("record", "emit") and len(chain) > 1 and (
+            "events" in chain[-2] or chain[-2] == "_events"
+        ):
+            return EmitSite(name=first, kind="event", node=node)
+        if last in _METRIC_CTORS and len(chain) <= 2:
+            mi = self.modules.get(info.module)
+            base = chain[0] if len(chain) == 2 else last
+            tgt = mi.imports.get(base, "") if mi else ""
+            if tgt.startswith("collections") or base == "collections":
+                return None  # collections.Counter is not a metric
+            return EmitSite(name=first, kind="metric", node=node)
+        return None
+
+    # -- resolution --------------------------------------------------------
+
+    def _class_from_annotation(self, ann, mi: ModuleInfo):
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.split(".")[-1].strip()
+        else:
+            d = dotted_parts(ann)
+            name = d[-1] if d else None
+        if not name:
+            return None
+        return self._lookup_class(name, mi)
+
+    def _lookup_class(self, name: str, mi: ModuleInfo):
+        if name in mi.classes:
+            return mi.classes[name].key
+        tgt = mi.imports.get(name)
+        if tgt and "." in tgt:
+            mod, _, cname = tgt.rpartition(".")
+            tmi = self.modules.get(mod)
+            if tmi and cname in tmi.classes:
+                return tmi.classes[cname].key
+            # re-export through a package __init__: unique class name wins
+            cands = [c.key for c in self.classes.values() if c.name == cname]
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _resolve_class_chain(self, chain, info: FuncInfo):
+        """A call chain that constructs a project class → its key."""
+        if not chain:
+            return None
+        mi = self.modules.get(info.module)
+        if mi is None:
+            return None
+        if len(chain) == 1:
+            return self._lookup_class(chain[0], mi)
+        if len(chain) == 2:
+            base = mi.imports.get(chain[0])
+            if base:
+                tmi = self.modules.get(base)
+                if tmi and chain[1] in tmi.classes:
+                    return tmi.classes[chain[1]].key
+        return None
+
+    def _class_of_expr(self, expr: ast.AST, info: FuncInfo):
+        chain = dotted_parts(expr)
+        if not chain:
+            return None
+        if info.cls is not None and info.self_name and chain[0] == info.self_name:
+            if len(chain) == 1:
+                return info.cls.key
+            if len(chain) == 2:
+                return info.cls.attr_classes.get(chain[1])
+        return None
+
+    def lock_key(self, chain: Tuple[str, ...], info: FuncInfo) -> Optional[str]:
+        """Resolve an acquisition chain to a global lock node:
+        ``('self','_lock')`` in an LLMEngine method → ``LLMEngine._lock``;
+        ``('self','pool','_lock')`` → ``KVBlockPool._lock`` via the attr
+        table; a module global → ``<module>.<NAME>``. None when the chain
+        cannot be anchored to an owner (a local-variable lock)."""
+        if not chain:
+            return None
+        mi = self.modules.get(info.module)
+        if info.self_name and chain[0] == info.self_name and info.cls is not None:
+            if len(chain) == 2:
+                return f"{info.cls.name}.{chain[1]}"
+            if len(chain) == 3:
+                ck = info.cls.attr_classes.get(chain[1])
+                if ck is not None:
+                    return f"{ck[1]}.{chain[2]}"
+            return f"{info.cls.name}.{'.'.join(chain[1:])}"
+        if len(chain) == 1:
+            if mi and mi.globals.get(chain[0]) == "lock":
+                return f"{info.module}.{chain[0]}"
+            return None
+        if mi and chain[0] in mi.imports and len(chain) == 2:
+            base = mi.imports[chain[0]]
+            tmi = self.modules.get(base)
+            if tmi is not None and tmi.globals.get(chain[1]) == "lock":
+                return f"{base}.{chain[1]}"
+        return None
+
+    def resolve_call(self, info: FuncInfo, chain: Tuple[str, ...]) -> Optional[FuncInfo]:
+        """Call chain → callee FuncInfo when it can be anchored: self
+        methods (incl. attr-resolved member objects and jit-wrapper
+        attributes), module functions, imported project functions, and
+        constructor calls (→ ``__init__``)."""
+        mi = self.modules.get(info.module)
+        if not chain or mi is None:
+            return None
+        if info.self_name and chain[0] == info.self_name and info.cls is not None:
+            if len(chain) == 2:
+                m = info.cls.methods.get(chain[1])
+                if m is not None:
+                    return m
+                # self._decode(...) where _decode = jax.jit(self._decode_impl)
+                for _in_init, kind, value in info.cls.attr_assigns.get(chain[1], []):
+                    if kind == "jit_wrapper" and isinstance(value, ast.Call):
+                        site = self._jit_site_from_call(value)
+                        if site is not None:
+                            init = info.cls.methods.get("__init__")
+                            return self.resolve_jit_target(site, init or info)
+                return None
+            if len(chain) == 3:
+                ck = info.cls.attr_classes.get(chain[1])
+                if ck is not None:
+                    owner = self.classes.get(ck)
+                    if owner is not None:
+                        return owner.methods.get(chain[2])
+            return None
+        if len(chain) == 1:
+            if chain[0] in mi.functions:
+                return mi.functions[chain[0]]
+            ck = self._lookup_class(chain[0], mi)
+            if ck is not None:
+                owner = self.classes.get(ck)
+                if owner is not None:
+                    return owner.methods.get("__init__")
+            tgt = mi.imports.get(chain[0])
+            if tgt and "." in tgt:
+                mod, _, fname = tgt.rpartition(".")
+                tmi = self.modules.get(mod)
+                if tmi and fname in tmi.functions:
+                    return tmi.functions[fname]
+            return None
+        if len(chain) == 2:
+            base = mi.imports.get(chain[0])
+            if base:
+                tmi = self.modules.get(base)
+                if tmi:
+                    if chain[1] in tmi.functions:
+                        return tmi.functions[chain[1]]
+                    if chain[1] in tmi.classes:
+                        return tmi.classes[chain[1]].methods.get("__init__")
+        return None
+
+    def resolve_jit_target(self, site: JitSite, info: FuncInfo) -> Optional[FuncInfo]:
+        """The function a jit site traces, when statically resolvable."""
+        if site.decorator_of is not None:
+            return self.functions.get(site.decorator_of)
+        chain = site.target_chain
+        if chain is None:
+            return None
+        if (
+            info.self_name
+            and chain[0] == info.self_name
+            and info.cls is not None
+            and len(chain) == 2
+        ):
+            return info.cls.methods.get(chain[1])
+        return self.resolve_call(info, chain)
+
+    # -- transitive queries ------------------------------------------------
+
+    def trans_lock_acqs(self, info: FuncInfo, _stack: Optional[set] = None):
+        """All ``(lock key, bounded, holder FuncInfo key, line)`` reachable
+        from ``info`` through resolvable calls (memoized, cycle-safe).
+
+        A traversal truncated by a call cycle (some callee was already on
+        the recursion stack, so its contribution is accumulated by the
+        ancestor, not here) is CORRECT for the top-level caller but
+        incomplete as a standalone answer — memoizing it would hand later
+        queries an order-dependent subset and silently drop RL010/RL011
+        edges. Only complete subtrees are cached; truncated ones recompute
+        on the next top-level query."""
+        memo = self._locks_memo
+        if info.key in memo:
+            return memo[info.key]
+        stack = _stack if _stack is not None else set()
+        if info.key in stack:
+            return frozenset()
+        stack.add(info.key)
+        out: set = set()
+        complete = True
+        for acq in info.acquisitions:
+            key = self.lock_key(acq.chain, info)
+            if key is not None:
+                out.add((key, acq.bounded, info.key, acq.node.lineno))
+        for call in info.calls:
+            callee = self.resolve_call(info, call.chain)
+            if callee is not None and callee.key != info.key:
+                if callee.key in stack:
+                    complete = False
+                    continue
+                out |= self.trans_lock_acqs(callee, stack)
+                if callee.key not in memo:
+                    complete = False  # child itself hit a cycle
+        stack.discard(info.key)
+        result = frozenset(out)
+        if complete:
+            memo[info.key] = result
+        return result
+
+    def trans_blocking(self, info: FuncInfo, _stack: Optional[set] = None):
+        """All blocking ops reachable from ``info``: (BlockOp, owner).
+        Same cycle-truncation memo discipline as ``trans_lock_acqs``."""
+        memo = self._block_memo
+        if info.key in memo:
+            return memo[info.key]
+        stack = _stack if _stack is not None else set()
+        if info.key in stack:
+            return []
+        stack.add(info.key)
+        out = [(op, info) for op in info.blocking]
+        complete = True
+        for call in info.calls:
+            callee = self.resolve_call(info, call.chain)
+            if callee is not None and callee.key != info.key:
+                if callee.key in stack:
+                    complete = False
+                    continue
+                out.extend(self.trans_blocking(callee, stack))
+                if callee.key not in memo:
+                    complete = False
+        stack.discard(info.key)
+        if complete:
+            memo[info.key] = out
+        return out
+
+    def daemon_reachable(self) -> set:
+        """Keys of functions reachable from a ``threading.Thread(...,
+        daemon=True)`` target (the monitor/daemon-thread closure for
+        RL011). Non-daemon threads are excluded: the rule's contract is
+        about long-lived monitors, and the repo spawns every monitor
+        with the ``daemon=True`` kwarg (a ``t.daemon = True`` attribute
+        assignment would be missed — documented under-approximation)."""
+        roots: list[FuncInfo] = []
+        for info in self.functions.values():
+            for chain, daemon in info.thread_targets:
+                if not daemon:
+                    continue
+                callee = self.resolve_call(info, chain)
+                if callee is not None:
+                    roots.append(callee)
+        seen: set = set()
+        frontier = roots
+        while frontier:
+            nxt: list[FuncInfo] = []
+            for f in frontier:
+                if f.key in seen:
+                    continue
+                seen.add(f.key)
+                for call in f.calls:
+                    callee = self.resolve_call(f, call.chain)
+                    if callee is not None and callee.key not in seen:
+                        nxt.append(callee)
+            frontier = nxt
+        return seen
+
+    def registries(self, name: str):
+        """Declared registries: (module, names, anchor, FileContext)."""
+        out = []
+        for mi in self.modules.values():
+            if name in mi.registries:
+                vals, node = mi.registries[name]
+                out.append((mi.module, vals, node, mi.ctx))
+        return out
+
+    def lock_orders(self):
+        out = []
+        for mi in self.modules.values():
+            for vals, node in mi.lock_orders:
+                out.append((mi.module, vals, node, mi.ctx))
+        return out
+
+    def prom_refs(self):
+        out = []
+        for mi in self.modules.values():
+            for name, node in mi.string_prom_refs:
+                out.append((name, node, mi))
+        return out
+
+
+def _kw_int_tuple(node: ast.Call, name: str) -> Tuple[int, ...]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+    return ()
+
+
+def _kw_str_tuple(node: ast.Call, name: str) -> Tuple[str, ...]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+    return ()
+
+
+def build_index(
+    contexts: Sequence[FileContext], display_root: Optional[Path] = None
+) -> ProjectIndex:
+    return ProjectIndex(contexts, display_root=display_root)

@@ -14,6 +14,7 @@ from typing import Iterator, Optional
 
 from ray_tpu._lint.core import (
     FileContext,
+    ProjectRule,
     Rule,
     Violation,
     dotted_name,
@@ -533,3 +534,496 @@ class ActorInitIOWithoutTimeout(Rule):
                         ".connect() in actor __init__; set a socket timeout "
                         "first or defer to a ready() method",
                     )
+
+
+# --------------------------------------------------------------------- RL009
+
+
+@register
+class JitTraceCapture(ProjectRule):
+    id = "RL009"
+    name = "jit-trace-capture"
+    description = (
+        "A function handed to jax.jit/pjit/shard_map (via decorator, "
+        "self._step = jax.jit(self._fn) assignment, or functools.partial) "
+        "reads self.<attr> or a module-level mutable global that is model "
+        "STATE (params/weights/arrays/containers, or anything reassigned "
+        "after __init__), not a traced argument. The value is baked into "
+        "the compiled executable at first trace — a later hot-swap "
+        "(LLMEngine.update_weights) silently keeps the stale copy (the "
+        "PR 7 embed/lm_head bug). Static config (ints/strs/bools/shapes, "
+        "static_argnums/static_argnames) is allowed; thread state through "
+        "a traced argument instead."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        seen: set = set()
+        for site, owner in index.jit_sites:
+            target = index.resolve_jit_target(site, owner)
+            if target is None:
+                continue
+            for func, read_attr, node in self._trace_scope_reads(index, target):
+                # one report per (function, attribute) — every further
+                # read of the same baked attr is the same fix
+                key = (func.key, read_attr or getattr(node, "id", ""))
+                if key in seen:
+                    continue
+                seen.add(key)
+                if func.cls is not None and read_attr is not None:
+                    reason = self._mutable_reason(func.cls, read_attr)
+                    yield func.ctx.violation(
+                        self, node,
+                        f"jit-traced {target.qualname} reads "
+                        f"self.{read_attr} ({reason}); the value is baked "
+                        "into the compiled executable at trace time — "
+                        "thread it through a traced argument "
+                        f"(jit site {owner.ctx.display_path}:"
+                        f"{site.node.lineno})",
+                    )
+                elif read_attr is None:
+                    # module-global mutable capture (node carries the name)
+                    yield func.ctx.violation(
+                        self, node,
+                        f"jit-traced {target.qualname} closes over mutable "
+                        f"module global {node.id!r}; the value is baked at "
+                        "trace time — pass it as a traced argument "
+                        f"(jit site {owner.ctx.display_path}:"
+                        f"{site.node.lineno})",
+                    )
+
+    def _mutable_reason(self, cls, attr: str) -> str:
+        from ray_tpu._lint.index import MUTABLE_STATE_NAMES
+
+        assigns = cls.attr_assigns.get(attr, [])
+        if any(not in_init and kind != "jit_wrapper" for in_init, kind, _ in assigns):
+            return "reassigned after __init__"
+        if attr in MUTABLE_STATE_NAMES or cls.attr_from_param.get(attr) in MUTABLE_STATE_NAMES:
+            return "model-state name"
+        return "array/container state"
+
+    def _trace_scope_reads(self, index, target):
+        """(func, attr-or-None, node) for every mutable capture reachable
+        from the traced function: self.<attr> reads in same-class methods
+        it calls, and mutable module-global reads in project module
+        functions it calls."""
+        todo = [target]
+        visited = set()
+        while todo:
+            func = todo.pop()
+            if func.key in visited:
+                continue
+            visited.add(func.key)
+            if func.cls is not None:
+                methods = func.cls.methods
+                for attr, node in func.self_reads:
+                    if attr in methods:
+                        continue  # method access (self._qkv_rows(...))
+                    kind = func.cls.attr_kind(attr)
+                    if kind == "jit_wrapper":
+                        continue
+                    if kind == "mutable":
+                        yield func, attr, node
+            yield from self._global_reads(index, func)
+            for call in func.calls:
+                callee = index.resolve_call(func, call.chain)
+                if callee is None or callee.key in visited:
+                    continue
+                same_class = (
+                    func.cls is not None and callee.cls is func.cls
+                )
+                module_fn = callee.cls is None and not _is_module_scope(callee)
+                if same_class or module_fn:
+                    todo.append(callee)
+
+    def _global_reads(self, index, func):
+        mi = index.modules.get(func.module)
+        if mi is None:
+            return
+        mutable = {
+            n for n, kind in mi.globals.items() if kind == "mutable"
+        }
+        if not mutable:
+            return
+        local: set = set()
+        args = getattr(func.node, "args", None)
+        if args is not None:
+            local |= {a.arg for a in args.args + args.kwonlyargs}
+            if args.vararg:
+                local.add(args.vararg.arg)
+            if args.kwarg:
+                local.add(args.kwarg.arg)
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(func.node):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutable
+                and node.id not in local
+            ):
+                yield func, None, node
+
+
+def _is_module_scope(func) -> bool:
+    return func.qualname == "<module>"
+
+
+# --------------------------------------------------------------------- RL010
+
+
+@register
+class CrossModuleLockOrder(ProjectRule):
+    id = "RL010"
+    name = "cross-module-lock-order"
+    description = (
+        "The global lock-acquisition graph — every with/acquire() nesting, "
+        "INCLUDING locks taken inside methods called while another lock is "
+        "held, with each lock resolved to its owner (LLMEngine._lock, "
+        "KVBlockPool._lock) — contains a cycle, or contradicts a declared "
+        "LOCK_ORDER constant. RL005 only sees ABBA pairs inside one class; "
+        "the deadlocks the runtime actually grew span engine → prefix "
+        "cache → pool across modules. Bounded acquires (timeout=) cannot "
+        "deadlock and add no edge. Declare the canonical order in a "
+        "module-level LOCK_ORDER tuple (see ray_tpu/llm/__init__.py) and "
+        "keep every acquisition path consistent with it."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        edges = self._build_edges(index)
+        yield from self._report_cycles(edges)
+        yield from self._check_declared_orders(index, edges)
+
+    # -- graph construction ------------------------------------------------
+
+    def _build_edges(self, index) -> dict:
+        """{(outer, inner): (ctx, node, description)} — first witness per
+        directed pair. Edges come from direct with-nesting and from calls
+        made while holding a lock into code that (transitively) acquires
+        another, both resolved to owner-qualified lock nodes."""
+        edges: dict = {}
+
+        def add(outer, inner, ctx, node, desc):
+            if outer == inner:
+                return
+            edges.setdefault((outer, inner), (ctx, node, desc))
+
+        for func in index.functions.values():
+            held_keys_cache: dict = {}
+
+            def resolve_held(held):
+                if held not in held_keys_cache:
+                    held_keys_cache[held] = [
+                        k
+                        for k in (index.lock_key(c, func) for c in held)
+                        if k is not None
+                    ]
+                return held_keys_cache[held]
+
+            for acq in func.acquisitions:
+                if acq.bounded:
+                    continue
+                inner = index.lock_key(acq.chain, func)
+                if inner is None:
+                    continue
+                for outer in resolve_held(acq.held):
+                    add(
+                        outer, inner, func.ctx, acq.node,
+                        f"{func.display()}:{acq.node.lineno}",
+                    )
+            for call in func.calls:
+                if not call.held:
+                    continue
+                callee = index.resolve_call(func, call.chain)
+                if callee is None:
+                    continue
+                outers = resolve_held(call.held)
+                if not outers:
+                    continue
+                for lock, bounded, owner_key, line in index.trans_lock_acqs(callee):
+                    if bounded:
+                        continue
+                    owner = index.functions.get(owner_key)
+                    where = owner.display() if owner else owner_key
+                    for outer in outers:
+                        add(
+                            outer, lock, func.ctx, call.node,
+                            f"{func.display()}:{call.node.lineno} -> "
+                            f"{where}:{line}",
+                        )
+        return edges
+
+    # -- cycle reporting ---------------------------------------------------
+
+    def _report_cycles(self, edges: dict) -> Iterator[Violation]:
+        adj: dict = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def path(src, dst):
+            """BFS src → dst, returns node list or None."""
+            frontier = [(src, (src,))]
+            seen = {src}
+            while frontier:
+                cur, p = frontier.pop(0)
+                for nxt in adj.get(cur, ()):
+                    if nxt == dst:
+                        return p + (nxt,)
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append((nxt, p + (nxt,)))
+            return None
+
+        reported: set = set()
+        for (a, b), (ctx, node, desc) in sorted(edges.items()):
+            back = path(b, a)
+            if back is None:
+                continue
+            cycle_key = frozenset(back)
+            if cycle_key in reported:
+                continue
+            reported.add(cycle_key)
+            fwd_desc = desc
+            back_edges = list(zip(back, back[1:]))
+            back_desc = "; ".join(
+                f"{x}->{y} ({edges[(x, y)][2]})" for x, y in back_edges
+            )
+            yield ctx.violation(
+                self, node,
+                f"lock-order cycle: {a} -> {b} ({fwd_desc}) but "
+                f"{back_desc} — an ABBA deadlock under concurrency; pick "
+                "one global order (see LOCK_ORDER)",
+            )
+
+    # -- declared-order verification ---------------------------------------
+
+    def _check_declared_orders(self, index, edges: dict) -> Iterator[Violation]:
+        observed_locks = set()
+        for a, b in edges:
+            observed_locks.add(a)
+            observed_locks.add(b)
+        for func in index.functions.values():
+            for acq in func.acquisitions:
+                k = index.lock_key(acq.chain, func)
+                if k is not None:
+                    observed_locks.add(k)
+        for module, names, node, ctx in index.lock_orders():
+            pos = {n: i for i, n in enumerate(names)}
+            for n in names:
+                if n not in observed_locks:
+                    yield ctx.violation(
+                        self, node,
+                        f"LOCK_ORDER entry {n!r} matches no acquisition "
+                        "anywhere in the project — stale or misspelled "
+                        "(observed locks use Owner._attr naming)",
+                    )
+            for (a, b), (wctx, wnode, desc) in sorted(edges.items()):
+                if a in pos and b in pos and pos[a] > pos[b]:
+                    yield wctx.violation(
+                        self, wnode,
+                        f"acquisition {a} -> {b} ({desc}) contradicts "
+                        f"LOCK_ORDER declared in {module} "
+                        f"({' -> '.join(names)})",
+                    )
+
+
+# --------------------------------------------------------------------- RL011
+
+
+@register
+class BlockingUnderSharedLock(ProjectRule):
+    id = "RL011"
+    name = "blocking-under-lock"
+    description = (
+        "A blocking operation — device sync (block_until_ready, "
+        "jax.device_get/put), unbounded queue.get()/.result(), network IO "
+        "— runs while holding a lock that a daemon/watchdog thread ALSO "
+        "acquires without a timeout. If the blocking op wedges (device "
+        "hang, dead peer), the monitor thread wedges behind the same lock "
+        "and can never diagnose it. This mechanizes the watchdog "
+        "contract: diagnosis must not need the engine lock (RESILIENCE.md "
+        "/ llm.watchdog) — monitors must use bounded acquires or lock-free "
+        "beats, or the blocking op must move outside the lock. A lock "
+        "whose ONLY daemon acquirer is the holding function itself (the "
+        "step loop owning its own lock) does not fire."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        daemon = index.daemon_reachable()
+        daemon_unbounded: dict = {}
+        for key in daemon:
+            func = index.functions.get(key)
+            if func is None:
+                continue
+            for acq in func.acquisitions:
+                if acq.bounded:
+                    continue
+                k = index.lock_key(acq.chain, func)
+                if k is not None:
+                    daemon_unbounded.setdefault(k, set()).add(func.key)
+        if not daemon_unbounded:
+            return
+        seen: set = set()
+
+        def fire(op, owner, lock, holder):
+            others = daemon_unbounded.get(lock, set()) - {holder.key}
+            if not others:
+                return None
+            key = (owner.key, getattr(op.node, "lineno", 0), lock)
+            if key in seen:
+                return None
+            seen.add(key)
+            other = sorted(others)[0]
+            ofunc = index.functions.get(other)
+            where = ofunc.display() if ofunc else other
+            return owner.ctx.violation(
+                self, op.node,
+                f"blocking {op.label} ({op.kind}) while holding {lock}, "
+                f"which the daemon-thread path {where} also acquires "
+                "without a timeout — a wedge here freezes the monitor; "
+                "use a bounded acquire there or move the blocking call "
+                "outside the lock",
+            )
+
+        for func in index.functions.values():
+            for op in func.blocking:
+                for chain in op.held:
+                    lock = index.lock_key(chain, func)
+                    if lock is None:
+                        continue
+                    v = fire(op, func, lock, func)
+                    if v is not None:
+                        yield v
+            for call in func.calls:
+                if not call.held:
+                    continue
+                callee = index.resolve_call(func, call.chain)
+                if callee is None:
+                    continue
+                held_locks = [
+                    k
+                    for k in (index.lock_key(c, func) for c in call.held)
+                    if k is not None and k in daemon_unbounded
+                ]
+                if not held_locks:
+                    continue
+                for op, owner in index.trans_blocking(callee):
+                    for lock in held_locks:
+                        v = fire(op, owner, lock, func)
+                        if v is not None:
+                            yield v
+
+
+# --------------------------------------------------------------------- RL012
+
+
+_PROM_SUFFIXES = ("_bucket", "_count", "_sum")
+
+
+@register
+class ObservabilityNameDrift(ProjectRule):
+    id = "RL012"
+    name = "observability-name-drift"
+    description = (
+        "Metric/event names must stay consistent across the code that "
+        "emits them (Counter/Gauge/Histogram constructors, events.record), "
+        "the declared registries (module-level METRIC_NAMES/EVENT_NAMES "
+        "tuples), the observability docs (OBSERVABILITY.md/RESILIENCE.md "
+        "backticked names; event families like llm.* plus their suffixes), "
+        "and dashboard/PromQL sources (ray_tpu_-prefixed references in "
+        "string literals). Fires on: an exported name nothing documents, "
+        "a registry/doc entry nothing emits, and a dashboard query over a "
+        "metric nothing exports — one pass instead of scattered "
+        "name-pinning tests."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        emitted = {"metric": {}, "event": {}}
+        for site, func in index.emits:
+            emitted[site.kind].setdefault(site.name, []).append((site, func))
+        declared_metrics = set()
+        declared_events = set()
+        for _mod, names, _node, _ctx in index.registries("METRIC_NAMES"):
+            declared_metrics.update(names)
+        for _mod, names, _node, _ctx in index.registries("EVENT_NAMES"):
+            declared_events.update(names)
+        docs = index.doc_names
+        prom_names = {
+            self._strip(name) for name, _n, _mi in index.prom_refs()
+        }
+
+        # exported but undocumented
+        for name, sites in sorted(emitted["metric"].items()):
+            if name in declared_metrics or name in docs or name in prom_names:
+                continue
+            site, func = sites[0]
+            yield func.ctx.violation(
+                self, site.node,
+                f"metric {name!r} is exported but appears in no "
+                "METRIC_NAMES registry, observability doc, or dashboard "
+                "source — document it or drop it",
+            )
+        for name, sites in sorted(emitted["event"].items()):
+            if name in declared_events or self._event_documented(name, docs):
+                continue
+            site, func = sites[0]
+            yield func.ctx.violation(
+                self, site.node,
+                f"event {name!r} is recorded but appears in no EVENT_NAMES "
+                "registry or observability doc (family tables like "
+                "`llm.*` + `suffix` count) — document it or drop it",
+            )
+
+        # declared but never emitted (dead registry entries)
+        for _mod, names, node, ctx in index.registries("METRIC_NAMES"):
+            for name in names:
+                if name not in emitted["metric"]:
+                    yield ctx.violation(
+                        self, node,
+                        f"METRIC_NAMES entry {name!r} is never exported by "
+                        "any Counter/Gauge/Histogram — stale registry entry",
+                    )
+        for _mod, names, node, ctx in index.registries("EVENT_NAMES"):
+            for name in names:
+                if name not in emitted["event"]:
+                    yield ctx.violation(
+                        self, node,
+                        f"EVENT_NAMES entry {name!r} is never recorded — "
+                        "stale registry entry",
+                    )
+
+        # dashboard/PromQL references to metrics nothing exports. Skipped
+        # when the scan saw no metric constructor at all (a single-file
+        # lint of the dashboard module cannot judge what the rest of the
+        # project exports).
+        if not emitted["metric"]:
+            return
+        reported: set = set()
+        for name, node, mi in index.prom_refs():
+            stripped = self._strip(name)
+            if stripped in emitted["metric"] or stripped in reported:
+                continue
+            reported.add(stripped)
+            yield mi.ctx.violation(
+                self, node,
+                f"string references metric ray_tpu_{name} but nothing "
+                f"exports {stripped!r} — a dashboard/alert over it would "
+                "be permanently empty",
+            )
+
+    def _strip(self, name: str) -> str:
+        for suf in _PROM_SUFFIXES:
+            if name.endswith(suf):
+                return name[: -len(suf)]
+        return name
+
+    def _event_documented(self, name: str, docs: set) -> bool:
+        if name in docs:
+            return True
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            family = ".".join(parts[:i]) + ".*"
+            suffix = ".".join(parts[i:])
+            if family in docs and suffix in docs:
+                return True
+        return False

@@ -18,6 +18,22 @@ streaming-generator machinery.
         ...
 """
 
+#: Canonical lock order of the serving plane, outermost first. Any code
+#: path that holds one of these may only acquire locks FURTHER RIGHT —
+#: raylint RL010 builds the whole-program acquisition graph (including
+#: locks taken inside methods called while another lock is held, across
+#: modules) and fails the lint gate on any acquisition that contradicts
+#: this declaration or closes a cycle. The watchdog deliberately sits
+#: outside the order: it only ever takes the engine lock with a bounded
+#: ``acquire(timeout=)`` (which cannot deadlock) and diagnoses wedges
+#: through the lock-free liveness beat instead (RESILIENCE.md).
+LOCK_ORDER = (
+    "RolloutWorker._lock",   # rlhf rollout actor wraps engine submit/poll
+    "LLMEngine._lock",       # the step/admission lock
+    "PrefixCache._lock",     # radix tree over shared KV blocks
+    "KVBlockPool._lock",     # free-list ledger; never calls back up
+)
+
 from ray_tpu.llm.cache import CacheConfig, KVBlockPool  # noqa: F401
 from ray_tpu.llm.drafter import NGramDrafter, SmallModelDrafter  # noqa: F401
 from ray_tpu.llm.engine import EngineConfig, LLMEngine  # noqa: F401

@@ -59,6 +59,29 @@ from ray_tpu.llm.scheduler import (
     Scheduler,
 )
 
+#: every metric the engine exports — the RL012 drift gate cross-checks
+#: this registry against the constructors in ``_metrics()`` (both
+#: directions), so a renamed metric cannot silently orphan its dashboard
+#: panel or doc row
+METRIC_NAMES = (
+    "llm_generated_tokens",
+    "llm_prefill_tokens",
+    "llm_engine_steps",
+    "llm_finished_requests",
+    "llm_preemptions",
+    "llm_running_requests",
+    "llm_waiting_requests",
+    "llm_kv_block_utilization",
+    "llm_time_to_first_token_s",
+    "llm_inter_token_latency_s",
+    "llm_spec_draft_tokens",
+    "llm_spec_accepted_tokens",
+    "llm_spec_acceptance_rate",
+    "llm_spec_draft_seconds",
+    "llm_tokens_per_step",
+    "llm_shed_requests",
+)
+
 _METRICS = None
 _METRICS_LOCK = threading.Lock()
 

@@ -50,6 +50,16 @@ from ray_tpu._private import events as _events
 from ray_tpu._private.log_util import warn_throttled
 from ray_tpu.llm.scheduler import FINISH_CANCELLED, FINISH_DEADLINE
 
+#: watchdog metric family — RL012 cross-checks this registry against the
+#: constructors in ``_metrics()`` and the observability docs
+METRIC_NAMES = (
+    "llm_watchdog_step_age_s",
+    "llm_watchdog_stalls",
+    "llm_watchdog_reaped",
+    "llm_watchdog_leaks",
+    "llm_watchdog_audit_ok",
+)
+
 _WD_METRICS = None
 _WD_LOCK = threading.Lock()
 

@@ -24,6 +24,13 @@ from typing import Any, Callable, Optional
 
 _CREATE_LOCK = threading.Lock()
 
+#: batching metric family — RL012 cross-checks this registry against the
+#: constructors in ``_metrics()`` and the observability docs
+METRIC_NAMES = (
+    "serve_batch_queue_depth",
+    "serve_batch_last_flush_size",
+)
+
 _METRICS = None
 
 

@@ -32,6 +32,8 @@ _CORE_PANELS = [
      "Nodes registered and alive in the cluster."),
     ("Logical resource utilization", 'ray_tpu_core_resource_used{{resource=~".+"}}', "short",
      "Used amount per logical resource (CPU/TPU/custom)."),
+    ("Logical resource capacity", 'ray_tpu_core_resource_total{{resource=~".+"}}', "short",
+     "Registered total per logical resource — pair with utilization to see headroom."),
     ("Object store objects", "ray_tpu_core_objects", "short",
      "Objects tracked by the head directory."),
     ("Object store bytes", "ray_tpu_core_object_bytes", "bytes",

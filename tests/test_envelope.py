@@ -20,6 +20,10 @@ def ray_4cpu():
     ray_tpu.shutdown()
 
 
+# tier1-durations: ~20s on the CI box — the full suite overruns the
+# 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+# non-LLM learning/scale tests run as @slow instead of being cut at random
+@pytest.mark.slow
 def test_deep_task_queue_100k(ray_4cpu):
     """100k no-op tasks queued at once: the signature-bucketed pending queue
     must stay O(signatures) per pass, not O(tasks) (head._PendingQueue) —

@@ -1,0 +1,445 @@
+"""raylint phase-1 (project index) unit suite.
+
+The cross-module rules are only as good as the index underneath them, so
+the index's resolution machinery is pinned directly: per-module symbol
+tables (imports incl. relative), the jit registry across all wrapping
+forms (decorator / ``partial`` decorator / assignment / inline call),
+attribute mutability classification, attr→class resolution (constructor,
+annotation, and cross-module constructor CALL SITES), owner-qualified
+lock keys, transitive lock sets, daemon-thread reachability, and the
+observability-name extraction RL012 consumes.
+"""
+
+import ast
+import textwrap
+
+from ray_tpu._lint.core import FileContext
+from ray_tpu._lint.index import build_index, module_name_for
+
+
+def make_index(tmp_path, files, display_root=None):
+    """files: {relative_path: source} -> ProjectIndex over all of them."""
+    ctxs = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        text = textwrap.dedent(src)
+        p.write_text(text)
+        ctxs.append(FileContext(p, rel, text, ast.parse(text)))
+    return build_index(ctxs, display_root=display_root)
+
+
+# ------------------------------------------------------------ module names
+
+
+def test_module_name_for():
+    assert module_name_for("ray_tpu/llm/engine.py") == "ray_tpu.llm.engine"
+    assert module_name_for("ray_tpu/llm/__init__.py") == "ray_tpu.llm"
+    assert module_name_for("pkg/mod.py") == "pkg.mod"
+
+
+def test_relative_import_resolution(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "pkg/__init__.py": "from .engine import Engine\n",
+            "pkg/engine.py": "from .cache import Pool\n\nclass Engine:\n    pass\n",
+            "pkg/cache.py": "class Pool:\n    pass\n",
+        },
+    )
+    assert idx.modules["pkg.engine"].imports["Pool"] == "pkg.cache.Pool"
+    # package __init__ anchors at the package itself, not its parent
+    assert idx.modules["pkg"].imports["Engine"] == "pkg.engine.Engine"
+
+
+# ------------------------------------------------------------ jit registry
+
+
+def test_jit_registry_all_forms(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                import functools
+
+                import jax
+                from functools import partial
+
+                @jax.jit
+                def decorated(x):
+                    return x
+
+                @partial(jax.jit, static_argnums=(1,))
+                def partial_decorated(x, n):
+                    return x
+
+                def plain(x):
+                    return x
+
+                module_level = jax.jit(plain, static_argnames=("n",))
+                via_partial = jax.jit(functools.partial(plain, 1))
+
+                class Runner:
+                    def __init__(self):
+                        self._step = jax.jit(self._impl, donate_argnums=(0,))
+
+                    def _impl(self, pool):
+                        return pool
+            """,
+        },
+    )
+    resolved = {}
+    for site, owner in idx.jit_sites:
+        target = idx.resolve_jit_target(site, owner)
+        if target is not None:
+            resolved[target.qualname] = site
+    assert "decorated" in resolved
+    assert "partial_decorated" in resolved
+    assert resolved["partial_decorated"].static_argnums == (1,)
+    assert "plain" in resolved  # assignment AND partial form both hit it
+    assert "Runner._impl" in resolved
+    module_site = next(
+        s for s, _ in idx.jit_sites if s.target_chain == ("plain",)
+        and s.static_argnames
+    )
+    assert module_site.static_argnames == ("n",)
+
+
+# ------------------------------------------------- attribute classification
+
+
+ATTR_SRC = {
+    "m.py": """
+        import numpy as np
+
+        class Runner:
+            def __init__(self, params: dict, block_size: int, arch="gpt",
+                         table=None):
+                self.params = params
+                self.block_size = block_size
+                self.arch = arch
+                self.table = table
+                self.buf = np.zeros(4)
+                self.mode = "fast"
+                self.counter = 0
+
+            def tweak(self):
+                self.counter = 1
+    """,
+}
+
+
+def test_attr_kinds(tmp_path):
+    idx = make_index(tmp_path, ATTR_SRC)
+    cls = idx.classes[("m", "Runner")]
+    assert cls.attr_kind("params") == "mutable"      # name + dict annotation
+    assert cls.attr_kind("block_size") == "static"   # int annotation
+    assert cls.attr_kind("arch") == "static"         # str default
+    assert cls.attr_kind("buf") == "mutable"         # array constructor
+    assert cls.attr_kind("mode") == "static"         # literal
+    assert cls.attr_kind("counter") == "mutable"     # reassigned after init
+    assert cls.attr_kind("table") == "unknown"       # no evidence: no fire
+
+
+def test_cross_module_mutation_marks_mutable(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "runner.py": """
+                class Runner:
+                    def __init__(self, weights_in):
+                        self.store = weights_in
+            """,
+            "engine.py": """
+                from runner import Runner
+
+                class Engine:
+                    def __init__(self):
+                        self.runner = Runner({})
+
+                    def swap(self, new):
+                        self.runner.store = new
+            """,
+        },
+    )
+    cls = idx.classes[("runner", "Runner")]
+    assert cls.attr_kind("store") == "mutable"
+
+
+# ------------------------------------------------------- class resolution
+
+
+def test_attr_class_from_ctor_and_callsite(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "cache.py": """
+                import threading
+
+                class Pool:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def free(self):
+                        with self._lock:
+                            return 1
+            """,
+            "engine.py": """
+                import threading
+
+                from cache import Pool
+                from watch import Watchdog
+
+                class Engine:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.pool = Pool()
+                        self.watchdog = Watchdog(self)
+            """,
+            "watch.py": """
+                class Watchdog:
+                    def __init__(self, engine):
+                        self.engine = engine
+            """,
+        },
+    )
+    eng = idx.classes[("engine", "Engine")]
+    assert eng.attr_classes["pool"] == ("cache", "Pool")
+    # ctor CALL SITE inference: Watchdog(self) binds engine -> Engine
+    wd = idx.classes[("watch", "Watchdog")]
+    assert wd.attr_classes["engine"] == ("engine", "Engine")
+
+
+def test_lock_key_resolution(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "cache.py": """
+                import threading
+
+                _GLOBAL_LOCK = threading.Lock()
+
+                class Pool:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def free(self):
+                        with self._lock:
+                            with _GLOBAL_LOCK:
+                                return 1
+            """,
+            "engine.py": """
+                import threading
+
+                from cache import Pool
+
+                class Engine:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.pool = Pool()
+
+                    def step(self):
+                        with self._lock:
+                            with self.pool._lock:
+                                return 1
+            """,
+        },
+    )
+    eng = idx.classes[("engine", "Engine")]
+    step = eng.methods["step"]
+    keys = [idx.lock_key(a.chain, step) for a in step.acquisitions]
+    assert keys == ["Engine._lock", "Pool._lock"]
+    pool_free = idx.classes[("cache", "Pool")].methods["free"]
+    keys = [idx.lock_key(a.chain, pool_free) for a in pool_free.acquisitions]
+    assert keys == ["Pool._lock", "cache._GLOBAL_LOCK"]
+
+
+def test_local_attr_alias_resolves(tmp_path):
+    # `sched = self.scheduler; sched.admit()` must resolve like the
+    # spelled-out chain — the engine step loop is written in this style
+    idx = make_index(
+        tmp_path,
+        {
+            "s.py": """
+                import threading
+
+                class Sched:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def admit(self):
+                        with self._lock:
+                            return 1
+
+                class Engine:
+                    def __init__(self):
+                        self.scheduler = Sched()
+
+                    def step(self):
+                        sched = self.scheduler
+                        return sched.admit()
+            """,
+        },
+    )
+    eng = idx.classes[("s", "Engine")]
+    step = eng.methods["step"]
+    locks = idx.trans_lock_acqs(step)
+    assert any(k == "Sched._lock" for k, _b, _f, _l in locks)
+
+
+def test_trans_locks_cross_module_and_bounded(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "a.py": """
+                import threading
+
+                class A:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def locked(self):
+                        with self._lock:
+                            return 1
+
+                    def bounded(self):
+                        got = self._lock.acquire(timeout=0.1)
+                        if got:
+                            self._lock.release()
+            """,
+            "b.py": """
+                from a import A
+
+                class B:
+                    def __init__(self):
+                        self.a = A()
+
+                    def call_locked(self):
+                        return self.a.locked()
+
+                    def call_bounded(self):
+                        return self.a.bounded()
+            """,
+        },
+    )
+    b = idx.classes[("b", "B")]
+    via_locked = idx.trans_lock_acqs(b.methods["call_locked"])
+    assert ("A._lock", False) in {(k, bd) for k, bd, _f, _l in via_locked}
+    via_bounded = idx.trans_lock_acqs(b.methods["call_bounded"])
+    assert all(bd for _k, bd, _f, _l in via_bounded)  # bounded only
+
+
+def test_daemon_reachability(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "w.py": """
+                import threading
+
+                class W:
+                    def start(self):
+                        self._t = threading.Thread(target=self._run, daemon=True)
+                        self._j = threading.Thread(target=self._joined)
+
+                    def _run(self):
+                        self._tick()
+
+                    def _tick(self):
+                        return 1
+
+                    def _joined(self):
+                        return 3
+
+                    def not_a_thread(self):
+                        return 2
+            """,
+        },
+    )
+    reach = idx.daemon_reachable()
+    assert "w:W._run" in reach
+    assert "w:W._tick" in reach      # transitively
+    assert "w:W.not_a_thread" not in reach
+    # a non-daemon (join()ed, short-lived) thread is not a monitor: RL011's
+    # contract is about daemon/watchdog threads only
+    assert "w:W._joined" not in reach
+
+
+def test_trans_locks_complete_despite_call_cycle(tmp_path):
+    # memo regression: a traversal truncated by a call cycle must not be
+    # cached as final — with early() scanned first (poisoning the memo for
+    # g via the truncated f<->g recursion), a later top-level query for
+    # late()'s locks must still see CV through f -> g
+    idx = make_index(
+        tmp_path,
+        {
+            "c.py": """
+                import threading
+
+                OUTER_LOCK = threading.Lock()
+                OTHER_LOCK = threading.Lock()
+                CV = threading.Lock()
+
+                def early():
+                    with OTHER_LOCK:
+                        f()
+
+                def f():
+                    g()
+
+                def g():
+                    with CV:
+                        f()
+
+                def late():
+                    with OUTER_LOCK:
+                        f()
+            """,
+        },
+    )
+    mi = idx.modules["c"]
+    # query in scan order so the cycle-truncated path runs first
+    idx.trans_lock_acqs(mi.functions["early"])
+    late_locks = {k for k, _b, _f, _l in idx.trans_lock_acqs(mi.functions["late"])}
+    assert "c.CV" in late_locks
+
+
+# ------------------------------------------------- observability extraction
+
+
+def test_emit_and_registry_extraction(tmp_path):
+    md = tmp_path / "OBSERVABILITY.md"
+    md.write_text("| `llm.*` | `submit`, `finish` |\n`llm_documented_metric`\n")
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                from collections import Counter as CollectionsCounter
+
+                from ray_tpu._private import events as _events
+                from ray_tpu.util.metrics import Counter, Gauge
+
+                METRIC_NAMES = ("m_one", "m_two")
+                EVENT_NAMES = ("sys.boot",)
+                LOCK_ORDER = ("Engine._lock", "Pool._lock")
+
+                c = Counter("m_one", "doc")
+                g = Gauge("m_two", "doc")
+                histo = CollectionsCounter(["not", "a", "metric"])
+                _events.record("sys.boot", n=1)
+                panel = "rate(ray_tpu_m_one[1m])"
+            """,
+        },
+        display_root=tmp_path,
+    )
+    metric_names = {s.name for s, _f in idx.emits if s.kind == "metric"}
+    event_names = {s.name for s, _f in idx.emits if s.kind == "event"}
+    assert metric_names == {"m_one", "m_two"}  # collections.Counter excluded
+    assert event_names == {"sys.boot"}
+    regs = idx.registries("METRIC_NAMES")
+    assert regs and regs[0][1] == ["m_one", "m_two"]
+    orders = idx.lock_orders()
+    assert orders and orders[0][1] == ["Engine._lock", "Pool._lock"]
+    assert ("m_one") in {n for n, _node, _mi in idx.prom_refs()}
+    # doc names parsed from the markdown at display_root
+    assert "llm.*" in idx.doc_names and "submit" in idx.doc_names
+    assert "llm_documented_metric" in idx.doc_names

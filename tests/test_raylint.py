@@ -26,7 +26,7 @@ def rule_ids(violations):
 
 
 def test_rule_registry_complete():
-    assert {f"RL{i:03d}" for i in range(1, 9)} <= ALL_RULE_IDS
+    assert {f"RL{i:03d}" for i in range(1, 13)} <= ALL_RULE_IDS
 
 
 # --------------------------------------------------------------------- RL001
@@ -559,6 +559,395 @@ def test_rl008_suppressed(tmp_path):
                 subprocess.run(["make"])  # raylint: disable=RL008
     """
     assert "RL008" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL009
+
+
+RL009_POS = """
+    import jax
+
+    class Runner:
+        def __init__(self, params: dict, block_size: int):
+            self.params = params
+            self.block_size = block_size
+            self._step = jax.jit(self._impl, donate_argnums=(0,))
+
+        def _embed(self, tokens):
+            return self.params["embed"][tokens]
+
+        def _impl(self, pool, tokens):
+            return pool, self._embed(tokens) + self.block_size
+"""
+
+
+def test_rl009_fires_transitively(tmp_path):
+    vs = lint_snippet(tmp_path, RL009_POS)
+    hits = [v for v in vs if v.rule == "RL009"]
+    assert len(hits) == 1  # one report per (function, attribute)
+    assert "self.params" in hits[0].message
+    assert hits[0].symbol == "Runner._embed"  # the read site, not the jit site
+    # static config (int annotation) read in the same traced scope is fine
+    assert not any("block_size" in v.message for v in vs)
+
+
+def test_rl009_decorator_form_fires(tmp_path):
+    src = """
+        import jax
+
+        class Runner:
+            def __init__(self, params: dict):
+                self.params = params
+
+            @jax.jit
+            def step(self, pool):
+                return pool, self.params["w"]
+    """
+    assert "RL009" in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl009_partial_decorator_fires(tmp_path):
+    src = """
+        from functools import partial
+
+        import jax
+
+        WEIGHTS = {}
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(pool, n):
+            return pool, WEIGHTS["w"]
+    """
+    vs = lint_snippet(tmp_path, src)
+    assert "RL009" in rule_ids(vs)
+    assert "WEIGHTS" in next(v for v in vs if v.rule == "RL009").message
+
+
+def test_rl009_traced_argument_ok(tmp_path):
+    # the fix the rule demands — params threaded through the traced
+    # argument — must lint clean (this is model_runner's real shape)
+    src = """
+        import jax
+
+        class Runner:
+            def __init__(self, params: dict, block_size: int):
+                self.params = params
+                self.block_size = block_size
+                self._step = jax.jit(self._impl)
+
+            def _embed(self, params, tokens):
+                return params["embed"][tokens]
+
+            def _impl(self, params, pool, tokens):
+                return pool, self._embed(params, tokens) + self.block_size
+
+            def step(self, pool, tokens):
+                return self._step(self.params, pool, tokens)
+    """
+    assert "RL009" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl009_unjitted_method_ok(tmp_path):
+    src = """
+        class Runner:
+            def __init__(self, params: dict):
+                self.params = params
+
+            def host_side(self, tokens):
+                return self.params["embed"][tokens]
+    """
+    assert "RL009" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl009_suppressed(tmp_path):
+    src = RL009_POS.replace(
+        'return self.params["embed"][tokens]',
+        'return self.params["embed"][tokens]  # raylint: disable=RL009',
+    )
+    assert "RL009" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL010
+
+
+RL010_CACHE = """
+    import threading
+
+
+    class BlockPool:
+        def __init__(self, engine):
+            self._lock = threading.Lock()
+            self.engine = engine
+
+        def reserve(self):
+            with self._lock:
+                return self.engine.utilization()
+
+        def free(self):
+            with self._lock:
+                return 1
+"""
+
+RL010_ENGINE = """
+    import threading
+
+    from cache import BlockPool
+
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pool = BlockPool(self)
+
+        def step(self):
+            with self._lock:
+                self.pool.free()
+
+        def utilization(self):
+            with self._lock:
+                return 0.5
+"""
+
+
+def write_lock_fixture(tmp_path, cache_src=RL010_CACHE, engine_src=RL010_ENGINE):
+    (tmp_path / "cache.py").write_text(textwrap.dedent(cache_src))
+    (tmp_path / "engine.py").write_text(textwrap.dedent(engine_src))
+    return run_paths([str(tmp_path)])
+
+
+def test_rl010_cross_module_cycle_fires(tmp_path):
+    vs = write_lock_fixture(tmp_path)
+    hits = [v for v in vs if v.rule == "RL010"]
+    assert len(hits) == 1  # one report per cycle
+    msg = hits[0].message
+    # both witness paths are cited file:line
+    assert "cache.py" in msg and "engine.py" in msg
+    assert "Engine._lock" in msg and "BlockPool._lock" in msg
+
+
+def test_rl010_consistent_order_ok(tmp_path):
+    consistent = RL010_CACHE.replace(
+        """def reserve(self):
+            with self._lock:
+                return self.engine.utilization()""",
+        """def reserve(self):
+            return self.engine.utilization()""",
+    )
+    vs = write_lock_fixture(tmp_path, cache_src=consistent)
+    assert "RL010" not in rule_ids(vs)
+
+
+RL010_ENGINE_DECLARED = """
+    import threading
+
+    from cache import BlockPool
+
+    LOCK_ORDER = ("BlockPool._lock", "Engine._lock")
+
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pool = BlockPool(self)
+
+        def step(self):
+            with self._lock:
+                self.pool.free()
+
+        def utilization(self):
+            return 0.5
+"""
+
+
+def test_rl010_lock_order_contradiction_fires(tmp_path):
+    # no cycle — but an edge against the declared LOCK_ORDER still fires
+    vs = write_lock_fixture(tmp_path, engine_src=RL010_ENGINE_DECLARED)
+    hits = [v for v in vs if v.rule == "RL010"]
+    assert hits and any("contradicts LOCK_ORDER" in v.message for v in hits)
+
+
+def test_rl010_stale_lock_order_entry_fires(tmp_path):
+    engine = RL010_ENGINE_DECLARED.replace(
+        'LOCK_ORDER = ("BlockPool._lock", "Engine._lock")',
+        'LOCK_ORDER = ("Engine._lock", "BlockPool._lock", "Ghost._lock")',
+    )
+    vs = write_lock_fixture(tmp_path, engine_src=engine)
+    assert any(
+        v.rule == "RL010" and "matches no acquisition" in v.message for v in vs
+    )
+
+
+def test_rl010_suppressed(tmp_path):
+    vs = write_lock_fixture(tmp_path)
+    hits = [v for v in vs if v.rule == "RL010"]
+    assert len(hits) == 1
+    # suppress on the reported anchor line, wherever the cycle anchored
+    target = tmp_path / hits[0].path.split("/")[-1]
+    lines = target.read_text().splitlines()
+    lines[hits[0].line - 1] += "  # raylint: disable=RL010"
+    target.write_text("\n".join(lines))
+    assert "RL010" not in rule_ids(run_paths([str(tmp_path)]))
+
+
+# --------------------------------------------------------------------- RL011
+
+
+RL011_POS = """
+    import threading
+
+    import jax
+
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.watchdog = Watchdog(self)
+
+        def step(self, out):
+            with self._lock:
+                return jax.device_get(out)
+
+
+    class Watchdog:
+        def __init__(self, engine):
+            self.engine = engine
+            self._thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self.engine._lock:
+                return self.engine
+"""
+
+
+def test_rl011_fires(tmp_path):
+    vs = lint_snippet(tmp_path, RL011_POS)
+    hits = [v for v in vs if v.rule == "RL011"]
+    assert len(hits) == 1
+    assert "jax.device_get" in hits[0].message
+    assert "Engine._lock" in hits[0].message
+    assert "Watchdog._run" in hits[0].message  # names the monitor path
+
+
+def test_rl011_bounded_monitor_ok(tmp_path):
+    # the watchdog contract: a monitor that only ever takes the lock with
+    # a timeout cannot wedge, so the engine's device sync is fine
+    src = RL011_POS.replace(
+        """def _run(self):
+            with self.engine._lock:
+                return self.engine""",
+        """def _run(self):
+            got = self.engine._lock.acquire(timeout=0.1)
+            if got:
+                self.engine._lock.release()""",
+    )
+    assert "RL011" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl011_step_loop_owns_its_lock_ok(tmp_path):
+    # the lock's ONLY daemon acquirer is the holding function itself (a
+    # run_loop daemon driving step()) — the step loop may sync under its
+    # own lock; that is what the lock-free beat exists for
+    src = """
+        import threading
+
+        import jax
+
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self.step, daemon=True)
+
+            def step(self, out=None):
+                with self._lock:
+                    return jax.device_get(out)
+    """
+    assert "RL011" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl011_non_daemon_thread_ok(tmp_path):
+    # a join()ed non-daemon thread is not a monitor — the rule's contract
+    # (and its message) is about daemon/watchdog threads
+    src = RL011_POS.replace(
+        "threading.Thread(target=self._run, daemon=True)",
+        "threading.Thread(target=self._run)",
+    )
+    assert "RL011" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl011_suppressed(tmp_path):
+    src = RL011_POS.replace(
+        "return jax.device_get(out)",
+        "return jax.device_get(out)  # raylint: disable=RL011",
+    )
+    assert "RL011" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL012
+
+
+RL012_POS = """
+    from ray_tpu._private import events as _events
+    from ray_tpu.util.metrics import Counter
+
+    METRIC_NAMES = (
+        "widget_hits",
+        "widget_ghost",
+    )
+
+    hits = Counter("widget_hits", "doc")
+    misses = Counter("widget_misses", "doc")
+    _events.record("widget.undocumented", n=1)
+    panel = "rate(ray_tpu_widget_orphan[1m])"
+"""
+
+
+def test_rl012_all_four_drift_directions(tmp_path):
+    vs = lint_snippet(tmp_path, RL012_POS)
+    msgs = [v.message for v in vs if v.rule == "RL012"]
+    assert len(msgs) == 4
+    assert any("widget_ghost" in m and "stale registry" in m for m in msgs)
+    assert any("widget_misses" in m and "no METRIC_NAMES" in m for m in msgs)
+    assert any("widget.undocumented" in m for m in msgs)
+    assert any("widget_orphan" in m and "permanently empty" in m for m in msgs)
+
+
+def test_rl012_registry_and_emission_consistent_ok(tmp_path):
+    src = """
+        from ray_tpu.util.metrics import Counter
+
+        METRIC_NAMES = ("widget_hits",)
+
+        hits = Counter("widget_hits", "doc")
+    """
+    assert "RL012" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl012_collections_counter_is_not_a_metric(tmp_path):
+    src = """
+        from collections import Counter
+
+        tally = Counter("abc")
+    """
+    assert "RL012" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl012_suppressed(tmp_path):
+    src = RL012_POS.replace(
+        'misses = Counter("widget_misses", "doc")',
+        'misses = Counter("widget_misses", "doc")  # raylint: disable=RL012',
+    ).replace(
+        '_events.record("widget.undocumented", n=1)',
+        '_events.record("widget.undocumented", n=1)  # raylint: disable=RL012',
+    ).replace(
+        'panel = "rate(ray_tpu_widget_orphan[1m])"',
+        'panel = "rate(ray_tpu_widget_orphan[1m])"  # raylint: disable=RL012',
+    ).replace(
+        '"widget_ghost",',
+        '"widget_hits",',
+    )
+    vs = lint_snippet(tmp_path, src)
+    assert "RL012" not in rule_ids(vs)
 
 
 # ----------------------------------------------------------------- machinery

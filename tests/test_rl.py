@@ -367,6 +367,10 @@ def test_sac_training_step_smoke():
         algo.stop()
 
 
+# tier1-durations: ~186s on the CI box — the full suite overruns the
+# 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+# non-LLM learning/scale tests run as @slow instead of being cut at random
+@pytest.mark.slow
 def test_sac_learns_pendulum():
     """SAC must clearly improve on Pendulum-v1 (random play averages about
     -1200; threshold mirrors rllib/tuned_examples/sac scaled to CI budget)."""

@@ -10,6 +10,10 @@ import pytest
 from ray_tpu.rl.algorithms.appo import APPOConfig
 
 
+# tier1-durations: ~19s on the CI box — the full suite overruns the
+# 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+# non-LLM learning/scale tests run as @slow instead of being cut at random
+@pytest.mark.slow
 def test_appo_learns_cartpole(ray_start_regular):
     algo = (
         APPOConfig()

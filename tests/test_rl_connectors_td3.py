@@ -70,6 +70,10 @@ class TestConnectors:
 
 
 class TestTD3:
+    # tier1-durations: ~12s on the CI box — the full suite overruns the
+    # 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+    # non-LLM learning/scale tests run as @slow instead of being cut at random
+    @pytest.mark.slow
     def test_td3_trains_and_improves_q(self):
         from ray_tpu.rl.algorithms.td3 import TD3Config
 

@@ -60,6 +60,10 @@ def test_dreamer_world_model_learns():
         assert key in m
 
 
+# tier1-durations: ~14s on the CI box — the full suite overruns the
+# 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+# non-LLM learning/scale tests run as @slow instead of being cut at random
+@pytest.mark.slow
 def test_dreamer_learns_cartpole():
     """Imagination-trained policy solves CartPole: the actor never sees a
     real environment return during its update — learning here proves the

@@ -117,6 +117,10 @@ class TestCQL:
         assert "learner/cql_penalty" in res
         assert np.isfinite(res["learner/cql_penalty"])
 
+    # tier1-durations: ~31s on the CI box — the full suite overruns the
+    # 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+    # non-LLM learning/scale tests run as @slow instead of being cut at random
+    @pytest.mark.slow
     def test_cql_is_more_conservative_than_sac(self):
         """The defining CQL property: the penalty shrinks the gap between
         Q on out-of-distribution (policy/random) actions and Q on dataset

@@ -132,6 +132,10 @@ def test_pixel_catch_learns_with_frame_pipeline(ray_start_regular):
         algo.stop()
 
 
+# tier1-durations: ~25s on the CI box — the full suite overruns the
+# 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+# non-LLM learning/scale tests run as @slow instead of being cut at random
+@pytest.mark.slow
 def test_learner_group_two_learners_match_single(ray_start_regular):
     """2 data-parallel learners must evolve weights IDENTICALLY to one
     learner on the full batch (grads averaged sample-weighted; every

@@ -31,6 +31,10 @@ def _tiny_hf_model():
 
 
 class TestHuggingFace:
+    # tier1-durations: ~48s on the CI box — the full suite overruns the
+    # 870s tier-1 budget (truncation, not failures; ROADMAP), so the heaviest
+    # non-LLM learning/scale tests run as @slow instead of being cut at random
+    @pytest.mark.slow
     def test_gpt2_logits_match(self):
         """Converted weights reproduce the torch forward pass.
 
