@@ -230,6 +230,8 @@ def obs_ab_main() -> dict:
     import ray_tpu
     from ray_tpu._private import events as _events
 
+    env = bench_environment()
+
     ray_tpu.init(num_cpus=8)
 
     @ray_tpu.remote
@@ -263,6 +265,8 @@ def obs_ab_main() -> dict:
         "events_enabled": _events.enabled(),
         "series_enabled": os.environ.get("RAY_TPU_METRICS_SERIES", "1")
         not in ("0", "false", "off"),
+        "trace_sample": os.environ.get("RAY_TPU_TRACE_SAMPLE", "1"),
+        "env": env,
         "detail": {r["metric"]: r["value"] for r in results},
     }
     print(json.dumps(rec), flush=True)

@@ -4079,9 +4079,15 @@ class Head:
 
     def rpc_task_events(self):
         with self.lock:
+            # rid None = a rootless submission (specs no longer ship a
+            # per-task minted context — PR-11 zero-cost tracing): derive
+            # the task-rooted id LAZILY here, matching what the worker's
+            # LazyTaskContext materializes, so the state-API contract
+            # (every task row carries a request_id) is unchanged
             return [
                 {"task_id": tid.hex(), "name": name, "state": state,
-                 "time": t, "kind": kind, "request_id": rid}
+                 "time": t, "kind": kind,
+                 "request_id": rid if rid is not None else tid.hex()[:16]}
                 for tid, name, state, t, kind, rid in self.task_events
             ]
 

@@ -514,7 +514,9 @@ def _stream_results(state: WorkerState, spec: dict, gen) -> None:
     LLM path) keep their request_id for the stream's whole life."""
     from ray_tpu.util import tracing as _tracing
 
-    prev_trace = _tracing.set_trace_context(spec.get("trace_ctx"))
+    prev_trace = _tracing.set_trace_context(
+        _tracing.task_context(spec.get("trace_ctx"), spec["task_id"])
+    )
     try:
         _stream_results_inner(state, spec, gen)
     finally:
@@ -613,8 +615,12 @@ def _run_task(state: WorkerState, spec: dict):
     state.task_threads[task_id] = threading.get_ident()
     # re-install the submitter's trace context on the executing thread:
     # spans/events inside the task body (and any nested .remote() hops)
-    # carry the same request_id end-to-end (util.tracing module doc)
-    prev_trace = _tracing.set_trace_context(spec.get("trace_ctx"))
+    # carry the same request_id end-to-end (util.tracing module doc).
+    # A spec with no context gets a LAZY task-rooted one — the id (and
+    # its sampling decision) materialize only if something observes it
+    prev_trace = _tracing.set_trace_context(
+        _tracing.task_context(spec.get("trace_ctx"), task_id)
+    )
     if spec["kind"] != "actor_method":
         # a plain task runs in its SUBMITTER's namespace (client sessions):
         # named-actor ops inside the function resolve where the submitter's
@@ -838,7 +844,7 @@ async def _arun(state: WorkerState, spec: dict):
     # under interleaving, a saved "previous" context can belong to a request
     # that already finished, and restoring it would tag the loop thread's
     # later events with a dead request's id indefinitely.
-    my_trace = spec.get("trace_ctx")
+    my_trace = _tracing.task_context(spec.get("trace_ctx"), task_id)
     _tracing.set_trace_context(my_trace)
     try:
         group = spec.get("concurrency_group")

@@ -111,14 +111,17 @@ class ActorHandle:
             "return_ids": return_ids,
             "name": f"{self._class_name}.{name}",
         }
-        # trace-context propagation: the submitter's request_id rides the
-        # spec so the executing worker's spans/events nest under it; with
-        # no active context the call roots a trace at its own task id
+        # trace-context propagation: the submitter's context rides the
+        # spec by reference (sampled dict, or the shared unsampled token
+        # that keeps forensics correlated while spans stay free); with no
+        # active context the worker roots a lazy trace at the task id
         from ray_tpu.util import tracing as _tracing
 
-        spec["trace_ctx"] = _tracing.get_trace_context() or {
-            "request_id": task_id.hex()[:16]
-        }
+        tctx = _tracing.get_trace_context()
+        if tctx is not None:
+            sp_ctx = _tracing.context_for_spec(tctx)
+            if sp_ctx is not None:
+                spec["trace_ctx"] = sp_ctx
         if concurrency_group:
             spec["concurrency_group"] = concurrency_group
         refs = ctx.submit_actor_task(spec)

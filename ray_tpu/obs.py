@@ -60,6 +60,14 @@ import sys
 import time
 from typing import Optional
 
+# `obs overhead` probe metrics (raylint RL012 registry): created only by
+# measure_overhead() in the probing process, never in a serving cluster
+METRIC_NAMES = (
+    "obs_overhead_counter",
+    "obs_overhead_gauge",
+    "obs_overhead_hist",
+)
+
 
 def _attach(address: Optional[str]):
     import ray_tpu
@@ -371,6 +379,95 @@ def cmd_export(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# overhead: self-measured emit-path costs (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def measure_overhead(n: int = 200_000) -> dict:
+    """Microbenchmark the telemetry hot paths IN THIS PROCESS: ns per
+    flight-recorder event, per unsampled trace context (mint + span),
+    per counter increment / gauge set / histogram observe.  These are the
+    numbers the OBSERVABILITY.md overhead budget pins — one command to
+    spot a hot-path regression without booting a cluster."""
+    from ray_tpu._private import events as ev
+    from ray_tpu.util import metrics as um
+    from ray_tpu.util import tracing as tr
+
+    def bench(fn, k=n) -> float:
+        fn()  # warm (ring/cell/context creation off the measured loop)
+        t0 = time.perf_counter_ns()
+        for _ in range(k):
+            fn()
+        return (time.perf_counter_ns() - t0) / k
+
+    out: dict = {"n": n}
+
+    prev_enabled = ev.enabled()
+    ev.set_enabled(True)
+    out["event_record_ns"] = bench(lambda: ev.record("obs.overhead", i=1))
+    ev.set_enabled(False)
+    out["event_record_disabled_ns"] = bench(lambda: ev.record("obs.overhead"))
+    ev.set_enabled(prev_enabled)
+
+    # unsampled context: the mint decision + installing the token + a
+    # span that must short-circuit (the zero-cost tracing contract)
+    prev_rate = os.environ.get("RAY_TPU_TRACE_SAMPLE")
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = "0"
+    try:
+        def unsampled_hop():
+            with tr.trace_context():
+                with tr.span("obs.overhead"):
+                    pass
+
+        # per-REQUEST cost: mint (sampling decision + id) + install + one span
+        out["unsampled_context_ns"] = bench(unsampled_hop, k=max(1, n // 4))
+
+        # per-SPAN cost under an already-unsampled context — the
+        # "unsampled tracing is free" contract is THIS number
+        prev_ctx = tr.set_trace_context(tr.mint_context())
+
+        def unsampled_span():
+            with tr.span("obs.overhead"):
+                pass
+
+        out["unsampled_span_ns"] = bench(unsampled_span)
+        tr.set_trace_context(prev_ctx)
+    finally:
+        if prev_rate is None:
+            os.environ.pop("RAY_TPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["RAY_TPU_TRACE_SAMPLE"] = prev_rate
+
+    c = um.Counter("obs_overhead_counter", "obs overhead probe")
+    out["counter_inc_ns"] = bench(c.inc)
+    g = um.Gauge("obs_overhead_gauge", "obs overhead probe")
+    out["gauge_set_ns"] = bench(lambda: g.set(1.0))
+    h = um.Histogram("obs_overhead_hist", "obs overhead probe")
+    out["histogram_observe_ns"] = bench(lambda: h.observe(0.5))
+    return {k: round(v, 1) if isinstance(v, float) else v for k, v in out.items()}
+
+
+def cmd_overhead(args) -> int:
+    res = measure_overhead(args.n)
+    if args.json:
+        print(json.dumps(res))
+        return 0
+    print(f"telemetry emit-path self-measurement ({res['n']} iterations):")
+    rows = [
+        ("flight-recorder record()", res["event_record_ns"]),
+        ("record() while disabled", res["event_record_disabled_ns"]),
+        ("unsampled trace ctx + span", res["unsampled_context_ns"]),
+        ("span under unsampled ctx", res["unsampled_span_ns"]),
+        ("Counter.inc()", res["counter_inc_ns"]),
+        ("Gauge.set()", res["gauge_set_ns"]),
+        ("Histogram.observe()", res["histogram_observe_ns"]),
+    ]
+    for label, v in rows:
+        print(f"  {label:<28} {v:>9.1f} ns")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # req
 # ---------------------------------------------------------------------------
 
@@ -629,6 +726,15 @@ def main(argv=None) -> int:
                    help="force one evaluation pass before reporting (headless/CI)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "overhead",
+        help="self-measure telemetry emit-path cost (ns/event, "
+        "ns/unsampled-context, ns/counter-inc) — no cluster needed",
+    )
+    p.add_argument("-n", type=int, default=200_000, help="iterations per probe")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_overhead)
 
     p = sub.add_parser(
         "export", help="OTLP-JSON export of spans + events + metric series"

@@ -15,6 +15,7 @@ from ray_tpu._private import options as opt
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.runtime import get_ctx
+from ray_tpu.util import tracing as _tracing
 
 
 class RemoteFunction:
@@ -94,14 +95,17 @@ class RemoteFunction:
             "return_ids": return_ids,
         }
         # trace-context propagation (util.tracing): a submission under an
-        # active context carries its request_id to the executing worker;
-        # otherwise the task roots a fresh trace at its own id — free
-        # (task ids are already random), so every task tree is traceable
-        from ray_tpu.util import tracing as _tracing
-
-        spec["trace_ctx"] = _tracing.get_trace_context() or {
-            "request_id": task_id.hex()[:16]
-        }
+        # active context ships it BY REFERENCE (sampled dict or shared
+        # unsampled token — the token keeps request-id forensics intact
+        # downstream while spans stay free); with no context at all the
+        # executing worker roots a lazy trace at the task's own id, so
+        # every task tree stays traceable without the submitter paying a
+        # per-task id mint
+        tctx = _tracing.get_trace_context()
+        if tctx is not None:
+            sp_ctx = _tracing.context_for_spec(tctx)
+            if sp_ctx is not None:
+                spec["trace_ctx"] = sp_ctx
         ns = getattr(ctx, "namespace", "default")
         if ns != "default":
             # tasks inherit the submitter's namespace (reference: job-scoped

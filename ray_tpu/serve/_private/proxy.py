@@ -374,9 +374,11 @@ class ProxyActor:
         gen = None
         try:
             # trace context on the stream thread: the streaming replica hop
-            # inherits the proxy-minted request_id
+            # inherits the proxy-minted request_id (mint_context makes the
+            # head-sampling decision once; an unsampled stream ships no
+            # context downstream and records no spans)
             _tracing.set_trace_context(
-                {"request_id": request_id} if request_id else None
+                _tracing.mint_context(request_id) if request_id else None
             )
             handle, _ = self._handle_for(app)
             self._shed_if_doomed(handle, app, deadline_s, request_id)

@@ -57,7 +57,17 @@ def _tag_key(tags: Optional[dict]) -> str:
 
 
 class Metric:
-    """Base: named, tagged, locally aggregated."""
+    """Base: named, tagged, locally aggregated.
+
+    Hot-path architecture (PR-11 rebuild; OBSERVABILITY.md): increments
+    land in **per-thread cells** — each emitting thread owns a private
+    dict it alone mutates, registered once by an atomic ``list.append``.
+    The emit path (``Counter.inc`` / ``Gauge.set`` /
+    ``Histogram.observe``) therefore acquires NO shared lock, ever; the
+    cells are merged only at snapshot time (the flusher's 1 Hz sample or
+    an explicit ``collect()``), where all the aggregation cost lives.
+    ``self._lock`` guards nothing on the emit path — it serializes
+    snapshot-side compaction only."""
 
     kind = "metric"
 
@@ -70,6 +80,12 @@ class Metric:
         self._default_tags: dict = {}
         self._lock = threading.Lock()
         self._data: dict[str, float | list] = defaultdict(float)
+        self._tls = threading.local()
+        # (owner thread, cell) per emitting thread. Appended lock-free at
+        # first emit; dead threads' cells are folded into _data and
+        # removed at snapshot time (under _lock) so thread churn — e.g.
+        # serve's per-stream proxy threads — cannot grow this unboundedly
+        self._cells: list[tuple] = []
         with _registry_lock:
             _registry.append(self)
         _ensure_flusher()
@@ -79,6 +95,8 @@ class Metric:
         return self
 
     def _tags(self, tags: Optional[dict]) -> str:
+        if not tags and not self._default_tags:
+            return ""  # untagged fast path: no dict build, no set math
         merged = dict(self._default_tags)
         if tags:
             merged.update(tags)
@@ -87,13 +105,61 @@ class Metric:
             raise ValueError(f"Unknown tag(s) {sorted(extra)} for metric {self.name!r}")
         return _tag_key(merged)
 
+    def _cell(self) -> dict:
+        """This thread's private cell. First touch registers it via a
+        plain list.append — atomic under the GIL, no lock (the raylint
+        hot-path fixture asserts the emit path stays lock-free)."""
+        try:
+            return self._tls.cell
+        except AttributeError:
+            cell: dict = {}
+            self._cells.append((threading.current_thread(), cell))
+            self._tls.cell = cell
+            return cell
+
+    @staticmethod
+    def _fold_into(out: dict, cell: dict) -> None:
+        for k, v in cell.copy().items():
+            if isinstance(v, list):  # histogram vector: elementwise sum
+                prev = out.get(k)
+                out[k] = (
+                    [a + b for a, b in zip(prev, v)]
+                    if isinstance(prev, list)
+                    else list(v)
+                )
+            else:  # counter cell: sum
+                out[k] = out.get(k, 0.0) + v
+
+    def _merged_data(self) -> dict:
+        """Base data + every thread cell, merged by kind (caller holds
+        ``self._lock``). Cells are single-writer dicts; ``dict.copy`` is
+        an atomic C call, so the merge sees a consistent point-in-time
+        view of each cell. Cells whose owner thread has exited are folded
+        PERMANENTLY into ``_data`` and dropped from the list — the owner
+        can never write again, so the fold is exact, and per-stream /
+        per-request threads can't leak cells for the process lifetime.
+        (The lock serializes concurrent snapshots: without it two folds
+        of the same dead cell would double-count.)"""
+        for entry in list(self._cells):
+            thread, cell = entry
+            if not thread.is_alive():
+                self._fold_into(self._data, cell)
+                try:
+                    self._cells.remove(entry)
+                except ValueError:
+                    pass
+        out = dict(self._data)
+        for _thread, cell in list(self._cells):
+            self._fold_into(out, cell)
+        return out
+
     def _snapshot(self) -> dict:
         with self._lock:
             snap = {
                 "name": self.name,
                 "kind": self.kind,
                 "description": self.description,
-                "data": {k: v for k, v in self._data.items()},
+                "data": self._merged_data(),
             }
             bounds = getattr(self, "boundaries", None)
             if bounds is not None:
@@ -102,7 +168,11 @@ class Metric:
 
 
 class Counter(Metric):
-    """Monotonically increasing count (reference: util/metrics.py Counter)."""
+    """Monotonically increasing count (reference: util/metrics.py Counter).
+
+    ``inc`` is lock-free: the increment lands in the calling thread's
+    private cell (single-writer dict read-modify-write — exact), merged
+    into the published total only at snapshot/flush time."""
 
     kind = "counter"
 
@@ -110,26 +180,37 @@ class Counter(Metric):
         if value < 0:
             raise ValueError("Counter.inc() requires a non-negative value")
         key = self._tags(tags)
-        with self._lock:
-            self._data[key] += value
+        try:
+            cell = self._tls.cell
+        except AttributeError:
+            cell = self._cell()
+        cell[key] = cell.get(key, 0.0) + value
 
 
 class Gauge(Metric):
-    """Last-value-wins measurement."""
+    """Last-value-wins measurement. ``set`` is a single atomic dict store
+    into the shared data — last write wins by definition, so thread cells
+    would only blur which write was last; no lock needed either way."""
 
     kind = "gauge"
 
     def set(self, value: float, tags: Optional[dict] = None):
         key = self._tags(tags)
-        with self._lock:
-            self._data[key] = float(value)
+        self._data[key] = float(value)
 
 
 DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
 
 class Histogram(Metric):
-    """Bucketed distribution; records per-bucket counts + sum + count."""
+    """Bucketed distribution; records per-bucket counts + sum + count.
+
+    ``observe`` is lock-free like ``Counter.inc``: the bucket vector
+    lives in the calling thread's cell (single-writer, exact); snapshot
+    merges vectors elementwise. A reader copying a cell mid-observe can
+    see a vector whose bucket is bumped but whose count isn't yet — a
+    one-sample transient the next snapshot corrects (same tolerance
+    Prometheus scrapes have always had)."""
 
     kind = "histogram"
 
@@ -145,19 +226,22 @@ class Histogram(Metric):
 
     def observe(self, value: float, tags: Optional[dict] = None):
         key = self._tags(tags)
-        with self._lock:
-            cur = self._data.get(key)
-            if not isinstance(cur, list):
-                cur = [0] * (len(self.boundaries) + 1) + [0.0, 0]  # buckets+sum+count
-                self._data[key] = cur
-            idx = len(self.boundaries)
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    idx = i
-                    break
-            cur[idx] += 1
-            cur[-2] += value
-            cur[-1] += 1
+        try:
+            cell = self._tls.cell
+        except AttributeError:
+            cell = self._cell()
+        cur = cell.get(key)
+        if not isinstance(cur, list):
+            cur = [0] * (len(self.boundaries) + 1) + [0.0, 0]  # buckets+sum+count
+            cell[key] = cur
+        idx = len(self.boundaries)
+        for i, b in enumerate(self.boundaries):
+            if value <= b:
+                idx = i
+                break
+        cur[idx] += 1
+        cur[-2] += value
+        cur[-1] += 1
 
     record = observe  # reference alias
 
@@ -169,9 +253,21 @@ class Histogram(Metric):
         :func:`percentiles_from_buckets`). Cluster-wide: ``histogram_percentiles``."""
         key = self._tags(tags)
         with self._lock:
-            cur = self._data.get(key)
+            cur = self._merged_data().get(key)
             data = list(cur) if isinstance(cur, list) else None
         return _percentile_summary(self.boundaries, data, qs)
+
+
+def safe_counter(name: str, description: str = "") -> Optional["Counter"]:
+    """A ``Counter``, or None when the registry is unavailable (late
+    interpreter teardown, import cycles). The shared shape for LAZY drop
+    counters created off the hot path on first drop — tracing's
+    ``tracing_dropped_spans`` and the flight recorder's
+    ``events_dropped`` both construct through here."""
+    try:
+        return Counter(name, description)
+    except Exception:
+        return None
 
 
 def percentiles_from_buckets(
@@ -358,18 +454,52 @@ def _reset_series_for_tests() -> None:
 
 
 _ship_lock = threading.Lock()
+# off-caller-path shipping rendezvous: callers that need fresh data at the
+# head (collect_series) RAISE this condition instead of shipping inline;
+# the flusher thread performs the I/O. Two sequence numbers make the
+# handoff race-free: a waiter is satisfied only by a ship that STARTED
+# after its request (the flusher claims _ship_req_seq BEFORE shipping and
+# publishes it to _ship_done_seq after) — a request landing mid-ship is
+# NOT consumed by that in-flight ship; the next loop pass ships again.
+_ship_cv = threading.Condition()
+_ship_req_seq = 0   # bumped by request_ship()
+_ship_done_seq = 0  # last req seq fully shipped (flusher-owned)
+
+
+def request_ship(wait: bool = False, timeout: float = 2.0) -> None:
+    """Ask the flusher thread to run a ship pass NOW (and optionally wait
+    for it to finish). This is the ONLY way query paths interact with
+    series shipping — the telemetry I/O itself always runs on the
+    dedicated flusher thread, never on the caller (PR-11 contract: no
+    application thread blocks on telemetry I/O it didn't ask for).
+    Falls back to an inline ship only when no flusher exists (a process
+    that never created a metric has nothing to ship anyway)."""
+    global _ship_req_seq
+    if not _series_enabled():
+        return
+    if not _flusher_started:
+        _ship_series()  # no flusher thread to hand off to
+        return
+    with _ship_cv:
+        _ship_req_seq += 1
+        mine = _ship_req_seq
+        _ship_cv.notify_all()
+        if wait:
+            _ship_cv.wait_for(lambda: _ship_done_seq >= mine, timeout=timeout)
 
 
 def _ship_series() -> None:
     """Push samples recorded since the last successful ship to the head's
-    SeriesStore. Best-effort, like the KV snapshot flush.
+    SeriesStore. Best-effort, like the KV snapshot flush. Runs on the
+    flusher thread (``request_ship``) — plus inline at interpreter exit,
+    the one moment there may be no flusher left to hand off to.
 
     Delivery is IDEMPOTENT: rows carry their sample seq and the head drops
     anything at/below its per-process watermark, so a push whose reply was
     lost (head applied it, caller retries the backlog) cannot duplicate
     rows; ``_ship_lock`` additionally serializes concurrent shippers (the
-    flusher thread racing a ``collect_series`` caller would otherwise have
-    the same backlog in flight twice)."""
+    flusher thread racing an exit-time flush would otherwise have the
+    same backlog in flight twice)."""
     global _shipped_seq
     if not _series_enabled():
         return
@@ -655,7 +785,7 @@ def collect_series(name: Optional[str] = None) -> dict:
     near-zero interval and rate the newest pair at ~0."""
     from ray_tpu._private.runtime import get_ctx
 
-    _ship_series()
+    request_ship(wait=True)
     try:
         ctx = get_ctx()
         raw = ctx.call("series_get", name=name)
@@ -680,8 +810,11 @@ def _process_tag() -> str:
     return f"pid-{os.getpid()}"
 
 
-def flush() -> None:
-    """Publish this process's metric snapshots into the head KV."""
+def flush(ship_inline: bool = False) -> None:
+    """Publish this process's metric snapshots into the head KV. Series
+    shipping is handed to the flusher thread (``request_ship``) unless
+    ``ship_inline`` — the exit-time path, where the flusher may already
+    be dead and this is the backlog's last chance off the process."""
     from ray_tpu._private.runtime import get_ctx
 
     try:
@@ -700,7 +833,13 @@ def flush() -> None:
         )
     except Exception:
         pass  # head gone (shutdown) — metrics are best-effort
-    _ship_series()
+    if ship_inline:
+        _ship_series()
+    else:
+        # hand the I/O to the flusher thread but keep flush()'s contract
+        # ("my samples are at the head when this returns") by waiting on
+        # the rendezvous — bounded, and never from a submission path
+        request_ship(wait=True)
 
 
 def _ensure_flusher():
@@ -711,21 +850,40 @@ def _ensure_flusher():
         _flusher_started = True
 
     def loop():
-        # one thread does both jobs on their own cadences: sample the
-        # registry into the series rings every _series_interval() (env,
-        # re-read each tick so tests can retune a live process) and ship
-        # snapshots + new samples every _FLUSH_INTERVAL_S
+        # one thread does every off-path job on its own cadence: sample
+        # the registry into the series rings every _series_interval()
+        # (env, re-read each tick so tests can retune a live process),
+        # ship snapshots + new samples every _FLUSH_INTERVAL_S, and
+        # answer request_ship() nudges immediately — the condition wait
+        # doubles as the tick sleep, so an on-demand ship never waits a
+        # full interval
+        global _ship_done_seq
         last_flush = 0.0
+        last_sample = time.monotonic()
         while True:
-            time.sleep(_series_interval() if _series_enabled() else _FLUSH_INTERVAL_S)
-            sample_series_now()
+            interval = _series_interval() if _series_enabled() else _FLUSH_INTERVAL_S
+            with _ship_cv:
+                if _ship_req_seq == _ship_done_seq:
+                    _ship_cv.wait(timeout=max(0.01, last_sample + interval - time.monotonic()))
+                # claim BEFORE the ship: requests arriving after this
+                # read stay pending and trigger another pass
+                claimed = _ship_req_seq
             now = time.monotonic()
+            if now - last_sample >= interval:
+                last_sample = now
+                sample_series_now()
             if now - last_flush >= _FLUSH_INTERVAL_S:
                 last_flush = now
-                flush()
+                flush(ship_inline=True)
+            elif claimed > _ship_done_seq:
+                _ship_series()
+            if claimed > _ship_done_seq:
+                with _ship_cv:
+                    _ship_done_seq = claimed
+                    _ship_cv.notify_all()
 
     threading.Thread(target=loop, daemon=True, name="metrics-flusher").start()
-    atexit.register(flush)
+    atexit.register(flush, ship_inline=True)
 
 
 def collect() -> dict:

@@ -35,7 +35,6 @@ import json
 import os
 import threading
 import time
-import uuid
 from collections import deque
 from typing import Any, Iterator, Optional
 
@@ -50,15 +49,27 @@ def _env_max_spans() -> int:
         return 8192
 
 
+_rate_cache: tuple = ("1", 1.0)  # (raw env string, parsed) — parse once
+
+
 def _env_sample_rate() -> float:
     """Head-sampling rate (``RAY_TPU_TRACE_SAMPLE``, 0..1, default 1.0):
     the keep/drop decision is made once per request id, deterministically
     from the id itself, so every process in the request's path agrees
-    without coordination (no half-sampled traces)."""
+    without coordination (no half-sampled traces). The float parse is
+    cached keyed on the raw env string — this sits on the span/context
+    hot path, and tests retune the env on a live process."""
+    global _rate_cache
+    raw = os.environ.get("RAY_TPU_TRACE_SAMPLE", "1")
+    cached_raw, cached = _rate_cache
+    if raw == cached_raw:
+        return cached
     try:
-        return min(1.0, max(0.0, float(os.environ.get("RAY_TPU_TRACE_SAMPLE", "1"))))
+        rate = min(1.0, max(0.0, float(raw)))
     except ValueError:
-        return 1.0
+        rate = 1.0
+    _rate_cache = (raw, rate)
+    return rate
 
 
 _local = threading.local()
@@ -96,15 +107,13 @@ def _count_dropped_span() -> None:
     global _dropped_spans, _drop_counter
     _dropped_spans += 1
     if _drop_counter is None:
-        try:
-            from ray_tpu.util.metrics import Counter
+        from ray_tpu.util.metrics import safe_counter
 
-            _drop_counter = Counter(
-                "tracing_dropped_spans",
-                "spans evicted by the per-process retention cap",
-            )
-        except Exception:
-            _drop_counter = False  # metrics unavailable: stats() still counts
+        # False (not None) when unavailable: don't retry every drop
+        _drop_counter = safe_counter(
+            "tracing_dropped_spans",
+            "spans evicted by the per-process retention cap",
+        ) or False
     if _drop_counter:
         try:
             _drop_counter.inc()
@@ -130,22 +139,112 @@ def trace_sampled(request_id: Optional[str]) -> bool:
 
 # ---------------------------------------------------------------------------
 # trace context (request_id propagation)
+#
+# Three context shapes ride the per-thread slot (PR-11 zero-cost rebuild):
+#
+# * a plain dict ``{"request_id": rid}`` — a SAMPLED context: propagated in
+#   task specs, tags spans/events (the pre-PR-11 shape, still the
+#   compatibility contract for hand-installed contexts);
+# * :class:`UnsampledContext` — the head-sampling decision said "drop",
+#   made ONCE at mint. It is an immutable token: spans under it skip
+#   allocation/locking entirely, ``remote()`` skips spec tagging (no
+#   cross-process shipping), and nothing downstream pays for tracing.
+# * :class:`LazyTaskContext` — a rootless task executing on a worker. The
+#   task-id-rooted request id (and its sampling decision) materialize only
+#   when something actually asks (an event, a span, a nested submission) —
+#   a plain noop task pays ZERO context cost end to end.
 # ---------------------------------------------------------------------------
+
+
+class UnsampledContext:
+    """Immutable unsampled-trace token. Carries the request id so
+    forensics stay correlated at ANY sample rate — ``record()`` events,
+    head task-event rows, and `obs req <id>` all keep the request id;
+    only SPANS are dropped, and they are dropped for free (the token
+    short-circuits ``span()`` before any allocation). The token itself
+    rides task specs — one shared immutable object per request, shipped
+    by reference (no per-task dict copies) — so every downstream hop
+    inherits the mint-time decision and half-sampled traces cannot
+    happen (the module's no-coordination invariant)."""
+
+    __slots__ = ("request_id",)
+    sampled = False
+
+    def __init__(self, request_id: Optional[str]):
+        object.__setattr__(self, "request_id", request_id)
+
+    def __setattr__(self, name, value):  # immutability: tokens are shared
+        raise AttributeError("UnsampledContext is immutable")
+
+    def __reduce__(self):  # __slots__ + frozen setattr need explicit pickle
+        return (UnsampledContext, (self.request_id,))
+
+    def get(self, key, default=None):  # dict-compatible read surface
+        return self.request_id if key == "request_id" else default
+
+    def __repr__(self):
+        return f"UnsampledContext({self.request_id!r})"
+
+
+class LazyTaskContext:
+    """Rootless-task context: the request id derives from the task id the
+    moment someone asks for it (and the sampling decision with it). Built
+    worker-side for specs that carry no ``trace_ctx``."""
+
+    __slots__ = ("_task_id", "_rid", "_sampled")
+
+    def __init__(self, task_id: bytes):
+        self._task_id = task_id
+        self._rid = None
+        self._sampled = None
+
+    @property
+    def request_id(self) -> str:
+        rid = self._rid
+        if rid is None:
+            rid = self._rid = self._task_id.hex()[:16]
+        return rid
+
+    @property
+    def sampled(self) -> bool:
+        s = self._sampled
+        if s is None:
+            s = self._sampled = trace_sampled(self.request_id)
+        return s
+
+    def get(self, key, default=None):
+        return self.request_id if key == "request_id" else default
+
+    def __repr__(self):
+        return f"LazyTaskContext({self.request_id!r})"
 
 
 def new_request_id() -> str:
     """Mint a fresh request id (16 hex chars — short enough to grep, wide
-    enough to never collide within a cluster's lifetime)."""
-    return uuid.uuid4().hex[:16]
+    enough to never collide within a cluster's lifetime). ``os.urandom``
+    rather than uuid4: same 64 bits of entropy at a fifth of the cost
+    (this runs once per request on the serve hot path)."""
+    return os.urandom(8).hex()
 
 
-def get_trace_context() -> Optional[dict]:
-    """The calling thread's active trace context ({"request_id": ...}) or
-    None. Shipped in task specs by remote()/actor submissions."""
+def mint_context(request_id: Optional[str] = None):
+    """Build a context for ``request_id`` (minting an id if None), making
+    the head-sampling decision HERE, once: sampled requests get the dict
+    shape, unsampled requests get the cheap immutable token that every
+    downstream hot path short-circuits on."""
+    rid = request_id or new_request_id()
+    if trace_sampled(rid):
+        return {"request_id": rid}
+    return UnsampledContext(rid)
+
+
+def get_trace_context():
+    """The calling thread's active trace context ({"request_id": ...}, an
+    :class:`UnsampledContext`, a :class:`LazyTaskContext`) or None."""
     return getattr(_local, "trace_ctx", None)
 
 
-def set_trace_context(ctx: Optional[dict]) -> Optional[dict]:
+def set_trace_context(ctx):
     """Install (or clear, with None) the thread's trace context; returns
     the previous one so callers can restore it."""
     prev = getattr(_local, "trace_ctx", None)
@@ -153,56 +252,138 @@ def set_trace_context(ctx: Optional[dict]) -> Optional[dict]:
     return prev
 
 
+def context_sampled(ctx) -> bool:
+    """Whether spans under ``ctx`` are kept. None (no context) keeps —
+    context-less spans are always retained, as before."""
+    if ctx is None:
+        return True
+    if type(ctx) is dict:
+        # hand-installed dicts predate mint-time decisions: fall back to
+        # the deterministic per-id check so sampling still applies
+        return trace_sampled(ctx.get("request_id"))
+    return ctx.sampled
+
+
+def context_for_spec(ctx):
+    """What ``remote()``/actor submission ships in ``spec["trace_ctx"]``
+    for an active context: the dict or unsampled token itself (shipped
+    by reference — no copy; the token keeps forensics correlated and
+    pins the mint-time sampling decision downstream), or a context
+    materialized from a lazy root — as a dict when its task-rooted id
+    sampled, as a token when it didn't, so nested hops under a rootless
+    root also inherit ONE coherent decision."""
+    if type(ctx) is dict or type(ctx) is UnsampledContext:
+        return ctx
+    if type(ctx) is LazyTaskContext:
+        if ctx.sampled:
+            return {"request_id": ctx.request_id}
+        return UnsampledContext(ctx.request_id)
+    return None
+
+
+def task_context(spec_ctx, task_id: bytes):
+    """The context a worker installs around a task body: the submitter's
+    shipped context when the spec carries one, else a lazy task-rooted
+    context that costs nothing until observed."""
+    if spec_ctx is not None:
+        return spec_ctx
+    return LazyTaskContext(task_id)
+
+
 def current_request_id() -> Optional[str]:
     ctx = getattr(_local, "trace_ctx", None)
-    return ctx.get("request_id") if ctx else None
+    if ctx is None:
+        return None
+    if type(ctx) is dict:
+        return ctx.get("request_id")
+    return ctx.request_id
 
 
 @contextlib.contextmanager
 def trace_context(request_id: Optional[str] = None) -> Iterator[str]:
     """Scope a request id onto this thread (minting one if not given);
-    spans, flight-recorder events, and remote() hops underneath carry it."""
-    rid = request_id or new_request_id()
-    prev = set_trace_context({"request_id": rid})
+    spans, flight-recorder events, and remote() hops underneath carry it.
+    The sampling decision happens here, once per request."""
+    ctx = mint_context(request_id)
+    rid = ctx.get("request_id")  # both context shapes expose .get
+    prev = set_trace_context(ctx)
     try:
         yield rid
     finally:
         set_trace_context(prev)
 
 
-@contextlib.contextmanager
-def span(name: str, **attributes: Any) -> Iterator[None]:
-    """Record a named region. Nesting tracks a per-thread stack so child
-    spans indent under their parent in the trace viewer. An active trace
-    context tags the span with its request_id (one lane per request in
-    the exported trace)."""
-    depth = getattr(_local, "depth", 0)
-    _local.depth = depth + 1
-    t0 = _now_us()
-    try:
-        yield
-    finally:
+class _NullSpan:
+    """Shared do-nothing span: what an unsampled request's ``span()``
+    returns — no allocation, no clock read, no lock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A recording span (context manager). Nesting tracks a per-thread
+    stack so child spans indent under their parent in the trace viewer;
+    an active trace context tags the span's args with its request_id
+    (one lane per request in the exported trace)."""
+
+    __slots__ = ("name", "attributes", "t0", "depth")
+
+    def __init__(self, name: str, attributes: dict):
+        self.name = name
+        self.attributes = attributes
+
+    def __enter__(self):
+        self.depth = depth = getattr(_local, "depth", 0)
+        _local.depth = depth + 1
+        self.t0 = _now_us()
+        return None
+
+    def __exit__(self, *exc):
+        depth = self.depth
         _local.depth = depth
         rec = {
-            "name": name,
+            "name": self.name,
             "cat": "user",
             "ph": "X",
-            "ts": t0,
-            "dur": _now_us() - t0,
+            "ts": self.t0,
+            "dur": _now_us() - self.t0,
             "pid": f"proc-{os.getpid()}",
             "tid": f"thread-{threading.get_ident() & 0xFFFF}-d{depth}",
         }
         rid = current_request_id()
+        attributes = self.attributes
         if attributes or rid:
             args = {k: _jsonable(v) for k, v in attributes.items()}
             if rid:
                 args.setdefault("request_id", rid)
             rec["args"] = args
-        if trace_sampled(rid):
-            with _lock:
-                if len(_spans) == _spans.maxlen:
-                    _count_dropped_span()
-                _spans.append(rec)
+        with _lock:
+            if len(_spans) == _spans.maxlen:
+                _count_dropped_span()
+            _spans.append(rec)
+        return False
+
+
+def span(name: str, **attributes: Any):
+    """Record a named region (``with tracing.span("step", batch=i):``).
+
+    ZERO-COST when unsampled: the mint-time head-sampling decision lives
+    on the context, so an unsampled request's spans return a shared null
+    manager — no record dict, no clock reads, no span-ring lock; the
+    body just runs."""
+    ctx = getattr(_local, "trace_ctx", None)
+    if ctx is not None and not context_sampled(ctx):
+        return _NULL_SPAN
+    return _Span(name, attributes)
 
 
 def _jsonable(v: Any):
