@@ -39,6 +39,23 @@ def _build_parser() -> argparse.ArgumentParser:
         help="print wall-time per phase (parse / index / each rule) to stderr",
     )
     p.add_argument(
+        "--profile-json", metavar="PATH", default=None,
+        help="also write the per-phase/per-rule profile as JSON to PATH "
+        "(CI uploads it as the lint artifact)",
+    )
+    p.add_argument(
+        "--changed-only", action="store_true",
+        help="report violations only for files git sees as changed "
+        "(working tree vs HEAD, plus untracked, plus the merge-base diff "
+        "against --changed-base when given); the whole-program index is "
+        "still built over every scanned file",
+    )
+    p.add_argument(
+        "--changed-base", metavar="REF", default=None,
+        help="git ref the PR diverged from (e.g. origin/main); adds "
+        "`git diff REF...HEAD` to the --changed-only file set",
+    )
+    p.add_argument(
         "--baseline", metavar="PATH", default=None,
         help="baseline file (default: <root>/tools/raylint-baseline.json if present)",
     )
@@ -73,6 +90,52 @@ def _default_package_path() -> str:
     # prefer the checkout we are running from
     here = Path(__file__).resolve().parent.parent
     return str(here)
+
+
+def _git_changed_files(repo_root: Path, base: Optional[str]) -> Optional[set]:
+    """Resolved ABSOLUTE paths of changed ``.py`` files (git reports them
+    relative to its toplevel, so they are re-anchored there): working
+    tree vs HEAD, untracked files, and (with ``base``) the merge-base
+    diff ``base...HEAD``.  None when git cannot answer — including a
+    ``--changed-base`` ref that does not resolve (shallow clone, typo'd
+    ref): a PR fast path whose base diff silently failed would lint an
+    empty set and report a false clean, so the caller must fall back to
+    the full run instead."""
+    import subprocess
+
+    def run(cwd: Path, *args: str) -> Optional[list]:
+        try:
+            # quotePath=off: git's default C-quoting of non-ASCII names
+            # ("na\303\257ve.py") would fail the .py suffix test and
+            # silently drop the file from the changed set
+            r = subprocess.run(
+                ["git", "-c", "core.quotePath=off", *args], cwd=cwd,
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        return [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
+
+    top = run(repo_root, "rev-parse", "--show-toplevel")
+    if not top:
+        return None
+    # every probe runs FROM the toplevel: `ls-files` prints cwd-relative
+    # paths while `diff --name-only` prints toplevel-relative ones, and
+    # mixing the two anchors silently mis-resolves the changed set
+    toplevel = Path(top[0])
+    out: set = set()
+    probes = [["diff", "--name-only", "HEAD"],
+              ["ls-files", "--others", "--exclude-standard"]]
+    if base:
+        probes.append(["diff", "--name-only", f"{base}...HEAD"])
+    for probe in probes:
+        got = run(toplevel, *probe)
+        if got is None:
+            return None  # ANY failed probe invalidates the fast path
+        out |= {(toplevel / p).resolve() for p in got if p.endswith(".py")}
+    return out
 
 
 def main(argv: Optional[Sequence] = None) -> int:
@@ -110,11 +173,12 @@ def main(argv: Optional[Sequence] = None) -> int:
             print(f"check-imports: {n} problem{'s' if n != 1 else ''} found")
         return 1 if problems else 0
 
-    if args.write_baseline and (args.select or args.ignore):
+    if args.write_baseline and (args.select or args.ignore or args.changed_only):
         # a filtered run would rewrite the whole file and silently drop
-        # every entry for the rules that didn't run
+        # every entry for the rules/files that didn't run
         print(
-            "error: --write-baseline cannot be combined with --select/--ignore",
+            "error: --write-baseline cannot be combined with "
+            "--select/--ignore/--changed-only",
             file=sys.stderr,
         )
         return 2
@@ -140,16 +204,53 @@ def main(argv: Optional[Sequence] = None) -> int:
             return d + "/" if Path(p).is_dir() else d
         return (Path(p).resolve().name + "/") if Path(p).is_dir() else Path(p).as_posix()
 
-    prof: Optional[dict] = {} if args.profile else None
+    report_only: Optional[set] = None
+    if args.changed_only:
+        if display_root is not None:
+            root = display_root
+        else:
+            # anchor git at the tree being linted, not the process cwd —
+            # linting a checkout elsewhere must diff THAT repo
+            first = Path(paths[0]).resolve()
+            root = first if first.is_dir() else first.parent
+        changed = _git_changed_files(root, args.changed_base)
+        if changed is None:
+            # no git / not a repo / unresolvable --changed-base: a fast
+            # path that lints NOTHING would read as a clean bill of
+            # health — fall back to the full run
+            print(
+                "warning: --changed-only could not query git; "
+                "linting everything",
+                file=sys.stderr,
+            )
+        else:
+            # already resolved ABSOLUTE paths: display conventions vary
+            # with the baseline anchoring, and a convention mismatch
+            # would skip every file and report a false clean (run_paths
+            # matches report_only against ctx.path, not display paths)
+            report_only = changed
+            if not report_only:
+                if args.profile_json:
+                    # the promised artifact must exist even on the quiet
+                    # early exit, or a CI upload/parse step breaks
+                    Path(args.profile_json).write_text(json.dumps({
+                        "files": 0, "parse_s": 0.0, "index_s": 0.0,
+                        "rules_s": {}, "total_s": 0.0,
+                        "changed_only_empty": True,
+                    }, indent=2))
+                print("raylint: no changed python files")
+                return 0
+
+    prof: Optional[dict] = {} if (args.profile or args.profile_json) else None
     try:
         violations = run_paths(
             paths, select=select, ignore=ignore, display_root=display_root,
-            profile=prof,
+            profile=prof, report_only=report_only,
         )
     except (FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if prof is not None:
+    if prof is not None and args.profile:
         print(
             f"raylint profile: {prof['files']} files, "
             f"parse {prof['parse_s']}s, index {prof['index_s']}s, "
@@ -158,6 +259,12 @@ def main(argv: Optional[Sequence] = None) -> int:
         )
         for rid, secs in prof["rules_s"].items():
             print(f"  {rid}: {secs}s", file=sys.stderr)
+    if prof is not None and args.profile_json:
+        try:
+            Path(args.profile_json).write_text(json.dumps(prof, indent=2))
+        except OSError as e:
+            print(f"error: cannot write {args.profile_json}: {e}", file=sys.stderr)
+            return 2
 
     if args.write_baseline:
         if baseline_path.is_file():

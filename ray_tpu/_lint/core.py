@@ -346,6 +346,7 @@ def run_paths(
     ignore: Optional[Iterable] = None,
     display_root: Optional[Path] = None,
     profile: Optional[dict] = None,
+    report_only: Optional[set] = None,
 ) -> List[Violation]:
     """Lint every python file under ``paths``; returns violations that are not
     suppressed by inline comments (baseline filtering is the caller's job).
@@ -354,7 +355,17 @@ def run_paths(
     when any :class:`ProjectRule` is selected, a :class:`ProjectIndex` is
     built over ALL parsed files and the cross-module rules run against it.
     Pass a dict as ``profile`` to receive wall-time per phase and per rule
-    (the CLI's ``--profile``)."""
+    (the CLI's ``--profile``).
+
+    ``report_only`` (a set of RESOLVED ABSOLUTE ``Path``s) restricts which
+    files may REPORT violations — the ``--changed-only`` fast path.
+    Absolute paths, not display paths: display conventions vary with the
+    baseline anchoring, and a convention mismatch here would silently
+    report clean (the false bill of health the fast path must never
+    give).  The index is still built over every scanned file (a
+    whole-program analysis judged from a partial index would silently
+    under-approximate), but per-file rules skip unlisted contexts and
+    project-rule violations anchored outside the set are dropped."""
     import time as _time
 
     t_start = _time.perf_counter()
@@ -388,6 +399,8 @@ def run_paths(
     t_parse = _time.perf_counter() - t0
 
     for ctx in contexts:
+        if report_only is not None and ctx.path not in report_only:
+            continue
         for rule in file_rules:
             t0 = _time.perf_counter()
             for v in rule.check(ctx):
@@ -407,6 +420,10 @@ def run_paths(
             t0 = _time.perf_counter()
             for v in rule.check_project(index):
                 ctx = by_display.get(v.path)
+                if report_only is not None and (
+                    ctx is None or ctx.path not in report_only
+                ):
+                    continue
                 if ctx is None or not ctx.is_suppressed(v):
                     violations.append(v)
             rule_times[rule.id] += _time.perf_counter() - t0

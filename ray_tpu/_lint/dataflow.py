@@ -1,0 +1,1149 @@
+"""raylint phase 1.5: per-function control-flow graphs + forward dataflow.
+
+The PR 9 index answers *"what exists and who calls whom"*; the bug classes
+left after it are *path* properties — a donated buffer read on the path
+between the jitted call and its reassignment, a ``KVBlockPool.allocate``
+whose matching ``free`` does not dominate an exception edge, a file/lock
+acquired before a raising statement that no ``finally`` covers.  This
+module supplies the machinery those rules (RL013-RL016, ``rules.py``)
+share:
+
+* **CFG** (:func:`build_cfg`) — statement-granular basic flow for one
+  ``def``: ``if``/``for``/``while``/``try``/``with`` lowered to nodes with
+  normal successors, plus EXCEPTION successors on every raise-capable
+  statement (contains a call, a subscript load, or is ``raise``/
+  ``assert``).  ``try`` handlers receive the pre-statement state; a
+  non-catch-all handler keeps an escape edge alive (an ``except OSError``
+  does not stop a ``TypeError``); ``finally`` bodies are duplicated per
+  continuation (normal / exceptional / return) so a release in a
+  ``finally`` is seen on every path it really covers.  ``break``/
+  ``continue`` jump directly to their targets (skipping ``finally`` —
+  documented approximation), and nested ``def``/``lambda`` bodies are
+  opaque (they execute later, not here).
+* **forward engine** (:func:`fixpoint`) — worklist iteration of a
+  ``transfer(node, state) -> (out, exc_out)`` function over frozenset
+  states, with **may** (union) or **must** (intersection) joins.  The
+  leak checks are phrased as may-analyses (a resource *may* still be
+  open at an escape = the release does not *must*-dominate it).
+* **donation/static summaries** (:class:`DataflowCache`) — the jit
+  registry's ``donate_argnums``/``static_argnums`` lifted one call level:
+  a function that passes its own parameter at a donated (static) position
+  of a directly-resolvable jit call donates (fixes as static) that
+  parameter for *its* callers.  Resolvable jit callables: a same-class
+  ``self._step = jax.jit(...)`` attribute, a local/module-level name
+  assigned from a jit call, and a local assigned from a function whose
+  ``return`` is directly a jit call (``make_step_fn`` → ``step_fn``).
+  Deeper indirection (tuple-unpacked factories, parameters holding jitted
+  callables) is skipped — the analyses under-approximate, they never
+  guess.
+* **analyses** — :func:`poison_reads` (RL013: donated operands poisoned
+  until reassigned, reads reported with both sites) and
+  :func:`resource_leaks` (RL015/RL016: acquire → release/transfer balance
+  over every exit, exception edges included, with a witness escaping
+  statement per report).
+
+Everything here is AST-only and import-free, like the rest of raylint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from ray_tpu._lint.index import (
+    LOCK_ATTR_RE,
+    FuncInfo,
+    JitSite,
+    ProjectIndex,
+    dotted_parts,
+)
+
+# --------------------------------------------------------------------- CFG
+
+
+class Node:
+    """One CFG node: a simple statement or a compound-statement header."""
+
+    __slots__ = (
+        "stmt", "kind", "succ", "esucc", "line", "succ_label",
+        "fallthrough_label",
+    )
+
+    def __init__(self, stmt: Optional[ast.AST], kind: str = "stmt"):
+        self.stmt = stmt
+        self.kind = kind  # stmt | header | entry | exit | raise | join
+        self.succ: List["Node"] = []
+        self.esucc: List["Node"] = []
+        self.line = getattr(stmt, "lineno", 0) if stmt is not None else 0
+        # If headers label their explicit branch entries ("true"/"false");
+        # an edge wired later by seq() (an empty branch's fallthrough)
+        # inherits fallthrough_label.  Everything else stays unlabeled.
+        self.succ_label: Optional[dict] = None
+        self.fallthrough_label: Optional[str] = None
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<Node {self.kind} L{self.line}>"
+
+
+class CFG:
+    def __init__(self):
+        self.entry = Node(None, "entry")
+        self.exit = Node(None, "exit")          # return / fall-off-the-end
+        self.raise_exit = Node(None, "raise")   # an exception escapes the def
+        self.nodes: List[Node] = [self.entry, self.exit, self.raise_exit]
+
+    def new(self, stmt: Optional[ast.AST], kind: str = "stmt") -> Node:
+        n = Node(stmt, kind)
+        self.nodes.append(n)
+        return n
+
+
+@dataclasses.dataclass
+class _ExcFrame:
+    """One enclosing ``try`` as seen by a raising statement inside it."""
+
+    handlers: List[Node]       # handler entry nodes (state flows in pre-stmt)
+    catch_all: bool            # bare / Exception / BaseException handler
+    fin_exc: Optional[Node]    # exceptional copy of the finally body
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    broad = {"Exception", "BaseException"}
+    if isinstance(t, ast.Name) and t.id in broad:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in broad for e in t.elts)
+    return False
+
+
+def scope_stmts(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a def/module body without descending into nested defs/classes
+    (their statements execute in a different scope at a different time)."""
+    stack = list(getattr(node, "body", []))
+    while stack:
+        cur = stack.pop()
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def iter_expr(expr: ast.AST) -> Iterator[ast.AST]:
+    """Walk an expression without descending into lambdas/comprehension
+    function bodies' nested defs (they run later, not at this statement)."""
+    stack = [expr]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def header_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The expressions a compound-statement HEADER evaluates (its body is
+    separate CFG nodes); the whole statement for simple statements."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: List[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return []  # a def/class statement just binds a name
+    return [stmt]
+
+
+def _raise_capable(stmt: ast.AST) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for expr in header_exprs(stmt):
+        for sub in iter_expr(expr):
+            if isinstance(sub, ast.Call):
+                return True
+            if isinstance(sub, ast.Subscript) and isinstance(sub.ctx, ast.Load):
+                return True
+    return False
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    # ``frames`` is innermost-last; break/continue/return targets are the
+    # *entry* nodes control jumps to
+    def seq(
+        self,
+        stmts: Sequence[ast.AST],
+        frames: Tuple[_ExcFrame, ...],
+        brk: Optional[Node],
+        cont: Optional[Node],
+        ret: Node,
+    ) -> Tuple[Optional[Node], List[Node]]:
+        """Build a statement list; returns (entry, open_exits). ``entry`` is
+        None for an empty list; ``open_exits`` fall through to whatever
+        comes next."""
+        entry: Optional[Node] = None
+        exits: List[Node] = []
+        for stmt in stmts:
+            head, tails = self.one(stmt, frames, brk, cont, ret)
+            if head is None:
+                continue
+            if entry is None:
+                entry = head
+            for e in exits:
+                e.succ.append(head)
+            exits = tails
+        return entry, exits
+
+    def _exc_targets(self, frames: Tuple[_ExcFrame, ...]) -> List[Node]:
+        """Where an exception raised under ``frames`` can go: every
+        enclosing handler, stopping at the first catch-all; escaping
+        routes through each finally's exceptional copy on the way out
+        (the copy's own exits chain outward, wired at build time)."""
+        out: List[Node] = []
+        for frame in reversed(frames):
+            out.extend(frame.handlers)
+            if frame.catch_all:
+                return out
+            if frame.fin_exc is not None:
+                out.append(frame.fin_exc)
+                return out  # fin_exc's exits continue outward already
+        out.append(self.cfg.raise_exit)
+        return out
+
+    def one(
+        self,
+        stmt: ast.AST,
+        frames: Tuple[_ExcFrame, ...],
+        brk: Optional[Node],
+        cont: Optional[Node],
+        ret: Node,
+    ) -> Tuple[Optional[Node], List[Node]]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            head = cfg.new(stmt, "header")
+            self._arm(head, frames)
+            b_entry, b_exits = self.seq(stmt.body, frames, brk, cont, ret)
+            o_entry, o_exits = self.seq(stmt.orelse, frames, brk, cont, ret)
+            head.succ_label = {}
+            exits: List[Node] = []
+            if b_entry is not None:
+                head.succ.append(b_entry)
+                head.succ_label[id(b_entry)] = "true"
+                exits.extend(b_exits)
+            else:
+                exits.append(head)
+                if o_entry is not None:
+                    head.fallthrough_label = "true"
+            if o_entry is not None:
+                head.succ.append(o_entry)
+                head.succ_label[id(o_entry)] = "false"
+                exits.extend(o_exits)
+            else:
+                exits.append(head)
+                if b_entry is not None:
+                    head.fallthrough_label = "false"
+            return head, exits
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = cfg.new(stmt, "header")
+            self._arm(head, frames)
+            # break jumps land on a join node so the loop has ONE after-exit
+            join = cfg.new(None, "join")
+            b_entry, b_exits = self.seq(stmt.body, frames, join, head, ret)
+            if b_entry is not None:
+                head.succ.append(b_entry)
+                for e in b_exits:
+                    e.succ.append(head)  # back edge
+            e_entry, e_exits = self.seq(stmt.orelse, frames, brk, cont, ret)
+            if e_entry is not None:
+                head.succ.append(e_entry)  # loop exhausted -> else
+                for e in e_exits:
+                    e.succ.append(join)
+            else:
+                head.succ.append(join)  # loop-not-taken / exhausted
+            return head, [join]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = cfg.new(stmt, "header")
+            self._arm(head, frames)
+            b_entry, b_exits = self.seq(stmt.body, frames, brk, cont, ret)
+            if b_entry is not None:
+                head.succ.append(b_entry)
+                return head, b_exits
+            return head, [head]
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frames, brk, cont, ret)
+        if isinstance(stmt, ast.Return):
+            node = cfg.new(stmt)
+            self._arm(node, frames)
+            node.succ.append(ret)
+            return node, []
+        if isinstance(stmt, ast.Raise):
+            node = cfg.new(stmt)
+            node.esucc.extend(self._exc_targets(frames))
+            return node, []
+        if isinstance(stmt, ast.Break):
+            node = cfg.new(stmt)
+            if brk is not None:
+                node.succ.append(brk)
+            return node, []
+        if isinstance(stmt, ast.Continue):
+            node = cfg.new(stmt)
+            if cont is not None:
+                node.succ.append(cont)
+            return node, []
+        # simple statement (incl. def/class bindings, which never branch)
+        node = cfg.new(stmt)
+        self._arm(node, frames)
+        return node, [node]
+
+    def _try(self, stmt: ast.Try, frames, brk, cont, ret):
+        cfg = self.cfg
+        head = cfg.new(None, "join")  # zero-width anchor for the try itself
+        # exceptional finally copy: runs when the exception escapes this
+        # try; its exits continue to the OUTER exception targets
+        fin_exc: Optional[Node] = None
+        if stmt.finalbody:
+            fe, fx = self.seq(stmt.finalbody, frames, None, None, ret)
+            fin_exc = fe if fe is not None else cfg.new(None, "join")
+            targets = self._exc_targets(frames)
+            for e in (fx if fe is not None else [fin_exc]):
+                e.succ.extend(targets)
+            # return-path finally copy: Return inside routes through it
+            re_, rx = self.seq(stmt.finalbody, frames, None, None, ret)
+            ret_entry = re_ if re_ is not None else cfg.new(None, "join")
+            for e in (rx if re_ is not None else [ret_entry]):
+                e.succ.append(ret)
+            inner_ret = ret_entry
+        else:
+            inner_ret = ret
+        # handler entries are join placeholders so body exception edges can
+        # point at them before the handler bodies exist (no stmt payload:
+        # the `except E as e:` line itself has no effects to analyze)
+        h_entries = [cfg.new(None, "join") for _ in stmt.handlers]
+        frame = _ExcFrame(
+            handlers=list(h_entries),
+            catch_all=any(_is_catch_all(h) for h in stmt.handlers),
+            fin_exc=fin_exc,
+        )
+        body_frames = frames + (frame,)
+        b_entry, b_exits = self.seq(stmt.body, body_frames, brk, cont, inner_ret)
+        if b_entry is not None:
+            head.succ.append(b_entry)
+        else:
+            b_exits = [head]
+        # else runs after a clean body; its exceptions skip the handlers
+        else_frames = (
+            frames + (_ExcFrame([], False, fin_exc),) if fin_exc else frames
+        )
+        o_entry, o_exits = self.seq(stmt.orelse, else_frames, brk, cont, inner_ret)
+        if o_entry is not None:
+            for e in b_exits:
+                e.succ.append(o_entry)
+            b_exits = o_exits
+        # handler bodies: exceptions inside them also skip these handlers
+        h_exits: List[Node] = []
+        for h, h_entry in zip(stmt.handlers, h_entries):
+            hb_entry, hb_exits = self.seq(
+                h.body, else_frames, brk, cont, inner_ret
+            )
+            if hb_entry is not None:
+                h_entry.succ.append(hb_entry)
+                h_exits.extend(hb_exits)
+            else:
+                h_exits.append(h_entry)
+        open_exits = b_exits + h_exits
+        if stmt.finalbody:
+            fn_entry, fn_exits = self.seq(
+                stmt.finalbody, frames, None, None, ret
+            )
+            if fn_entry is not None:
+                for e in open_exits:
+                    e.succ.append(fn_entry)
+                return head, fn_exits
+        return head, open_exits
+
+    def _arm(self, node: Node, frames: Tuple[_ExcFrame, ...]) -> None:
+        if node.stmt is not None and _raise_capable(node.stmt):
+            node.esucc.extend(self._exc_targets(frames))
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one function def (or any statement-list owner)."""
+    cfg = CFG()
+    builder = _Builder(cfg)
+    body = getattr(fn, "body", [])
+    entry, exits = builder.seq(body, (), None, None, cfg.exit)
+    cfg.entry.succ.append(entry if entry is not None else cfg.exit)
+    for e in exits:
+        e.succ.append(cfg.exit)
+    return cfg
+
+
+# ----------------------------------------------------------- forward engine
+
+
+def fixpoint(
+    cfg: CFG,
+    transfer: Callable[[Node, frozenset], Tuple[frozenset, frozenset]],
+    join: str = "may",
+    edge_adjust: Optional[Callable[[Node, str, frozenset], frozenset]] = None,
+) -> dict:
+    """Forward dataflow to fixpoint; returns {node: entry-state}.
+
+    ``join="may"`` unions states at merge points (a fact holds if it holds
+    on SOME path — leak/poison detection); ``join="must"`` intersects (a
+    fact holds only when EVERY path establishes it — definite-assignment
+    style proofs).  Unvisited predecessors contribute nothing in either
+    mode (⊥ for may, ⊤ for must).
+
+    ``edge_adjust(node, label, out) -> out'`` refines the state flowing
+    down a LABELED branch edge of an If header ("true"/"false") — the
+    narrow slice of path sensitivity the conditional-acquire idiom
+    (``if not pool.cache_retain(b): break``) needs."""
+    states: dict = {cfg.entry: frozenset()}
+    work = [cfg.entry]
+    while work:
+        node = work.pop()
+        state = states[node]
+        out, exc = transfer(node, state)
+        for succs, flowed, normal in (
+            (node.succ, out, True), (node.esucc, exc, False)
+        ):
+            for m in succs:
+                here = flowed
+                if normal and edge_adjust is not None:
+                    label = None
+                    if node.succ_label is not None:
+                        label = node.succ_label.get(
+                            id(m), node.fallthrough_label
+                        )
+                    if label is not None:
+                        here = edge_adjust(node, label, flowed)
+                cur = states.get(m)
+                if cur is None:
+                    new = here
+                elif join == "may":
+                    new = cur | here
+                else:
+                    new = cur & here
+                if new != cur:
+                    states[m] = new
+                    work.append(m)
+    return states
+
+
+# ------------------------------------------- donation / static summaries
+
+
+@dataclasses.dataclass(frozen=True)
+class CallResolution:
+    """A call statically known to reach a jit-wrapped callable."""
+
+    donate: Tuple[int, ...]        # caller-side positional arg indices
+    static: Tuple[int, ...]        # caller-side positional arg indices
+    static_names: Tuple[str, ...]  # keyword names that are static
+    desc: str                      # human label of the jitted target
+    site_line: int                 # where the jit wrapping happens
+
+
+class DataflowCache:
+    """Per-run memo shared by RL013-RL016: function summaries, resolved
+    call sites, CFGs.  Built lazily via :func:`get_cache`."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self._summaries: dict = {}   # FuncInfo.key -> summary | None
+        self._cfgs: dict = {}        # FuncInfo.key -> CFG
+        self._callmaps: dict = {}    # FuncInfo.key -> {id(call node): chain}
+        self._local_jits: dict = {}  # FuncInfo.key -> {name: JitSite}
+        self._resolve_memo: dict = {}  # (key, id(call)) -> resolution | None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def cfg(self, info: FuncInfo) -> CFG:
+        got = self._cfgs.get(info.key)
+        if got is None:
+            got = build_cfg(info.node)
+            self._cfgs[info.key] = got
+        return got
+
+    def callmap(self, info: FuncInfo) -> dict:
+        got = self._callmaps.get(info.key)
+        if got is None:
+            got = {id(cs.node): cs.chain for cs in info.calls}
+            self._callmaps[info.key] = got
+        return got
+
+    def chain_of_call(self, info: FuncInfo, call: ast.Call):
+        """The (alias-normalized when the index saw it) chain of a call."""
+        chain = self.callmap(info).get(id(call))
+        if chain is None:
+            chain = dotted_parts(call.func)
+        return chain
+
+    # -- jit-site resolution -----------------------------------------------
+
+    def _site_of_assigned_jit(self, value: ast.AST) -> Optional[JitSite]:
+        return self.index._jit_site_from_call(value)
+
+    def _local_jit_names(self, info: FuncInfo) -> dict:
+        """name -> JitSite for ``fn = jax.jit(...)`` / ``fn = factory()``
+        where ``factory``'s return is directly a jit call, bound to a
+        LOCAL name inside ``info`` (or at module level for the module
+        scope)."""
+        got = self._local_jits.get(info.key)
+        if got is not None:
+            return got
+        out: dict = {}
+        for stmt in scope_stmts(info.node):
+            if not isinstance(stmt, ast.Assign) or not isinstance(
+                stmt.value, ast.Call
+            ):
+                continue
+            site = self._site_of_assigned_jit(stmt.value)
+            if site is None:
+                # one level deeper: a call to a function whose `return` is
+                # directly a jit call (make_step_fn -> step_fn)
+                callee = self.index.resolve_call(
+                    info, self.chain_of_call(info, stmt.value)
+                )
+                if callee is not None:
+                    site = self._returned_jit_site(callee)
+            if site is None:
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = site
+        self._local_jits[info.key] = out
+        return out
+
+    def _returned_jit_site(self, info: FuncInfo) -> Optional[JitSite]:
+        for stmt in scope_stmts(info.node):
+            if isinstance(stmt, ast.Return) and isinstance(stmt.value, ast.Call):
+                site = self.index._jit_site_from_call(stmt.value)
+                if site is not None:
+                    return site
+        return None
+
+    def _self_attr_jit_site(self, info: FuncInfo, attr: str) -> Optional[JitSite]:
+        if info.cls is None:
+            return None
+        for _in_init, kind, value in info.cls.attr_assigns.get(attr, []):
+            if kind == "jit_wrapper" and isinstance(value, ast.Call):
+                site = self.index._jit_site_from_call(value)
+                if site is not None:
+                    return site
+        return None
+
+    def _module_jit_site(self, info: FuncInfo, name: str) -> Optional[JitSite]:
+        mi = self.index.modules.get(info.module)
+        if mi is None or mi.scope is None:
+            return None
+        return self._local_jit_names(mi.scope).get(name)
+
+    def _direct_site(
+        self, info: FuncInfo, call: ast.Call, local_jits: dict
+    ) -> Optional[Tuple[JitSite, str]]:
+        """A call whose target IS a jit-wrapped callable (no summary)."""
+        chain = self.chain_of_call(info, call)
+        if not chain:
+            return None
+        if (
+            info.self_name
+            and chain[0] == info.self_name
+            and len(chain) == 2
+        ):
+            site = self._self_attr_jit_site(info, chain[1])
+            if site is not None:
+                return site, f"self.{chain[1]}"
+        if len(chain) == 1:
+            site = local_jits.get(chain[0])
+            if site is None:
+                site = self._module_jit_site(info, chain[0])
+            if site is not None:
+                return site, chain[0]
+        return None
+
+    # -- summaries ---------------------------------------------------------
+
+    def summary(self, info: FuncInfo) -> Optional[CallResolution]:
+        """One-level interprocedural summary: which of ``info``'s OWN
+        positional parameters are handed to a donated/static position of a
+        jit call it makes directly.  Positions are in ``info``'s parameter
+        index space (``self`` included for methods)."""
+        if info.key in self._summaries:
+            return self._summaries[info.key]
+        self._summaries[info.key] = None  # cycle guard
+        args = getattr(info.node, "args", None)
+        params = [a.arg for a in args.args] if args is not None else []
+        pidx = {p: i for i, p in enumerate(params)}
+        donate: set = set()
+        static: set = set()
+        site_line = 0
+        desc = ""
+        local_jits = self._local_jit_names(info)
+        for cs in info.calls:
+            got = self._direct_site(info, cs.node, local_jits)
+            if got is None:
+                continue
+            site, label = got
+            if not site.donate_argnums and not site.static_argnums:
+                continue
+            contributed = False
+            for j, arg in enumerate(cs.node.args):
+                if not isinstance(arg, ast.Name) or arg.id not in pidx:
+                    continue
+                if j in site.donate_argnums:
+                    donate.add(pidx[arg.id])
+                    contributed = True
+                if j in site.static_argnums:
+                    static.add(pidx[arg.id])
+                    contributed = True
+            # only a call that actually contributed a fact may name the
+            # jit site — otherwise a later static-only call would steal
+            # the citation from the donating one and RL013's message
+            # would point the maintainer at the wrong wrapping
+            if contributed and not site_line:
+                site_line = site.node.lineno
+                desc = f"{info.qualname} -> jit({label})"
+        if not donate and not static:
+            self._summaries[info.key] = None
+            return None
+        out = CallResolution(
+            donate=tuple(sorted(donate)),
+            static=tuple(sorted(static)),
+            static_names=(),
+            desc=desc,
+            site_line=site_line,
+        )
+        self._summaries[info.key] = out
+        return out
+
+    def resolve(self, info: FuncInfo, call: ast.Call) -> Optional[CallResolution]:
+        """Caller-side view of one call that reaches a jitted callable:
+        which of ITS positional argument indices are donated / static.
+        Direct jit targets first (returned even with no donated/static
+        args — RL014's pytree check needs the bare fact of jit-ness), then
+        the one-level summaries through ``resolve_call``."""
+        memo_key = (info.key, id(call))
+        if memo_key in self._resolve_memo:
+            return self._resolve_memo[memo_key]
+        out = self._resolve_uncached(info, call)
+        self._resolve_memo[memo_key] = out
+        return out
+
+    def _resolve_uncached(
+        self, info: FuncInfo, call: ast.Call
+    ) -> Optional[CallResolution]:
+        local_jits = self._local_jit_names(info)
+        got = self._direct_site(info, call, local_jits)
+        if got is not None:
+            site, label = got
+            return CallResolution(
+                donate=site.donate_argnums,
+                static=site.static_argnums,
+                static_names=site.static_argnames,
+                desc=f"jit({label})",
+                site_line=site.node.lineno,
+            )
+        chain = self.chain_of_call(info, call)
+        if not chain:
+            return None
+        callee = self.index.resolve_call(info, chain)
+        if callee is None or callee.key == info.key:
+            return None
+        summ = self.summary(callee)
+        if summ is None:
+            return None
+        # bound-method shift: `self.runner.decode_step(a, b)` binds the
+        # callee's param 0 (self), so caller arg i maps to callee param i+1
+        shift = 1 if callee.self_name is not None else 0
+        donate = tuple(p - shift for p in summ.donate if p - shift >= 0)
+        static = tuple(p - shift for p in summ.static if p - shift >= 0)
+        if not donate and not static:
+            return None
+        return CallResolution(
+            donate=donate,
+            static=static,
+            static_names=(),
+            desc=f"{callee.qualname} ({summ.desc})",
+            site_line=summ.site_line,
+        )
+
+
+def get_cache(index: ProjectIndex) -> DataflowCache:
+    cache = getattr(index, "_dataflow_cache", None)
+    if cache is None:
+        cache = DataflowCache(index)
+        index._dataflow_cache = cache
+    return cache
+
+
+# ------------------------------------------------------- statement effects
+
+
+def load_chains(stmt: ast.AST) -> List[Tuple[Tuple[str, ...], ast.AST]]:
+    """Maximal dotted Load chains a statement (header) reads."""
+    out: List[Tuple[Tuple[str, ...], ast.AST]] = []
+    covered: set = set()
+    for expr in header_exprs(stmt):
+        for sub in iter_expr(expr):
+            if id(sub) in covered:
+                continue
+            if isinstance(sub, (ast.Attribute, ast.Name)) and isinstance(
+                sub.ctx, ast.Load
+            ):
+                chain = dotted_parts(sub)
+                if chain:
+                    out.append((chain, sub))
+                    # don't re-report the sub-chains of this chain
+                    inner = sub
+                    while isinstance(inner, ast.Attribute):
+                        inner = inner.value
+                        covered.add(id(inner))
+    return out
+
+
+def store_chains(stmt: ast.AST) -> List[Tuple[str, ...]]:
+    """Dotted chains a statement assigns (Name/Attribute targets; a
+    Subscript store ``a.b[k] = v`` reports ``a.b`` as mutated-not-rebound
+    and is excluded from kills — it does not rebind the buffer)."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [
+            it.optional_vars for it in stmt.items if it.optional_vars is not None
+        ]
+    out: List[Tuple[str, ...]] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, (ast.Name, ast.Attribute)):
+            chain = dotted_parts(t)
+            if chain:
+                out.append(chain)
+    return out
+
+
+def calls_in(stmt: ast.AST) -> List[ast.Call]:
+    out = []
+    for expr in header_exprs(stmt):
+        for sub in iter_expr(expr):
+            if isinstance(sub, ast.Call):
+                out.append(sub)
+    return out
+
+
+def _prefix(p: Tuple[str, ...], c: Tuple[str, ...]) -> bool:
+    return len(p) <= len(c) and c[: len(p)] == p
+
+
+# ------------------------------------------------------------ RL013 engine
+
+
+@dataclasses.dataclass(frozen=True)
+class PoisonRead:
+    chain: Tuple[str, ...]
+    read_node: ast.AST
+    donate_node: ast.Call
+    desc: str
+    site_line: int
+
+
+def poison_reads(cache: DataflowCache, info: FuncInfo) -> List[PoisonRead]:
+    """RL013: donated operands are poisoned from the donating call until a
+    rebinding of the chain (or a prefix of it); any read in between — on
+    any path, loops and exception edges included — is a use-after-free of
+    an XLA-invalidated buffer."""
+    donating: dict = {}  # id(call) -> (CallResolution, call)
+    for cs in info.calls:
+        res = cache.resolve(info, cs.node)
+        if res is not None and res.donate:
+            donating[id(cs.node)] = (res, cs.node)
+    if not donating:
+        return []
+    cfg = cache.cfg(info)
+    site_info: dict = {}  # fact site id -> (call, res)
+
+    def effects(node: Node, state: frozenset, report=None):
+        stmt = node.stmt
+        if stmt is None:
+            return state, state
+        if report is not None:
+            for chain, rnode in load_chains(stmt):
+                for (p, sid) in state:
+                    if _prefix(p, chain):
+                        call, res = site_info[sid]
+                        report.append(
+                            PoisonRead(
+                                chain=p,  # the donated chain, not the read
+                                read_node=rnode,
+                                donate_node=call,
+                                desc=res.desc,
+                                site_line=res.site_line,
+                            )
+                        )
+        new = set(state)
+        for call in calls_in(stmt):
+            got = donating.get(id(call))
+            if got is None:
+                continue
+            res, _ = got
+            site_info[id(call)] = (call, res)
+            for p in res.donate:
+                if p < len(call.args):
+                    chain = dotted_parts(call.args[p])
+                    if chain:
+                        new.add((chain, id(call)))
+        for tgt in store_chains(stmt):
+            new = {
+                (p, s) for (p, s) in new if not _prefix(tgt, p)
+            }
+        return frozenset(new), state
+
+    states = fixpoint(cfg, lambda n, s: effects(n, s), join="may")
+    reports: List[PoisonRead] = []
+    seen: set = set()
+    for node, state in states.items():
+        if not state or node.stmt is None:
+            continue
+        found: List[PoisonRead] = []
+        effects(node, state, report=found)
+        for r in found:
+            key = (r.chain, getattr(r.read_node, "lineno", 0), id(r.donate_node))
+            if key not in seen:
+                seen.add(key)
+                reports.append(r)
+    return reports
+
+
+# ------------------------------------------------------ RL015/RL016 engine
+
+
+@dataclasses.dataclass
+class Acquisition:
+    """One tracked acquisition inside a function."""
+
+    call: ast.Call
+    label: str                     # human label ("pool.allocate", "open")
+    release_methods: Tuple[str, ...]
+    receiver: Tuple[str, ...]      # chain the release must be called on; ()
+    tracked_roots: Tuple[str, ...]  # names whose hand-off counts as transfer
+
+
+@dataclasses.dataclass(frozen=True)
+class Leak:
+    acq: "Acquisition"
+    escape_node: Optional[ast.AST]  # None: open at a normal exit
+    kind: str                       # "raise" | "exit"
+
+
+def resource_leaks(
+    cache: DataflowCache,
+    info: FuncInfo,
+    acquisitions: List[Acquisition],
+    report_normal_exit: bool = True,
+) -> List[Leak]:
+    """Shared RL015/RL016 balance check: every path from an acquisition to
+    an exit must pass a release (matching method on the same receiver), a
+    transfer (the tracked value stored into self-rooted state, appended to
+    self-rooted state, or returned), before the exit.  Exception edges are
+    real exits.  Normal-exit reports (``report_normal_exit``) are limited
+    to acquisitions that are never resolved ANYWHERE in the function —
+    conditional-acquire bookkeeping is beyond a path-insensitive lattice,
+    and a function that releases on its happy path has clearly thought
+    about ownership."""
+    if not acquisitions:
+        return []
+    by_call = {id(a.call): (i, a) for i, a in enumerate(acquisitions)}
+    cfg = cache.cfg(info)
+    self_name = info.self_name
+    ever_resolved: set = set()
+
+    def _reads_root(expr: Optional[ast.AST], roots: Tuple[str, ...]) -> bool:
+        if expr is None:
+            return False
+        for sub in iter_expr(expr):
+            if isinstance(sub, ast.Name) and sub.id in roots:
+                return True
+        return False
+
+    def _kills(stmt: ast.AST, state: frozenset) -> frozenset:
+        live = set(state)
+        if not live:
+            return state
+        # releases: <receiver>.release_method(...)
+        for call in calls_in(stmt):
+            chain = dotted_parts(call.func)
+            if not chain or len(chain) < 2:
+                continue
+            meth, recv = chain[-1], chain[:-1]
+            for i in list(live):
+                a = acquisitions[i]
+                if meth not in a.release_methods:
+                    continue
+                if a.receiver:
+                    matched = recv == a.receiver
+                else:  # value-holder resources: f.close() on the bound name
+                    matched = len(recv) == 1 and recv[0] in a.tracked_roots
+                if matched:
+                    live.discard(i)
+                    ever_resolved.add(i)
+            # handoff: the tracked value passed to ANY call — appending it
+            # to self-rooted state, registering it with another component
+            # (faulthandler.register(file=f)), or delegating cleanup — the
+            # callee is now responsible for the resource, this function is
+            # no longer the leak site
+            for i in list(live):
+                roots = acquisitions[i].tracked_roots
+                if not roots:
+                    continue
+                if any(
+                    _reads_root(arg, roots) for arg in call.args
+                ) or any(
+                    _reads_root(kw.value, roots) for kw in call.keywords
+                ):
+                    live.discard(i)
+                    ever_resolved.add(i)
+        # transfers: store into self-rooted attribute / subscript where the
+        # value or the subscript key reads a tracked root
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                base = tgt
+                key = None
+                if isinstance(tgt, ast.Subscript):
+                    base, key = tgt.value, tgt.slice
+                chain = dotted_parts(base)
+                if not chain or self_name is None or chain[0] != self_name:
+                    continue
+                for i in list(live):
+                    roots = acquisitions[i].tracked_roots
+                    if _reads_root(stmt.value, roots) or _reads_root(key, roots):
+                        live.discard(i)
+                        ever_resolved.add(i)
+        if isinstance(stmt, ast.Return):
+            for i in list(live):
+                if _reads_root(stmt.value, acquisitions[i].tracked_roots):
+                    live.discard(i)
+                    ever_resolved.add(i)
+        # `f = open(path)` then `with f:` — the context manager's __exit__
+        # now guarantees the release on every path out of the with body
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                chain = dotted_parts(item.context_expr)
+                if chain is None or len(chain) != 1:
+                    continue
+                for i in list(live):
+                    if chain[0] in acquisitions[i].tracked_roots:
+                        live.discard(i)
+                        ever_resolved.add(i)
+        return frozenset(live)
+
+    def transfer(node: Node, state: frozenset):
+        stmt = node.stmt
+        if stmt is None:
+            return state, state
+        new = _kills(stmt, state)
+        for call in calls_in(stmt):
+            got = by_call.get(id(call))
+            if got is not None:
+                new = new | {got[0]}
+        return new, state
+
+    # conditional acquires: `if not pool.cache_retain(b): break` — the
+    # acquisition holds only on the branch where the call returned truthy
+    cond_map: dict = {}
+    for stmt in scope_stmts(info.node):
+        if not isinstance(stmt, ast.If):
+            continue
+        for i, a in enumerate(acquisitions):
+            pol = _polarity_in(stmt.test, a.call)
+            if pol is not None:
+                cond_map.setdefault(id(stmt), []).append((i, pol))
+
+    def edge_adjust(node: Node, label: str, out: frozenset) -> frozenset:
+        conds = cond_map.get(id(node.stmt)) if node.stmt is not None else None
+        if not conds:
+            return out
+        drop = {
+            i for i, positive in conds if (label == "true") != positive
+        }
+        return frozenset(x for x in out if x not in drop) if drop else out
+
+    states = fixpoint(cfg, transfer, join="may", edge_adjust=edge_adjust)
+
+    leaks: List[Leak] = []
+    reported: set = set()
+    # raising escapes: a raise-capable node holding an open resource whose
+    # exception continuation reaches the raise exit without killing it
+    for node, state in states.items():
+        if not state or not node.esucc or node.stmt is None:
+            continue
+        for i in state:
+            if ("raise", i) in reported:
+                continue
+            if _is_release_stmt(node.stmt, acquisitions[i]):
+                # the escaping statement IS the release (a close() that
+                # itself raises) — not an actionable leak. A failed
+                # HANDOFF (register(file=f) raising) is NOT exempt: the
+                # resource is then neither registered nor closed.
+                continue
+            if _escapes(node, i, acquisitions, _kills):
+                reported.add(("raise", i))
+                leaks.append(
+                    Leak(acq=acquisitions[i], escape_node=node.stmt, kind="raise")
+                )
+    if report_normal_exit:
+        exit_state = states.get(cfg.exit, frozenset())
+        for i in exit_state:
+            if i not in ever_resolved and ("exit", i) not in reported:
+                reported.add(("exit", i))
+                leaks.append(Leak(acq=acquisitions[i], escape_node=None, kind="exit"))
+    return leaks
+
+
+def _is_release_stmt(stmt: ast.AST, acq: "Acquisition") -> bool:
+    """Does this statement call the acquisition's RELEASE method (close/
+    release/free on the matching receiver)?  Used to exempt the release
+    call itself from escape reports."""
+    for call in calls_in(stmt):
+        chain = dotted_parts(call.func)
+        if not chain or len(chain) < 2:
+            continue
+        if chain[-1] not in acq.release_methods:
+            continue
+        recv = chain[:-1]
+        if acq.receiver:
+            if recv == acq.receiver:
+                return True
+        elif len(recv) == 1 and recv[0] in acq.tracked_roots:
+            return True
+    return False
+
+
+def _polarity_in(test: ast.AST, call: ast.Call) -> Optional[bool]:
+    """Is ``call``'s result truthy on the TRUE branch of ``test``?  True
+    for ``if acquire():``, False for ``if not acquire():`` (odd number of
+    enclosing ``not``s), None when the call is not in the test."""
+    stack: List[Tuple[ast.AST, int]] = [(test, 0)]
+    while stack:
+        node, nots = stack.pop()
+        if node is call:
+            return nots % 2 == 0
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            stack.append((node.operand, nots + 1))
+            continue
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for ch in ast.iter_child_nodes(node):
+            stack.append((ch, nots))
+    return None
+
+
+def _escapes(node: Node, fact: int, acquisitions, kills_fn) -> bool:
+    """Does the exception raised at ``node`` reach the function boundary
+    with ``fact`` still open?  BFS the exception continuation applying
+    only kill effects (state-insensitive witness check)."""
+    work = list(node.esucc)
+    seen: set = set()
+    while work:
+        cur = work.pop()
+        if id(cur) in seen:
+            continue
+        seen.add(id(cur))
+        if cur.kind == "raise":
+            return True
+        if cur.stmt is not None:
+            if fact not in kills_fn(cur.stmt, frozenset({fact})):
+                continue  # released/transferred on this continuation
+        work.extend(cur.succ)
+        work.extend(cur.esucc)
+    return False
+
+
+# ----------------------------------------------------------- RL014 helpers
+
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def loop_varying_names(loop: ast.AST) -> set:
+    """Names (re)bound by the loop header or anywhere in its body —
+    anything whose value can differ between iterations.  Works for
+    ``for``/``while`` statements AND comprehensions (whose generator
+    targets vary per element exactly the same way)."""
+    out: set = set()
+    stack: List[ast.AST] = []
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        stack.append(loop.target)
+        stack.extend(loop.body)
+    elif isinstance(loop, _COMPREHENSIONS):
+        for gen in loop.generators:
+            stack.append(gen.target)
+    else:  # While
+        stack.extend(loop.body)
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Store):
+            out.add(cur.id)
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+def names_in(expr: ast.AST) -> set:
+    return {
+        n.id
+        for n in iter_expr(expr)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def set_built_pytree(expr: ast.AST) -> bool:
+    """A dict/list argument whose keys/elements iterate a SET — pytree
+    structure then depends on set iteration order, which varies run to
+    run: every variation is a fresh trace."""
+    for sub in iter_expr(expr):
+        src = None
+        if isinstance(sub, (ast.DictComp, ast.ListComp, ast.SetComp)):
+            src = sub.generators[0].iter if sub.generators else None
+        elif isinstance(sub, ast.GeneratorExp):
+            src = sub.generators[0].iter if sub.generators else None
+        if src is None:
+            continue
+        for s in iter_expr(src):
+            if isinstance(s, ast.Set) or isinstance(s, ast.SetComp):
+                return True
+            if (
+                isinstance(s, ast.Call)
+                and isinstance(s.func, ast.Name)
+                and s.func.id in ("set", "frozenset")
+            ):
+                return True
+    return False
+
+
+# lock-ish attribute names (shared with the index / RL005)
+LOCKISH_RE = LOCK_ATTR_RE

@@ -189,6 +189,10 @@ class JitSite:
     wrapper: str                             # jit / pjit / shard_map
     static_argnums: Tuple[int, ...] = ()
     static_argnames: Tuple[str, ...] = ()
+    #: positions whose input buffer XLA invalidates (RL013's bug class);
+    #: indices are in the traced function's parameter space, which for the
+    #: repo's bound-method wrappings equals the call-site arg position
+    donate_argnums: Tuple[int, ...] = ()
     decorator_of: Optional[str] = None       # FuncInfo key when via decorator
 
 
@@ -784,6 +788,7 @@ class ProjectIndex:
             wrapper=chain[-1],
             static_argnums=_kw_int_tuple(node, "static_argnums"),
             static_argnames=_kw_str_tuple(node, "static_argnames"),
+            donate_argnums=_kw_int_tuple(node, "donate_argnums"),
         )
 
     def _jit_decorator(self, dec: ast.AST, info: FuncInfo) -> Optional[JitSite]:
@@ -801,6 +806,10 @@ class ProjectIndex:
                     _kw_str_tuple(dec, "static_argnames")
                     if isinstance(dec, ast.Call) else ()
                 ),
+                donate_argnums=(
+                    _kw_int_tuple(dec, "donate_argnums")
+                    if isinstance(dec, ast.Call) else ()
+                ),
                 decorator_of=info.key,
             )
         # @partial(jax.jit, static_argnums=...)
@@ -813,6 +822,7 @@ class ProjectIndex:
                     wrapper=inner[-1],
                     static_argnums=_kw_int_tuple(dec, "static_argnums"),
                     static_argnames=_kw_str_tuple(dec, "static_argnames"),
+                    donate_argnums=_kw_int_tuple(dec, "donate_argnums"),
                     decorator_of=info.key,
                 )
         return None

@@ -22,6 +22,7 @@ from ray_tpu._lint.core import (
     is_remote_def,
     register,
 )
+from ray_tpu._lint.index import dotted_parts
 
 
 def _fallback_unserializable() -> dict:
@@ -1027,3 +1028,400 @@ class ObservabilityNameDrift(ProjectRule):
             if family in docs and suffix in docs:
                 return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# RL013-RL016: path-sensitive dataflow rules (phase 1.5, ray_tpu._lint.dataflow)
+# ---------------------------------------------------------------------------
+
+
+def _analyzable_functions(index):
+    """Defs worth a CFG: real functions/methods (the module pseudo-scope is
+    skipped — module-level control flow is trivially linear here and the
+    donating/jitted calls all live inside defs)."""
+    for info in index.functions.values():
+        if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield info
+
+
+# --------------------------------------------------------------------- RL013
+
+
+@register
+class UseAfterDonation(ProjectRule):
+    id = "RL013"
+    name = "use-after-donation"
+    description = (
+        "A buffer passed at a donated position (donate_argnums) of a "
+        "registry-known jitted call is INVALIDATED by XLA the moment the "
+        "call dispatches — the step reuses its memory for the output. "
+        "Reading the same variable/attribute afterwards, on any path, "
+        "before it is reassigned returns deleted-buffer errors (or, on "
+        "backends that alias in place, silently garbled data). The rule "
+        "runs a forward may-analysis over the per-function CFG: donated "
+        "operands are poisoned at the call and cleansed only by "
+        "rebinding; every read in between fires, naming both sites. "
+        "Donation is resolved through the jit registry one call level "
+        "deep: self._step = jax.jit(fn, donate_argnums=...) attributes, "
+        "local/module names bound to jit calls (including via a factory "
+        "whose return is directly a jit call), and methods that forward "
+        "their parameters to a donated position (model_runner.decode_step "
+        "donates its k_pool/v_pool for engine callers)."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow
+
+        cache = dataflow.get_cache(index)
+        for info in _analyzable_functions(index):
+            if not info.calls:
+                continue
+            for r in dataflow.poison_reads(cache, info):
+                yield info.ctx.violation(
+                    self, r.read_node,
+                    f"use-after-donation: {'.'.join(r.chain)} was donated "
+                    f"to {r.desc} at line {r.donate_node.lineno} "
+                    f"(jit site line {r.site_line}) and is invalidated by "
+                    "XLA; reassign it from the call's result before "
+                    "reading it",
+                )
+
+
+# --------------------------------------------------------------------- RL014
+
+
+@register
+class RetraceStorm(ProjectRule):
+    id = "RL014"
+    name = "retrace-storm"
+    description = (
+        "A registry-known jitted call inside a loop whose STATIC-argument "
+        "operand (static_argnums/static_argnames) varies per iteration — "
+        "the loop variable or anything assigned in the loop body — "
+        "recompiles on EVERY iteration: a silent 1000x slowdown that "
+        "profiles as 'jax is slow'. Also fires when a pytree argument of "
+        "a jitted call in a loop is built by iterating a set "
+        "(set()/set-literal/set-comprehension): pytree structure then "
+        "depends on unordered iteration, and every ordering is a fresh "
+        "trace. Hoist the static value out of the loop, make it a traced "
+        "argument, or sort the keys."
+    )
+
+    def _loop_calls(self, loop):
+        """jit-candidate Call nodes inside the loop body (or, for a
+        comprehension, its per-element expressions), honoring scope
+        boundaries (nested defs/lambdas execute elsewhere)."""
+        from ray_tpu._lint.dataflow import _COMPREHENSIONS
+
+        if isinstance(loop, _COMPREHENSIONS):
+            stack = [loop.key, loop.value] if isinstance(
+                loop, ast.DictComp
+            ) else [loop.elt]
+            for gen in loop.generators:
+                stack.extend(gen.ifs)
+        else:
+            stack = list(loop.body)
+        while stack:
+            cur = stack.pop()
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                continue
+            if isinstance(cur, ast.Call):
+                yield cur
+            stack.extend(ast.iter_child_nodes(cur))
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow
+
+        cache = dataflow.get_cache(index)
+        for info in _analyzable_functions(index):
+            if not info.calls:
+                continue
+            reported: set = set()  # (call id, kind, detail) across loops
+            for loop in dataflow.scope_stmts(info.node):
+                if not isinstance(
+                    loop,
+                    (ast.For, ast.AsyncFor, ast.While)
+                    + dataflow._COMPREHENSIONS,
+                ):
+                    continue
+                varying = dataflow.loop_varying_names(loop)
+                for stmt in self._loop_calls(loop):
+                    res = cache.resolve(info, stmt)
+                    if res is None:
+                        continue
+                    for p in res.static:
+                        if p >= len(stmt.args):
+                            continue
+                        hot = dataflow.names_in(stmt.args[p]) & varying
+                        key = (id(stmt), "static", p)
+                        if hot and key not in reported:
+                            reported.add(key)
+                            yield info.ctx.violation(
+                                self, stmt,
+                                f"retrace-storm: static arg {p} of "
+                                f"{res.desc} (jit site line {res.site_line}) "
+                                f"is built from {sorted(hot)!r}, which "
+                                "varies per loop iteration — every "
+                                "iteration recompiles; hoist it or make "
+                                "it a traced argument",
+                            )
+                    for kw in stmt.keywords:
+                        if kw.arg in res.static_names:
+                            hot = dataflow.names_in(kw.value) & varying
+                            key = (id(stmt), "static_kw", kw.arg)
+                            if hot and key not in reported:
+                                reported.add(key)
+                                yield info.ctx.violation(
+                                    self, stmt,
+                                    f"retrace-storm: static kwarg "
+                                    f"{kw.arg!r} of {res.desc} is built "
+                                    f"from {sorted(hot)!r}, which varies "
+                                    "per loop iteration — every iteration "
+                                    "recompiles; hoist it or make it a "
+                                    "traced argument",
+                                )
+                    for arg in list(stmt.args) + [k.value for k in stmt.keywords]:
+                        key = (id(stmt), "pytree", 0)
+                        if dataflow.set_built_pytree(arg) and key not in reported:
+                            reported.add(key)
+                            yield info.ctx.violation(
+                                self, stmt,
+                                f"retrace-storm: a pytree argument of "
+                                f"{res.desc} is built by iterating a set; "
+                                "pytree structure follows unordered "
+                                "iteration, so orderings retrace — sort "
+                                "the keys or build from an ordered source",
+                            )
+
+
+# --------------------------------------------------------------------- RL015
+
+
+#: acquire method -> the release that balances it (KVBlockPool's ledger)
+_KV_PAIRS = {"allocate": ("free",), "cache_retain": ("cache_release",)}
+
+
+@register
+class BlockOwnershipBalance(ProjectRule):
+    id = "RL015"
+    name = "block-ownership-balance"
+    description = (
+        "Along every path through a function that takes KV-block "
+        "ownership — KVBlockPool.allocate() / cache_retain() — the "
+        "matching free()/cache_release() or an ownership TRANSFER "
+        "(storing the blocks/owner into self-rooted state, appending to "
+        "it, or returning them) must dominate every exit, exception "
+        "edges included. A path that escapes between the allocate and "
+        "the transfer leaks the blocks until KVBlockPool.audit() or the "
+        "watchdog notices at runtime — this rule is the static twin of "
+        "that audit, catching the leak at review time. Receivers resolve "
+        "through the index (an attribute annotated/constructed as "
+        "KVBlockPool) or by pool-ish naming. Conditional acquires a "
+        "happy path resolves are exempt from normal-exit reports (a "
+        "boolean-correlated ledger is beyond a path-insensitive "
+        "lattice); raising escapes always fire."
+    )
+
+    def _pool_receiver(self, index, info, recv) -> bool:
+        if not recv:
+            return False
+        if "pool" in recv[-1].lower():
+            return True
+        if (
+            info.cls is not None
+            and info.self_name
+            and recv[0] == info.self_name
+            and len(recv) == 2
+        ):
+            ck = info.cls.attr_classes.get(recv[1])
+            if ck is not None and ck[1] == "KVBlockPool":
+                return True
+        return False
+
+    def _acquisitions(self, index, info):
+        from ray_tpu._lint import dataflow
+
+        out = []
+        for stmt in dataflow.scope_stmts(info.node):
+            if not isinstance(stmt, ast.stmt):
+                continue  # scope_stmts yields every node; scan per STATEMENT
+            for call in dataflow.calls_in(stmt):
+                chain = dotted_parts(call.func)
+                if not chain or len(chain) < 2:
+                    continue
+                meth, recv = chain[-1], chain[:-1]
+                if meth not in _KV_PAIRS:
+                    continue
+                if not self._pool_receiver(index, info, recv):
+                    continue
+                roots = []
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            roots.append(tgt.id)
+                if call.args:
+                    key_chain = dotted_parts(call.args[0])
+                    if key_chain:
+                        roots.append(key_chain[0])
+                out.append(
+                    dataflow.Acquisition(
+                        call=call,
+                        label=f"{'.'.join(recv)}.{meth}",
+                        release_methods=_KV_PAIRS[meth],
+                        receiver=recv,
+                        tracked_roots=tuple(roots),
+                    )
+                )
+        return out
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow
+
+        cache = dataflow.get_cache(index)
+        for info in _analyzable_functions(index):
+            acqs = self._acquisitions(index, info)
+            if not acqs:
+                continue
+            for leak in dataflow.resource_leaks(cache, info, acqs):
+                a = leak.acq
+                want = "/".join(a.release_methods)
+                if leak.kind == "raise":
+                    yield info.ctx.violation(
+                        self, a.call,
+                        f"block-ownership leak: {a.label}() at line "
+                        f"{a.call.lineno} is not balanced by {want}() or "
+                        "an ownership transfer on the exception path "
+                        f"escaping from line {leak.escape_node.lineno} — "
+                        "the blocks leak until the watchdog audit; "
+                        "release them in an except/finally before the "
+                        "error escapes",
+                    )
+                else:
+                    yield info.ctx.violation(
+                        self, a.call,
+                        f"block-ownership leak: {a.label}() at line "
+                        f"{a.call.lineno} reaches a return with no "
+                        f"{want}() and no ownership transfer anywhere in "
+                        "the function — the ledger entry outlives every "
+                        "reference to it",
+                    )
+
+
+# --------------------------------------------------------------------- RL016
+
+
+_OPEN_CTORS = {
+    "open": ("close",),
+    "socket.socket": ("close", "detach"),
+    "socket.create_connection": ("close", "detach"),
+}
+
+
+@register
+class UnreleasedResourceOnRaise(ProjectRule):
+    id = "RL016"
+    name = "unreleased-resource-on-raise"
+    description = (
+        "A resource acquired without a with-statement — open(), "
+        "socket.socket()/create_connection(), or an unconditional "
+        "lock/Condition .acquire() — where a raising path escapes the "
+        "function before the matching close()/release() and no "
+        "with/finally covers it. Handlers count: a release inside an "
+        "except/finally that re-raises is a covered path, and a "
+        "catch-all handler stops the escape; a narrow handler "
+        "(except OSError) does NOT stop other exception types, so the "
+        "escape edge survives it. Intentionally process-lifetime "
+        "resources are fine on the NORMAL path — only raising escapes "
+        "fire. Conditional acquires (blocking=False / timeout=) are "
+        "skipped: their ownership is boolean-correlated (RL011 covers "
+        "their deadlock half)."
+    )
+
+    def _acquisitions(self, info):
+        from ray_tpu._lint import dataflow
+
+        out = []
+        with_items: set = set()
+        for stmt in dataflow.scope_stmts(info.node):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    for sub in dataflow.iter_expr(item.context_expr):
+                        with_items.add(id(sub))
+        for stmt in dataflow.scope_stmts(info.node):
+            if not isinstance(stmt, ast.stmt):
+                continue  # scope_stmts yields every node; scan per STATEMENT
+            for call in dataflow.calls_in(stmt):
+                if id(call) in with_items:
+                    continue
+                chain = dotted_parts(call.func)
+                if not chain:
+                    continue
+                dotted = ".".join(chain)
+                # `import socket as _socket` still reads as *socket.socket
+                socket_alias = (
+                    len(chain) == 2
+                    and chain[-1] in ("socket", "create_connection")
+                    and "socket" in chain[0]
+                )
+                if dotted in _OPEN_CTORS or socket_alias:
+                    releases = _OPEN_CTORS.get(dotted, ("close", "detach"))
+                    roots = []
+                    # only a DIRECT binding (`f = open(...)`) is trackable;
+                    # an open() buried in a comprehension/argument has no
+                    # name whose close()/handoff we could observe
+                    if isinstance(stmt, ast.Assign) and stmt.value is call:
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                roots.append(tgt.id)
+                    if not roots:
+                        continue  # unbound resource: nothing to track
+                    out.append(
+                        dataflow.Acquisition(
+                            call=call,
+                            label=f"{dotted}()",
+                            release_methods=releases,
+                            receiver=(),
+                            tracked_roots=tuple(roots),
+                        )
+                    )
+                elif (
+                    chain[-1] == "acquire"
+                    and len(chain) > 1
+                    and dataflow.LOCKISH_RE.search(chain[-2])
+                    and not call.args
+                    and not call.keywords
+                ):
+                    out.append(
+                        dataflow.Acquisition(
+                            call=call,
+                            label=f"{'.'.join(chain[:-1])}.acquire()",
+                            release_methods=("release",),
+                            receiver=chain[:-1],
+                            tracked_roots=(),
+                        )
+                    )
+        return out
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow
+
+        cache = dataflow.get_cache(index)
+        for info in _analyzable_functions(index):
+            acqs = self._acquisitions(info)
+            if not acqs:
+                continue
+            leaks = dataflow.resource_leaks(
+                cache, info, acqs, report_normal_exit=False
+            )
+            for leak in leaks:
+                a = leak.acq
+                yield info.ctx.violation(
+                    self, a.call,
+                    f"unreleased resource on raise: {a.label} acquired at "
+                    f"line {a.call.lineno} escapes via the exception "
+                    f"raised at line {leak.escape_node.lineno} without "
+                    f"{'/'.join(a.release_methods)}() and no with/finally "
+                    "covers it; release it on the exception path",
+                )

@@ -39,7 +39,11 @@ def arm_stack_dumps() -> Optional[str]:
         os.makedirs(STACKS_DIR, exist_ok=True)
         path = os.path.join(STACKS_DIR, f"{os.getpid()}.stacks")
         f = open(path, "w")  # held open for the process lifetime (signal-safe fd)
-        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+        try:
+            faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+        except BaseException:
+            f.close()  # a failed arm must not leak the fd (RL016)
+            raise
         atexit.register(_unlink_quiet, path)  # crash-killed workers are
         # reaped by their spawner (head death path / agent proc sweep)
         return path

@@ -129,11 +129,12 @@ def package(runtime_env: Optional[dict], ctx, kind: str = "task") -> Optional[di
             # semantics), expanded at submission
             if not os.path.isfile(reqs):
                 raise ValueError(f"runtime_env['pip'] requirements file {reqs!r} not found")
-            reqs = [
-                line.strip()
-                for line in open(reqs).read().splitlines()
-                if line.strip() and not line.strip().startswith("#")
-            ]
+            with open(reqs) as fh:
+                reqs = [
+                    line.strip()
+                    for line in fh.read().splitlines()
+                    if line.strip() and not line.strip().startswith("#")
+                ]
         shipped = []
         for r in reqs:
             remote_form = "://" in r or r.startswith("git+") or " @ " in r
@@ -148,8 +149,10 @@ def package(runtime_env: Optional[dict], ctx, kind: str = "task") -> Optional[di
             if looks_local:
                 # a LOCAL distribution (wheel/sdist): ship its bytes so
                 # every node can install it without an index (air-gapped)
+                with open(r, "rb") as fh:
+                    blob = fh.read()
                 shipped.append({
-                    "file_key": _kv_put_blob(open(r, "rb").read(), ctx),
+                    "file_key": _kv_put_blob(blob, ctx),
                     "name": os.path.basename(r),
                 })
             else:

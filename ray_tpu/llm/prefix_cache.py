@@ -271,12 +271,23 @@ class PrefixCache:
                 child = node.children.get(key)
                 if child is None:
                     blk = blocks[b]
-                    # one node per physical block, and only blocks the
-                    # pool can take a reference on (defensive: a block
-                    # freed between prefill and insert must not resurrect)
-                    if blk in self._by_block or not self.pool.cache_retain(blk):
+                    if blk in self._by_block:
+                        break  # one node per physical block
+                    # The node is built BEFORE the pool reference is
+                    # taken, so the retain → tree-registration window
+                    # holds only plain stores: an error escaping between
+                    # the two would strand a cache reference no tree node
+                    # tracks — a leak only the runtime audit would see
+                    # (RL015's bug class; its conservative model treats
+                    # any call, even this trivial constructor, as able to
+                    # raise).
+                    fresh = _Node(key, blk, node)
+                    # only blocks the pool can take a reference on
+                    # (defensive: a block freed between prefill and
+                    # insert must not resurrect)
+                    if not self.pool.cache_retain(blk):
                         break
-                    child = _Node(key, blk, node)
+                    child = fresh
                     node.children[key] = child
                     self._by_block[blk] = child
                     created += 1

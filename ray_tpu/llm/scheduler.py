@@ -231,22 +231,41 @@ class Scheduler:
                 if not self.pool.can_allocate(need_tokens, shared=len(shared)):
                     break  # FIFO head blocked on memory: don't starve it
             self.waiting.popleft()
-            blocks = self.pool.allocate(req.id, need_tokens, shared=shared)
             slot = free[0]
-            self.slots[slot] = req
-            req.state = PREFILL
-            req.prefill_pos = match.matched if match is not None else 0
-            if self.prefix_cache is not None:
-                req.cache_epoch = self.prefix_cache.epoch
-            if match is not None and match.cow_src is not None:
-                # the forked block sits right after the shared prefix;
-                # its first cow_tokens positions become valid at copy time
-                self.pending_cow.append(
-                    (match.cow_src, blocks[len(shared)], req.id)
-                )
-            if self.prefix_cache is not None:
-                self.prefix_cache.record(req, match, len(req.prompt) + len(req.out))
-            self._admitted_at[req.id] = next(self._admit_seq)
+            blocks = self.pool.allocate(req.id, need_tokens, shared=shared)
+            try:
+                self.slots[slot] = req
+                req.state = PREFILL
+                req.prefill_pos = match.matched if match is not None else 0
+                if self.prefix_cache is not None:
+                    req.cache_epoch = self.prefix_cache.epoch
+                if match is not None and match.cow_src is not None:
+                    # the forked block sits right after the shared prefix;
+                    # its first cow_tokens positions become valid at copy
+                    # time
+                    self.pending_cow.append(
+                        (match.cow_src, blocks[len(shared)], req.id)
+                    )
+                if self.prefix_cache is not None:
+                    self.prefix_cache.record(
+                        req, match, len(req.prompt) + len(req.out)
+                    )
+                self._admitted_at[req.id] = next(self._admit_seq)
+            except BaseException:
+                # exception-path block release (RL015's bug class): an
+                # admission that fails AFTER taking blocks but before the
+                # request is fully installed would otherwise leave the
+                # ledger entry owned by a request in no slot and no queue
+                # — a leak only the watchdog audit would ever notice.
+                # Roll the whole admission back and let the error surface.
+                self.slots[slot] = None
+                self.pool.free(req.id)
+                self._admitted_at.pop(req.id, None)
+                self._drop_pending_cow(req.id)
+                req.state = WAITING
+                req.prefill_pos = 0
+                self.waiting.appendleft(req)
+                raise
             admitted.append(req)
             _events.record(
                 "llm.admit", request_id=req.trace_id, engine_req=req.id,

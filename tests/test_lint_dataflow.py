@@ -1,0 +1,425 @@
+"""Unit tests for the raylint dataflow phase (ray_tpu._lint.dataflow):
+CFG construction (branch/loop/try/finally shapes, exception edges, branch
+labels), the forward fixpoint engine in both may and must modes, and the
+jit donation/static summaries the RL013/RL014 rules consume."""
+
+import ast
+import textwrap
+
+from ray_tpu._lint import dataflow
+from ray_tpu._lint.core import FileContext
+from ray_tpu._lint.index import build_index
+
+
+def _fn(src, name=None):
+    tree = ast.parse(textwrap.dedent(src))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and (name is None or node.name == name):
+            return node
+    raise AssertionError("no function in snippet")
+
+
+def _cfg(src, name=None):
+    return dataflow.build_cfg(_fn(src, name))
+
+
+def _reachable(cfg):
+    seen = set()
+    work = [cfg.entry]
+    while work:
+        n = work.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        work.extend(n.succ)
+        work.extend(n.esucc)
+    return seen
+
+
+def _stmt_nodes(cfg, kind=None):
+    return [
+        n
+        for n in cfg.nodes
+        if n.stmt is not None and id(n) in _reachable(cfg) and (
+            kind is None or isinstance(n.stmt, kind)
+        )
+    ]
+
+
+# ------------------------------------------------------------------ CFG
+
+
+def test_linear_flow_reaches_exit():
+    cfg = _cfg("""
+        def f(x):
+            y = x + 1
+            return y
+    """)
+    assert id(cfg.exit) in _reachable(cfg)
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    assert cfg.exit in ret.succ
+
+
+def test_if_branches_are_labeled():
+    cfg = _cfg("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+    """)
+    head = _stmt_nodes(cfg, ast.If)[0]
+    labels = sorted(head.succ_label.values())
+    assert labels == ["false", "true"]
+
+
+def test_if_without_else_labels_fallthrough():
+    cfg = _cfg("""
+        def f(x):
+            if x:
+                a = 1
+            return x
+    """)
+    head = _stmt_nodes(cfg, ast.If)[0]
+    assert list(head.succ_label.values()) == ["true"]
+    assert head.fallthrough_label == "false"
+
+
+def test_loop_has_back_edge_and_break_exit():
+    cfg = _cfg("""
+        def f(xs):
+            out = 0
+            for x in xs:
+                if x < 0:
+                    break
+                out += x
+            return out
+    """)
+    head = _stmt_nodes(cfg, (ast.For,))[0]
+    # the body's last statement loops back to the header
+    aug = _stmt_nodes(cfg, ast.AugAssign)[0]
+    assert head in aug.succ
+    # break reaches the return without passing the header again
+    brk = _stmt_nodes(cfg, ast.Break)[0]
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    seen, work = set(), list(brk.succ)
+    while work:
+        n = work.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        work.extend(n.succ)
+    assert id(ret) in seen and id(head) not in seen
+
+
+def test_call_statement_has_exception_edge_to_raise_exit():
+    cfg = _cfg("""
+        def f(x):
+            g(x)
+            return x
+    """)
+    call = [n for n in _stmt_nodes(cfg) if isinstance(n.stmt, ast.Expr)][0]
+    assert cfg.raise_exit in call.esucc
+
+
+def test_narrow_handler_keeps_escape_edge():
+    cfg = _cfg("""
+        def f(x):
+            try:
+                g(x)
+            except OSError:
+                pass
+            return x
+    """)
+    call = [n for n in _stmt_nodes(cfg) if isinstance(n.stmt, ast.Expr)][0]
+    # handler entry AND the escape (OSError is not catch-all)
+    assert cfg.raise_exit in call.esucc
+    assert len(call.esucc) == 2
+
+
+def test_catch_all_handler_stops_escape():
+    cfg = _cfg("""
+        def f(x):
+            try:
+                g(x)
+            except Exception:
+                pass
+            return x
+    """)
+    call = [n for n in _stmt_nodes(cfg) if isinstance(n.stmt, ast.Expr)][0]
+    assert cfg.raise_exit not in call.esucc
+
+
+def test_finally_on_exception_path():
+    cfg = _cfg("""
+        def f(x):
+            try:
+                g(x)
+            finally:
+                release(x)
+            return x
+    """)
+    call = [
+        n for n in _stmt_nodes(cfg)
+        if isinstance(n.stmt, ast.Expr)
+        and isinstance(n.stmt.value, ast.Call)
+        and n.stmt.value.func.id == "g"
+    ][0]
+    # exception routes through the finally copy, not straight out
+    assert cfg.raise_exit not in call.esucc
+    assert len(call.esucc) == 1
+    fin = call.esucc[0]
+    assert isinstance(fin.stmt, ast.Expr)  # the release(x) copy
+    assert cfg.raise_exit in [s for s in fin.succ]
+
+
+def test_return_routes_through_finally():
+    cfg = _cfg("""
+        def f(x):
+            try:
+                return g(x)
+            finally:
+                release(x)
+    """)
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    (fin,) = ret.succ
+    assert isinstance(fin.stmt, ast.Expr)  # the finally's release copy
+    assert cfg.exit in fin.succ
+
+
+def test_raise_statement_targets_handlers():
+    cfg = _cfg("""
+        def f(x):
+            try:
+                raise ValueError(x)
+            except ValueError:
+                return 1
+    """)
+    rz = _stmt_nodes(cfg, ast.Raise)[0]
+    assert rz.succ == [] and len(rz.esucc) == 2  # handler + escape
+
+
+# ------------------------------------------------------------- fixpoint
+
+
+def _assign_analysis(cfg, join):
+    """Toy definite/possible-assignment analysis over Name stores."""
+
+    def transfer(node, state):
+        stmt = node.stmt
+        if stmt is None:
+            return state, state
+        new = set(state)
+        for chain in dataflow.store_chains(stmt):
+            if len(chain) == 1:
+                new.add(chain[0])
+        return frozenset(new), state
+
+    return dataflow.fixpoint(cfg, transfer, join=join)
+
+
+def test_fixpoint_may_vs_must_join():
+    cfg = _cfg("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                b = 2
+            return x
+    """)
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    may = _assign_analysis(cfg, "may")[ret]
+    must = _assign_analysis(cfg, "must")[ret]
+    assert may == frozenset({"a", "b"})   # assigned on SOME path
+    assert must == frozenset()            # on EVERY path: neither
+
+
+def test_fixpoint_must_keeps_common_facts():
+    cfg = _cfg("""
+        def f(x):
+            if x:
+                a = 1
+                c = 3
+            else:
+                a = 2
+            return x
+    """)
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    must = _assign_analysis(cfg, "must")[ret]
+    assert must == frozenset({"a"})
+
+
+def test_fixpoint_loop_terminates_and_unions():
+    cfg = _cfg("""
+        def f(xs):
+            for x in xs:
+                y = x
+            return xs
+    """)
+    ret = _stmt_nodes(cfg, ast.Return)[0]
+    may = _assign_analysis(cfg, "may")[ret]
+    assert may == frozenset({"x", "y"})
+
+
+# ------------------------------------------------- summaries / resolution
+
+
+def _index_for(tmp_path, sources):
+    contexts = []
+    for name, src in sources.items():
+        f = tmp_path / name
+        f.write_text(textwrap.dedent(src))
+        contexts.append(
+            FileContext(f, name, f.read_text(), ast.parse(f.read_text()))
+        )
+    return build_index(contexts)
+
+
+def test_jit_registry_records_donate_argnums(tmp_path):
+    index = _index_for(tmp_path, {"m.py": """
+        import jax
+
+        class R:
+            def __init__(self):
+                self._step = jax.jit(self._impl, donate_argnums=(1, 2))
+
+            def _impl(self, p, k, v):
+                return k, v
+    """})
+    sites = [s for s, _ in index.jit_sites]
+    assert any(s.donate_argnums == (1, 2) for s in sites)
+
+
+def test_summary_lifts_donation_one_level(tmp_path):
+    index = _index_for(tmp_path, {"m.py": """
+        import jax
+
+        class R:
+            def __init__(self):
+                self._step = jax.jit(self._impl, donate_argnums=(1, 2))
+
+            def _impl(self, p, k, v):
+                return k, v
+
+            def step(self, k_pool, v_pool):
+                return self._step(self.p, k_pool, v_pool)
+    """})
+    cache = dataflow.get_cache(index)
+    step = index.functions["m:R.step"]
+    summ = cache.summary(step)
+    # param-index space includes self: k_pool=1, v_pool=2
+    assert summ is not None and summ.donate == (1, 2)
+
+
+def test_resolve_shifts_bound_method_positions(tmp_path):
+    index = _index_for(tmp_path, {
+        "m.py": """
+            import jax
+
+            class R:
+                def __init__(self):
+                    self._step = jax.jit(self._impl, donate_argnums=(1,))
+
+                def _impl(self, p, k):
+                    return k
+
+                def step(self, k_pool):
+                    return self._step(self.p, k_pool)
+        """,
+        "e.py": """
+            from m import R
+
+            class E:
+                def __init__(self):
+                    self.runner = R()
+
+                def go(self, buf):
+                    out = self.runner.step(buf)
+                    return out
+        """,
+    })
+    cache = dataflow.get_cache(index)
+    go = index.functions["e:E.go"]
+    call = next(cs.node for cs in go.calls if cs.chain[-1] == "step")
+    res = cache.resolve(go, call)
+    assert res is not None and res.donate == (0,)
+
+
+def test_factory_returned_jit_resolves(tmp_path):
+    index = _index_for(tmp_path, {"m.py": """
+        import jax
+
+        def make_step(fn):
+            return jax.jit(fn, donate_argnums=(0,))
+
+        def train(state, batch):
+            step = make_step(lambda s, b: s)
+            state2 = step(state, batch)
+            return state2
+    """})
+    cache = dataflow.get_cache(index)
+    train = index.functions["m:train"]
+    call = next(
+        cs.node for cs in train.calls if cs.chain == ("step",)
+    )
+    res = cache.resolve(train, call)
+    assert res is not None and res.donate == (0,)
+
+
+def test_unresolvable_parameter_callable_is_skipped(tmp_path):
+    # a jitted callable arriving as a PARAMETER is not resolvable — the
+    # analyses must under-approximate, not guess
+    index = _index_for(tmp_path, {"m.py": """
+        def drive(step_fn, state, batch):
+            state = step_fn(state, batch)
+            return state
+    """})
+    cache = dataflow.get_cache(index)
+    drive = index.functions["m:drive"]
+    call = next(cs.node for cs in drive.calls)
+    assert cache.resolve(drive, call) is None
+
+
+def test_conditional_acquire_polarity():
+    fn = _fn("""
+        def f(self, blk):
+            if not self.pool.cache_retain(blk):
+                return 0
+            return 1
+    """)
+    test = fn.body[0].test
+    call = next(
+        n for n in ast.walk(test) if isinstance(n, ast.Call)
+    )
+    assert dataflow._polarity_in(test, call) is False
+    other = ast.parse("x or y").body[0].value
+    assert dataflow._polarity_in(other, call) is None
+
+
+def test_summary_cites_the_contributing_jit_site(tmp_path):
+    # a later static-only jit call must not steal the site citation from
+    # the donating call RL013's message points at
+    index = _index_for(tmp_path, {"m.py": """
+        import jax
+
+        class R:
+            def __init__(self):
+                self._step = jax.jit(self._impl, donate_argnums=(1,))
+                self._other = jax.jit(self._oimpl, static_argnums=(1,))
+
+            def _impl(self, p, k):
+                return k
+
+            def _oimpl(self, x, n):
+                return x
+
+            def step(self, k_pool):
+                out = self._step(self.p, k_pool)
+                self._other(out, 3)
+                return out
+    """})
+    cache = dataflow.get_cache(index)
+    summ = cache.summary(index.functions["m:R.step"])
+    assert summ is not None and summ.donate == (1,)
+    assert "self._step" in summ.desc

@@ -26,7 +26,7 @@ def rule_ids(violations):
 
 
 def test_rule_registry_complete():
-    assert {f"RL{i:03d}" for i in range(1, 13)} <= ALL_RULE_IDS
+    assert {f"RL{i:03d}" for i in range(1, 17)} <= ALL_RULE_IDS
 
 
 # --------------------------------------------------------------------- RL001
@@ -1280,3 +1280,548 @@ def test_check_imports_relative_import_cycle(tmp_path):
     )
     problems = check_imports([str(root)])
     assert len(problems) == 1 and "cycle" in problems[0]
+
+
+# --------------------------------------------------------------------- RL013
+
+
+RL013_RUNNER = """
+    import jax
+
+
+    class Runner:
+        def __init__(self, params):
+            self.params = params
+            self._decode = jax.jit(self._impl, donate_argnums=(1, 2))
+
+        def _impl(self, params, k_pool, v_pool, tokens):
+            return k_pool, v_pool, tokens
+
+        def decode_step(self, k_pool, v_pool, tokens):
+            return self._decode(self.params, k_pool, v_pool, tokens)
+"""
+
+RL013_ENGINE_BAD = """
+    from runner import Runner
+
+
+    class Engine:
+        def __init__(self, pool):
+            self.runner = Runner({})
+            self.pool = pool
+
+        def step(self, tokens):
+            k, v, out = self.runner.decode_step(self.pool.k, self.pool.v, tokens)
+            stale = self.pool.k.sum()
+            self.pool.k, self.pool.v = k, v
+            return out, stale
+"""
+
+
+def write_donation_fixture(tmp_path, engine_src=RL013_ENGINE_BAD):
+    (tmp_path / "runner.py").write_text(textwrap.dedent(RL013_RUNNER))
+    (tmp_path / "engine.py").write_text(textwrap.dedent(engine_src))
+    return run_paths([str(tmp_path)])
+
+
+def test_rl013_fires_across_modules(tmp_path):
+    vs = write_donation_fixture(tmp_path)
+    hits = [v for v in vs if v.rule == "RL013"]
+    assert len(hits) == 1
+    msg = hits[0].message
+    # names the poisoned chain, the donating callee and the jit site
+    assert "self.pool.k" in msg and "decode_step" in msg
+    assert hits[0].symbol == "Engine.step"
+
+
+def test_rl013_reassign_before_read_ok(tmp_path):
+    good = RL013_ENGINE_BAD.replace(
+        """k, v, out = self.runner.decode_step(self.pool.k, self.pool.v, tokens)
+            stale = self.pool.k.sum()
+            self.pool.k, self.pool.v = k, v""",
+        """k, v, out = self.runner.decode_step(self.pool.k, self.pool.v, tokens)
+            self.pool.k, self.pool.v = k, v
+            stale = self.pool.k.sum()""",
+    )
+    assert "RL013" not in rule_ids(write_donation_fixture(tmp_path, good))
+
+
+def test_rl013_same_statement_swap_ok(tmp_path):
+    # the engine's real idiom: donate and reassign in ONE statement, in a
+    # loop — the back edge must see the cleansed state
+    good = RL013_ENGINE_BAD.replace(
+        """k, v, out = self.runner.decode_step(self.pool.k, self.pool.v, tokens)
+            stale = self.pool.k.sum()
+            self.pool.k, self.pool.v = k, v
+            return out, stale""",
+        """for t in tokens:
+                self.pool.k, self.pool.v, t = self.runner.decode_step(
+                    self.pool.k, self.pool.v, t
+                )
+            return tokens, 0""",
+    )
+    assert "RL013" not in rule_ids(write_donation_fixture(tmp_path, good))
+
+
+def test_rl013_direct_jit_local_fires(tmp_path):
+    src = """
+        import jax
+
+        def run(state, batch):
+            step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            new_state = step(state, batch)
+            return state.loss, new_state
+    """
+    vs = lint_snippet(tmp_path, src)
+    hits = [v for v in vs if v.rule == "RL013"]
+    assert len(hits) == 1 and "state" in hits[0].message
+
+
+def test_rl013_branch_read_fires(tmp_path):
+    # poisoned on SOME path is enough (may-join): the read sits after a
+    # rejoin where only one branch donated
+    src = """
+        import jax
+
+        def run(state, batch, flip):
+            step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+            if flip:
+                out = step(state, batch)
+            else:
+                out = state
+            return state.loss, out
+    """
+    assert "RL013" in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl013_suppressed(tmp_path):
+    bad = RL013_ENGINE_BAD.replace(
+        "stale = self.pool.k.sum()",
+        "stale = self.pool.k.sum()  # raylint: disable=RL013",
+    )
+    assert "RL013" not in rule_ids(write_donation_fixture(tmp_path, bad))
+
+
+# --------------------------------------------------------------------- RL014
+
+
+RL014_POS = """
+    import jax
+
+    step = jax.jit(lambda x: x, static_argnums=(1,))
+
+
+    def drive(xs):
+        out = []
+        for n, x in enumerate(xs):
+            out.append(step(x, n))
+        return out
+"""
+
+
+def test_rl014_static_arg_varies_fires(tmp_path):
+    vs = lint_snippet(tmp_path, RL014_POS)
+    hits = [v for v in vs if v.rule == "RL014"]
+    assert len(hits) == 1
+    assert "static arg 1" in hits[0].message and "'n'" in hits[0].message
+
+
+def test_rl014_loop_invariant_static_ok(tmp_path):
+    src = RL014_POS.replace("step(x, n)", "step(x, 7)")
+    assert "RL014" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl014_static_argname_varies_fires(tmp_path):
+    src = """
+        import jax
+
+        class R:
+            def __init__(self):
+                self._p = jax.jit(self._impl, static_argnames=("chunk",))
+
+            def _impl(self, tokens, *, chunk):
+                return tokens
+
+            def run(self, pieces):
+                out = []
+                for piece in pieces:
+                    out.append(self._p(piece, chunk=len(piece)))
+                return out
+    """
+    vs = lint_snippet(tmp_path, src)
+    assert any(
+        v.rule == "RL014" and "'chunk'" in v.message for v in vs
+    )
+
+
+def test_rl014_set_built_pytree_fires(tmp_path):
+    src = """
+        import jax
+
+        step = jax.jit(lambda tree: tree)
+
+        def drive(names, xs):
+            out = []
+            for x in xs:
+                out.append(step({k: x for k in set(names)}))
+            return out
+    """
+    vs = lint_snippet(tmp_path, src)
+    assert any(
+        v.rule == "RL014" and "iterating a set" in v.message for v in vs
+    )
+
+
+def test_rl014_not_in_loop_ok(tmp_path):
+    src = """
+        import jax
+
+        step = jax.jit(lambda x: x, static_argnums=(1,))
+
+        def drive(x, n):
+            return step(x, n)
+    """
+    assert "RL014" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl014_suppressed(tmp_path):
+    src = RL014_POS.replace(
+        "out.append(step(x, n))",
+        "out.append(step(x, n))  # raylint: disable=RL014",
+    )
+    assert "RL014" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL015
+
+
+RL015_POS = """
+    class Scheduler:
+        def __init__(self, pool):
+            self.pool = pool
+            self.slots = {}
+            self.waiting = []
+
+        def admit(self, req, free):
+            self.waiting.pop(0)
+            blocks = self.pool.allocate(req.id, 64)
+            slot = free[0]
+            self.slots[slot] = req
+            return blocks
+"""
+
+
+def test_rl015_exception_path_fires(tmp_path):
+    vs = lint_snippet(tmp_path, RL015_POS)
+    hits = [v for v in vs if v.rule == "RL015"]
+    assert len(hits) == 1
+    msg = hits[0].message
+    assert "allocate" in msg and "exception path" in msg
+    assert hits[0].symbol == "Scheduler.admit"
+
+
+def test_rl015_release_in_handler_ok(tmp_path):
+    src = RL015_POS.replace(
+        """blocks = self.pool.allocate(req.id, 64)
+            slot = free[0]
+            self.slots[slot] = req""",
+        """blocks = self.pool.allocate(req.id, 64)
+            try:
+                slot = free[0]
+                self.slots[slot] = req
+            except BaseException:
+                self.pool.free(req.id)
+                raise""",
+    )
+    assert "RL015" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl015_transfer_before_risk_ok(tmp_path):
+    src = RL015_POS.replace(
+        """blocks = self.pool.allocate(req.id, 64)
+            slot = free[0]
+            self.slots[slot] = req""",
+        """slot = free[0]
+            blocks = self.pool.allocate(req.id, 64)
+            self.slots[slot] = req""",
+    )
+    assert "RL015" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl015_never_resolved_reaches_return_fires(tmp_path):
+    src = """
+        class C:
+            def __init__(self, pool):
+                self.pool = pool
+
+            def leak(self, req):
+                self.pool.allocate(req.id, 64)
+                return True
+    """
+    vs = lint_snippet(tmp_path, src)
+    assert any(
+        v.rule == "RL015" and "reaches a return" in v.message for v in vs
+    )
+
+
+def test_rl015_conditional_retain_break_ok(tmp_path):
+    # `if not pool.cache_retain(b): break` — the break path did NOT
+    # acquire; only the success branch carries the reference
+    src = """
+        class Cache:
+            def __init__(self, pool):
+                self.pool = pool
+                self.by_block = {}
+
+            def insert(self, blocks):
+                for blk in blocks:
+                    if not self.pool.cache_retain(blk):
+                        break
+                    self.by_block[blk] = True
+                return len(self.by_block)
+    """
+    assert "RL015" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl015_raising_call_before_register_fires(tmp_path):
+    src = """
+        class Cache:
+            def __init__(self, pool):
+                self.pool = pool
+                self.by_block = {}
+
+            def insert(self, key, blk, parent):
+                if not self.pool.cache_retain(blk):
+                    return None
+                node = make_node(key, blk, parent)
+                self.by_block[blk] = node
+                return node
+    """
+    vs = lint_snippet(tmp_path, src)
+    hits = [v for v in vs if v.rule == "RL015"]
+    assert len(hits) == 1 and "cache_retain" in hits[0].message
+
+
+def test_rl015_suppressed(tmp_path):
+    src = RL015_POS.replace(
+        "blocks = self.pool.allocate(req.id, 64)",
+        "blocks = self.pool.allocate(req.id, 64)  # raylint: disable=RL015",
+    )
+    assert "RL015" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL016
+
+
+RL016_POS = """
+    import faulthandler
+    import signal
+
+
+    def arm(path):
+        f = open(path, "w")
+        faulthandler.register(signal.SIGUSR1, file=f)
+        return path
+"""
+
+
+def test_rl016_open_escapes_on_raise(tmp_path):
+    # faulthandler.register can raise; f leaks. (The register call is
+    # ALSO the handoff — the leak window is exactly that one statement.)
+    vs = lint_snippet(tmp_path, RL016_POS)
+    hits = [v for v in vs if v.rule == "RL016"]
+    assert len(hits) == 1
+    assert "open()" in hits[0].message
+
+
+def test_rl016_close_on_exception_path_ok(tmp_path):
+    src = RL016_POS.replace(
+        """f = open(path, "w")
+        faulthandler.register(signal.SIGUSR1, file=f)""",
+        """f = open(path, "w")
+        try:
+            faulthandler.register(signal.SIGUSR1, file=f)
+        except BaseException:
+            f.close()
+            raise""",
+    )
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl016_with_statement_ok(tmp_path):
+    src = """
+        def read(path):
+            with open(path) as f:
+                return parse(f.read())
+    """
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl016_finally_release_ok(tmp_path):
+    src = """
+        import socket
+
+        def probe(conn):
+            s = socket.socket(fileno=conn.fileno())
+            try:
+                return s.getsockname()[0]
+            finally:
+                s.close()
+    """
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl016_unconditional_lock_acquire_fires(tmp_path):
+    src = """
+        class Pump:
+            def drain(self, items):
+                self._lock.acquire()
+                flush(items)
+                self._lock.release()
+    """
+    vs = lint_snippet(tmp_path, src)
+    hits = [v for v in vs if v.rule == "RL016"]
+    assert len(hits) == 1 and ".acquire()" in hits[0].message
+
+
+def test_rl016_bounded_acquire_skipped(tmp_path):
+    # conditional ownership (blocking=False / timeout=) is out of scope —
+    # boolean-correlated release patterns are RL011's territory
+    src = """
+        class Pump:
+            def drain(self, items):
+                if not self._lock.acquire(timeout=0.1):
+                    return
+                flush(items)
+                self._lock.release()
+    """
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl016_normal_exit_lifetime_resource_ok(tmp_path):
+    # only RAISING escapes fire: a deliberately process-lifetime resource
+    # handed off by a plain store (which cannot raise) lints clean even
+    # though nothing ever closes it
+    src = """
+        class Arm:
+            def arm(self, path):
+                f = open(path, "w")
+                self.f = f
+                return path
+    """
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl016_suppressed(tmp_path):
+    src = RL016_POS.replace(
+        'f = open(path, "w")',
+        'f = open(path, "w")  # raylint: disable=RL016',
+    )
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# ------------------------------------------------------ --changed-only
+
+
+def test_report_only_filters_but_keeps_whole_program_index(tmp_path):
+    # the index still covers runner.py (RL013 needs its jit registry),
+    # but only engine.py may report. report_only takes resolved ABSOLUTE
+    # paths — display conventions vary with baseline anchoring, and a
+    # mismatch would silently report clean
+    (tmp_path / "runner.py").write_text(textwrap.dedent(RL013_RUNNER))
+    (tmp_path / "engine.py").write_text(textwrap.dedent(RL013_ENGINE_BAD))
+    vs = run_paths(
+        [str(tmp_path)], report_only={(tmp_path / "engine.py").resolve()}
+    )
+    assert rule_ids(vs).count("RL013") == 1
+    vs = run_paths(
+        [str(tmp_path)], report_only={(tmp_path / "runner.py").resolve()}
+    )
+    assert "RL013" not in rule_ids(vs)
+
+
+def test_changed_only_cli_no_git_falls_back(tmp_path, capsys):
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    rc = lint_main([str(tmp_path), "--changed-only"])
+    captured = capsys.readouterr()
+    # tmp_path is not a git repo: must FALL BACK to a full run (linting
+    # nothing and reporting clean would be a false bill of health)
+    assert rc == 0 and "linting everything" in captured.err
+
+
+def test_changed_only_bad_base_ref_falls_back(tmp_path, capsys):
+    # a --changed-base that git cannot resolve (shallow clone, typo'd
+    # ref) must invalidate the whole fast path, not silently shrink the
+    # changed set — a PR gate that checked nothing would read as green
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=tmp_path, check=True, capture_output=True
+        )
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\nclass XActor:\n    async def h(self):\n"
+        "        time.sleep(1)\n"
+    )
+    git("add", "-A")
+    git("commit", "-qm", "base")  # violation is COMMITTED, tree clean
+    rc = lint_main([str(tmp_path), "--changed-only",
+                    "--changed-base", "origin/doesnotexist"])
+    captured = capsys.readouterr()
+    assert "linting everything" in captured.err
+    assert rc == 1  # the full-run fallback still sees the RL002
+
+
+def test_rl016_bound_then_with_ok(tmp_path):
+    # `f = open(path)` handed to a with-statement: __exit__ guarantees the
+    # close on every path — the standard idiom must not need a suppression
+    src = """
+        def read(path):
+            f = open(path)
+            with f:
+                return parse(f.read())
+    """
+    assert "RL016" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_changed_only_survives_git_quoted_filenames(tmp_path):
+    # git's default core.quotePath C-quotes non-ASCII names; a dropped
+    # file here would mean a silent false clean on the PR fast path
+    import subprocess
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=tmp_path, check=True, capture_output=True
+        )
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    git("commit", "-q", "--allow-empty", "-m", "base")
+    (tmp_path / "naïve.py").write_text("x = 1\n")
+    from ray_tpu._lint.cli import _git_changed_files
+
+    changed = _git_changed_files(tmp_path, None)
+    assert changed is not None
+    assert any(p.name == "naïve.py" for p in changed), changed
+
+
+def test_rl014_comprehension_loop_fires(tmp_path):
+    # a comprehension is a loop too: the generator target varies per
+    # element exactly like a for-statement's
+    src = """
+        import jax
+
+        step = jax.jit(lambda x: x, static_argnums=(1,))
+
+        def drive(xs):
+            return [step(x, n) for n, x in enumerate(xs)]
+    """
+    vs = lint_snippet(tmp_path, src)
+    assert any(v.rule == "RL014" and "'n'" in v.message for v in vs)
