@@ -48,6 +48,7 @@ from ray_tpu._private.proc_handles import ForkedProc, TemplateProc, spawn_templa
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID
 from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.shm_store import ShmLocation, ShmOwner
+from ray_tpu.util import waterfall as _waterfall
 
 # --------------------------------------------------------------------------
 # Object directory
@@ -1503,6 +1504,9 @@ class Head:
         rec["worker"] = wh
         rec["state"] = "RUNNING"
         rec["started_at"] = time.monotonic()  # OOM policy: newest-first victim
+        wf = spec.get("wf")
+        if wf is not None:
+            _waterfall.stamp(wf)  # head_dispatch: about to queue the send
         self._event(rec, "RUNNING")
         # send OUTSIDE the head lock (flush_outbox); a dead conn surfaces
         # there as worker death, which requeues the whole dispatch FIFO —
@@ -2135,6 +2139,11 @@ class Head:
         if "stream_count" in payload:
             self._finish_stream_locked(task_id, payload)
         rec = self.tasks.pop(task_id, None)
+        wf = payload.get("wf")
+        if wf is not None:
+            # reply_recv closes the waterfall: fold the sampled task's
+            # stamps into the per-phase histograms + recent ring
+            _waterfall.fold(wf, rec["spec"] if rec is not None else None)
         if wh is not None:
             self._worker_pop_done(wh, task_id)
         if rec is None:
@@ -2714,6 +2723,9 @@ class Head:
         if rec is not None:
             rec["state"] = "RUNNING"
             rec["worker"] = actor.worker
+        wf = spec.get("wf")
+        if wf is not None:
+            _waterfall.stamp(wf)  # head_dispatch: about to send to the actor
         if not actor.worker.send(("run_task", spec)):
             # route through the DEDUPLICATING death path (wh.alive guard) —
             # calling _on_actor_worker_death directly left the handle alive,
@@ -4076,6 +4088,13 @@ class Head:
         # the head process's own ring (the in-process driver's, usually)
         out.setdefault("head", {})[str(os.getpid())] = _ev.snapshot()
         return out
+
+    def rpc_waterfall(self, recent: int = 0):
+        """Task-hop waterfall summary (``obs waterfall`` / the ``obs top``
+        row): per-phase percentile summaries folded from sampled tasks'
+        stamp lists, plus optionally the newest raw records (the chrome
+        trace nests them as slices)."""
+        return _waterfall.summary(recent=int(recent))
 
     def rpc_task_events(self):
         with self.lock:

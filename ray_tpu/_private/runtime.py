@@ -525,11 +525,23 @@ class BaseContext:
         # the head takes the submitter's refs on the return ids inside
         # submit_task itself — one round trip, not 1 + num_returns
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
+        wf = spec.get("wf")
+        if wf is not None:
+            # deferred import (util package ↔ runtime cycle); only the
+            # sampled-and-stamped path pays the sys.modules lookup
+            from ray_tpu.util import waterfall as _waterfall
+
+            _waterfall.stamp(wf)  # socket_write: the submit RPC begins
         self.call("submit_task", spec=spec)
         return refs
 
     def submit_actor_task(self, spec: dict) -> list[ObjectRef]:
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
+        wf = spec.get("wf")
+        if wf is not None:
+            from ray_tpu.util import waterfall as _waterfall
+
+            _waterfall.stamp(wf)  # socket_write: the submit RPC begins
         self.call("submit_actor_task", spec=spec)
         return refs
 

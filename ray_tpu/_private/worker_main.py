@@ -609,10 +609,18 @@ def _sync_over_asyncgen(agen, loop):
 def _run_task(state: WorkerState, spec: dict):
     from ray_tpu._private import runtime_env as renv
     from ray_tpu.util import tracing as _tracing
+    from ray_tpu.util import waterfall as _waterfall
 
     task_id = spec["task_id"]
     state.current_task_id = task_id
     state.task_threads[task_id] = threading.get_ident()
+    # task-hop waterfall: a sampled spec arrives with the submitter's and
+    # head's stamps; worker_deserialize marks the start of fn resolve +
+    # arg fetch, exec_start/exec_end bracket the body, and the list rides
+    # the task_done payload back so the head can fold reply_recv
+    wf = spec.get("wf")
+    if wf is not None:
+        _waterfall.stamp(wf)  # worker_deserialize
     # re-install the submitter's trace context on the executing thread:
     # spans/events inside the task body (and any nested .remote() hops)
     # carry the same request_id end-to-end (util.tracing module doc).
@@ -634,12 +642,18 @@ def _run_task(state: WorkerState, spec: dict):
         if spec["kind"] == "actor_method":
             method = _resolve_actor_method(state, spec["method_name"])
             args, kwargs = _load_args(state, spec)
+            if wf is not None:
+                _waterfall.stamp(wf)  # exec_start
             value = method(*args, **kwargs)
         else:
             fn = _resolve_function(state, spec["func_id"])
             args, kwargs = _load_args(state, spec)
+            if wf is not None:
+                _waterfall.stamp(wf)  # exec_start
             with renv.applied(spec.get("runtime_env"), state.ctx):
                 value = fn(*args, **kwargs)
+        if wf is not None:
+            _waterfall.stamp(wf)  # exec_end
     except BaseException as e:  # noqa: BLE001
         if isinstance(e, rex.TaskCancelledError):
             value = e
@@ -663,9 +677,10 @@ def _run_task(state: WorkerState, spec: dict):
     except BaseException:  # noqa: BLE001
         traceback.print_exc()
         results = []
-    _emit_done(
-        state, {"task_id": task_id, "results": results, "results_error": is_error}
-    )
+    payload = {"task_id": task_id, "results": results, "results_error": is_error}
+    if wf is not None:
+        payload["wf"] = wf
+    _emit_done(state, payload)
 
 
 def _emit_done(state: WorkerState, payload: dict) -> None:
@@ -831,11 +846,17 @@ async def _arun(state: WorkerState, spec: dict):
     import inspect
 
     from ray_tpu.util import tracing as _tracing
+    from ray_tpu.util import waterfall as _waterfall
 
     loop = asyncio.get_running_loop()
     task_id = spec["task_id"]
     state.async_tasks[task_id] = asyncio.current_task()
     is_error = False
+    # task-hop waterfall (sampled specs only; see _run_task). exec_start
+    # is stamped after the arg fetch below; exec_end after the method.
+    wf = spec.get("wf")
+    if wf is not None:
+        _waterfall.stamp(wf)  # worker_deserialize
     # best-effort trace context for async actors: the loop thread is shared,
     # so interleaved coroutines can momentarily see each other's context —
     # spans inside async methods still tag correctly in the common
@@ -863,6 +884,8 @@ async def _arun(state: WorkerState, spec: dict):
             if task_id in state.cancel_requested:
                 raise rex.TaskCancelledError()
             method = _resolve_actor_method(state, spec["method_name"])
+            if wf is not None:
+                _waterfall.stamp(wf)  # exec_start
             if inspect.iscoroutinefunction(method):
                 value = await method(*args, **kwargs)
             elif spec["method_name"] == "__dag_exec__":
@@ -901,6 +924,8 @@ async def _arun(state: WorkerState, spec: dict):
                 value = await fut
             else:
                 value = method(*args, **kwargs)
+        if wf is not None:
+            _waterfall.stamp(wf)  # exec_end
     except BaseException as e:  # noqa: BLE001
         if isinstance(e, asyncio.CancelledError):
             value = rex.TaskCancelledError()
@@ -934,9 +959,11 @@ def _finish_task(state: WorkerState, spec: dict, value, is_error: bool) -> None:
     except BaseException:  # noqa: BLE001
         traceback.print_exc()
         results = []
-    state.ctx.send_raw(
-        ("task_done", {"task_id": spec["task_id"], "results": results, "results_error": is_error})
-    )
+    payload = {"task_id": spec["task_id"], "results": results, "results_error": is_error}
+    wf = spec.get("wf")
+    if wf is not None:
+        payload["wf"] = wf  # waterfall stamps ride the reply (head folds)
+    state.ctx.send_raw(("task_done", payload))
 
 
 def _cli_main():

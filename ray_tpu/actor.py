@@ -94,9 +94,23 @@ class ActorHandle:
         )
 
     def _submit_method(self, name, args, kwargs, num_returns, concurrency_group=None):
+        # trace-context propagation: the submitter's context rides the
+        # spec by reference (sampled dict, or the shared unsampled token
+        # that keeps forensics correlated while spans stay free); with no
+        # active context the worker roots a lazy trace at the task id
+        from ray_tpu.util import tracing as _tracing
+        from ray_tpu.util import waterfall as _waterfall
+
         ctx = get_ctx()
         streaming = num_returns == "streaming"
+        tctx = _tracing.get_trace_context()
+        sp_ctx = _tracing.context_for_spec(tctx) if tctx is not None else None
+        # task-hop waterfall: sampled request/reply calls stamp phases
+        # (streaming replies arrive long after exec — no waterfall)
+        wf = None if streaming else _waterfall.maybe_start(sp_ctx)
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
+        if wf is not None:
+            _waterfall.stamp(wf)  # serialize: args done, spec build next
         task_id, return_ids = ctx.new_task_returns(
             1 if streaming else max(num_returns, 1)
         )
@@ -111,17 +125,10 @@ class ActorHandle:
             "return_ids": return_ids,
             "name": f"{self._class_name}.{name}",
         }
-        # trace-context propagation: the submitter's context rides the
-        # spec by reference (sampled dict, or the shared unsampled token
-        # that keeps forensics correlated while spans stay free); with no
-        # active context the worker roots a lazy trace at the task id
-        from ray_tpu.util import tracing as _tracing
-
-        tctx = _tracing.get_trace_context()
-        if tctx is not None:
-            sp_ctx = _tracing.context_for_spec(tctx)
-            if sp_ctx is not None:
-                spec["trace_ctx"] = sp_ctx
+        if sp_ctx is not None:
+            spec["trace_ctx"] = sp_ctx
+        if wf is not None:
+            spec["wf"] = wf
         if concurrency_group:
             spec["concurrency_group"] = concurrency_group
         refs = ctx.submit_actor_task(spec)

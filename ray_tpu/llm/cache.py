@@ -104,6 +104,19 @@ class KVBlockPool:
         return -(-max(n_tokens, 1) // self.cfg.block_size)
 
     @property
+    def block_bytes(self) -> int:
+        """Device bytes ONE physical block occupies across both pool
+        arrays and every layer (k + v) — the unit the HBM ledger gauges
+        multiply block counts by."""
+        return (self.k.nbytes + self.v.nbytes) // self.cfg.num_blocks
+
+    @property
+    def device_bytes(self) -> int:
+        """Total device footprint of the pool arrays (k + v), trash
+        block included — allocated once at engine start, never resized."""
+        return self.k.nbytes + self.v.nbytes
+
+    @property
     def num_free_blocks(self) -> int:
         with self._lock:
             return len(self._free)
@@ -127,6 +140,22 @@ class KVBlockPool:
         the scheduler can reclaim without preempting anyone."""
         with self._lock:
             return sum(1 for b in self._cache_held if self._ref.get(b) == 1)
+
+    def ledger_counts(self) -> dict:
+        """One consistent snapshot of the block partition for the HBM
+        ledger gauges (a single lock acquisition — the per-property reads
+        could interleave with an allocation between them): ``free`` +
+        ``seq_owned`` (distinct blocks owned by ≥1 sequence, shared or
+        not) + ``cache_only`` (resident purely for the prefix tree)
+        partition the usable blocks, the same invariant ``audit()``
+        checks."""
+        with self._lock:
+            owned = {b for bs in self._owned.values() for b in bs}
+            return {
+                "free": len(self._free),
+                "seq_owned": len(owned),
+                "cache_only": len(self._cache_held - owned),
+            }
 
     def utilization(self) -> float:
         """Fraction of usable (non-reserved) blocks currently owned by

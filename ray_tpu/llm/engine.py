@@ -80,6 +80,16 @@ METRIC_NAMES = (
     "llm_spec_draft_seconds",
     "llm_tokens_per_step",
     "llm_shed_requests",
+    # HBM ledger: who holds device memory (the tiered-KV spill decision's
+    # signal) — params, pool blocks split seq-owned vs cache-only
+    # resident vs free, drafter state; conservation against
+    # KVBlockPool.audit() is pinned by tests/test_profiling_plane.py
+    "llm_hbm_params_bytes",
+    "llm_hbm_kv_pool_bytes",
+    "llm_hbm_kv_seq_bytes",
+    "llm_hbm_kv_cache_bytes",
+    "llm_hbm_kv_free_bytes",
+    "llm_hbm_drafter_bytes",
 )
 
 _METRICS = None
@@ -139,8 +149,50 @@ def _metrics() -> dict:
                 "llm_shed_requests",
                 "requests rejected by deadline-aware admission (429 upstream)",
             ),
+            # HBM ledger gauges: live byte accounting of device memory.
+            # params + pool + drafter is (approximately) the resident
+            # footprint; the three kv_* gauges PARTITION the pool's
+            # usable blocks (seq-owned + cache-only + free), so the
+            # tiered-KV spill decision can read exactly how much HBM a
+            # host-RAM tier would reclaim (cache-only bytes).
+            "hbm_params": Gauge(
+                "llm_hbm_params_bytes", "device bytes held by model params"
+            ),
+            "hbm_pool": Gauge(
+                "llm_hbm_kv_pool_bytes",
+                "total device bytes of the KV pool arrays (fixed at start)",
+            ),
+            "hbm_seq": Gauge(
+                "llm_hbm_kv_seq_bytes",
+                "bytes of KV blocks owned by at least one live sequence",
+            ),
+            "hbm_cache": Gauge(
+                "llm_hbm_kv_cache_bytes",
+                "bytes of KV blocks resident ONLY in the prefix cache "
+                "(reclaimable without preempting anyone)",
+            ),
+            "hbm_free": Gauge(
+                "llm_hbm_kv_free_bytes", "bytes of free-list KV blocks"
+            ),
+            "hbm_drafter": Gauge(
+                "llm_hbm_drafter_bytes",
+                "device bytes held by the speculative drafter's params",
+            ),
         }
     return _METRICS
+
+
+def _tree_device_bytes(params) -> int:
+    """Total ``nbytes`` across a param pytree (0 for None — the n-gram
+    drafter holds no device state)."""
+    if params is None:
+        return 0
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -266,6 +318,13 @@ class LLMEngine:
             # verification keeps output exact either way)
             if self.prefix_cache is not None and hasattr(self._drafter, "corpus"):
                 self._drafter.corpus = self.prefix_cache.paths
+        # HBM ledger inputs fixed at init: params/drafter footprints never
+        # change size (update_weights validates identical leaf shapes),
+        # and the pool arrays are allocated once
+        self._params_bytes = _tree_device_bytes(params)
+        self._drafter_bytes = _tree_device_bytes(
+            getattr(self._drafter, "_params", None)
+        )
         self._lock = threading.Lock()
         self._requests: dict[str, Request] = {}
         self._step_n = 0
@@ -648,12 +707,16 @@ class LLMEngine:
 
     def stats(self) -> dict:
         with self._lock:
+            # ONE pool-ledger snapshot feeds utilization, free_blocks and
+            # the hbm section — three separate property reads could each
+            # interleave with an allocation and disagree in one response
+            led = self.hbm_ledger()
             s = {
                 "running": self.scheduler.num_running,
                 "waiting": self.scheduler.num_waiting,
                 "queue_depth": self.scheduler.num_waiting,
-                "kv_utilization": self.pool.utilization(),
-                "free_blocks": self.pool.num_free_blocks,
+                "kv_utilization": led["utilization"],
+                "free_blocks": led["free_blocks"],
                 "steps": self._step_n,
                 "tokens_generated": self._tokens_generated,
                 "prefill_tokens_computed": self._prefill_tokens,
@@ -663,6 +726,8 @@ class LLMEngine:
             }
             if self.prefix_cache is not None:
                 s["prefix_cache"] = self.prefix_cache.stats()
+            s["hbm"] = led
+            s["retraces"] = self.runner.prof.retraces
             if self._drafter is not None:
                 s["spec_proposed"] = self._spec_proposed
                 s["spec_accepted"] = self._spec_accepted
@@ -1024,11 +1089,42 @@ class LLMEngine:
         elif len(req.out) >= p.max_tokens or req.seq_len >= self.max_model_len:
             self.scheduler.finish(req, FINISH_LENGTH)
 
+    def hbm_ledger(self) -> dict:
+        """Live HBM byte accounting (the gauges' source of truth, also
+        handy for tests/stats): params, pool total, and the seq-owned /
+        cache-only / free partition of usable blocks × block bytes."""
+        bb = self.pool.block_bytes
+        counts = self.pool.ledger_counts()
+        return {
+            "params_bytes": self._params_bytes,
+            "pool_bytes": self.pool.device_bytes,
+            "block_bytes": bb,
+            "seq_bytes": counts["seq_owned"] * bb,
+            "cache_bytes": counts["cache_only"] * bb,
+            "free_bytes": counts["free"] * bb,
+            "drafter_bytes": self._drafter_bytes,
+            # utilization/free_blocks derived from the SAME snapshot —
+            # one pool-lock acquisition serves the SLO gauge, stats()
+            # and the ledger, and the numbers cannot disagree within one
+            # response (separate property reads could interleave with an
+            # allocation between the lock acquisitions)
+            "free_blocks": counts["free"],
+            "utilization": counts["seq_owned"]
+            / max(self.pool.cfg.num_blocks - 1, 1),
+        }
+
     def _publish_gauges(self) -> None:
         m = _metrics()
         m["running"].set(self.scheduler.num_running)
         m["waiting"].set(self.scheduler.num_waiting)
-        m["kv_util"].set(self.pool.utilization())
+        led = self.hbm_ledger()
+        m["kv_util"].set(led["utilization"])
+        m["hbm_params"].set(led["params_bytes"])
+        m["hbm_pool"].set(led["pool_bytes"])
+        m["hbm_seq"].set(led["seq_bytes"])
+        m["hbm_cache"].set(led["cache_bytes"])
+        m["hbm_free"].set(led["free_bytes"])
+        m["hbm_drafter"].set(led["drafter_bytes"])
         done = self.scheduler.finish_count
         if done > self._finished_published:
             m["finished"].inc(done - self._finished_published)

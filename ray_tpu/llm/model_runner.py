@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from ray_tpu._private import events as _events
 from ray_tpu.models.gpt import GPTConfig, _layernorm
+from ray_tpu.util.device_prof import JitProfiler
 from ray_tpu.models.gptj import GPTJConfig
 from ray_tpu.models.sampling import (
     sample_tokens_logprobs,
@@ -157,6 +158,12 @@ class PagedModelRunner:
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1, 2))
         self._fork = jax.jit(_fork_impl, donate_argnums=(0, 1))
         self._compiled: set = set()  # (fn, shape-key)s already traced
+        # device-step profiler: per-call wall time into device_step_seconds
+        # {site=decode|prefill|verify|fork} + retrace detection against the
+        # jit cache size — a site recompiling after its warmup baseline
+        # emits llm.retrace and trips the retrace-storm SLO (per-runner so
+        # two engines in one process never compare cache sizes)
+        self.prof = JitProfiler(event="llm.retrace")
 
     def _note_compile(self, fn: str, key: Any, t0: float) -> None:
         """Flight-recorder marker for each jit trace+compile: the first
@@ -300,6 +307,7 @@ class PagedModelRunner:
             temp, top_k, top_p, seeds, counters,
         )
         self._note_compile("decode", len(tokens), t0)
+        self.prof.note("decode", self._decode, time.perf_counter() - t0)
         return out
 
     # -- speculative verification step -------------------------------------
@@ -385,6 +393,7 @@ class PagedModelRunner:
             temp, top_k, top_p, seeds, counters,
         )
         self._note_compile("verify", tuple(jnp.shape(tokens)), t0)
+        self.prof.note("verify", self._verify, time.perf_counter() - t0)
         return out
 
     # -- copy-on-write block fork (llm.prefix_cache) -----------------------
@@ -399,6 +408,7 @@ class PagedModelRunner:
         t0 = time.perf_counter()
         out = self._fork(k_pool, v_pool, src, dst)
         self._note_compile("fork", len(src), t0)
+        self.prof.note("fork", self._fork, time.perf_counter() - t0)
         return out
 
     # -- prefill chunk -----------------------------------------------------
@@ -464,4 +474,5 @@ class PagedModelRunner:
             jnp.int32(start), jnp.int32(n_valid), table, chunk=len(tokens),
         )
         self._note_compile("prefill", len(tokens), t0)
+        self.prof.note("prefill", self._prefill, time.perf_counter() - t0)
         return out

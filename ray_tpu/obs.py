@@ -39,6 +39,15 @@ Commands::
         The SLO burn-rate engine's state: every rule with FIRING/OK/
         RESOLVED status, current burn value, firing age, and labels.
 
+    python -m ray_tpu.obs waterfall --address HOST:PORT [--probe N]
+        Task-hop waterfall: the head's per-phase histograms (submit →
+        serialize → socket-write → head-dispatch → worker-deserialize →
+        exec → reply, plus total) folded from sampled tasks' stamp
+        lists, rendered as a p50/p95/p99 table.  ``--probe N`` first
+        drives N sync noop tasks under a traced context so a fresh
+        cluster has data (the CI waterfall-probe job does exactly this
+        and uploads the --json output).
+
     python -m ray_tpu.obs export -o otlp.json --address HOST:PORT
         OTLP-JSON export of spans, flight-recorder events, and metric
         series (resourceSpans/resourceLogs/resourceMetrics in one file);
@@ -168,8 +177,18 @@ def _render_top() -> None:
     req_rate = _series_rate_text(series, "serve_requests")
     if req_rate != "—":
         lines.append(f"serve: requests/s={req_rate}")
+    wf_line = _waterfall_top_line()
+    if wf_line:
+        lines.append(wf_line)
     if "llm_running_requests" in metrics:
         acc = gauge("llm_spec_acceptance_rate")
+        # runtime retrace count (device_prof): nonzero after warmup means
+        # a jit site is recompiling mid-traffic (RL014's runtime twin)
+        retraces = sum(
+            v
+            for v in metrics.get("device_retraces", {}).values()
+            if isinstance(v, (int, float))
+        )
         lines.append(
             "engine: "
             f"running={int(gauge('llm_running_requests', 0) or 0)} "
@@ -177,6 +196,11 @@ def _render_top() -> None:
             f"kv_util={float(gauge('llm_kv_block_utilization', 0.0) or 0.0):.2f} "
             f"tokens/step={gauge('llm_tokens_per_step', 0)} "
             + (f"accept_rate={acc:.2f} " if acc is not None else "")
+            + (
+                f"retraces={int(retraces)} "
+                if "device_retraces" in metrics
+                else ""
+            )
             + f"tokens/s={_series_rate_text(series, 'llm_generated_tokens')} "
             + f"req/s={_series_rate_text(series, 'llm_finished_requests')}"
         )
@@ -197,6 +221,49 @@ def _render_top() -> None:
             )
         )
     print("\n".join(lines), flush=True)
+
+
+def waterfall_top_row(summary: dict) -> str:
+    """The ``obs top`` waterfall row: per-hop ``p50/p99`` from the head's
+    phase histograms, honoring the below-2-samples contract — a hop that
+    has fewer than two folded samples renders ``—``, never a number
+    faked out of one observation."""
+    parts = []
+    for name, _i, _j in _wf_legs():
+        p = summary.get("legs", {}).get(name) or {}
+        if p.get("count", 0) < 2:
+            parts.append(f"{name}=—")
+        else:
+            parts.append(f"{name}={_fmt_us(p['p50'])}/{_fmt_us(p['p99'])}")
+    return "waterfall(p50/p99): " + " ".join(parts)
+
+
+def _wf_legs():
+    from ray_tpu.util.waterfall import LEGS
+
+    return LEGS
+
+
+def _fmt_us(seconds: float) -> str:
+    if seconds is None or (isinstance(seconds, float) and math.isnan(seconds)):
+        return "-"
+    if seconds >= 0.1:
+        return f"{seconds:.2f}s"
+    if seconds >= 0.001:
+        return f"{seconds * 1e3:.1f}ms"
+    return f"{seconds * 1e6:.0f}us"
+
+
+def _waterfall_top_line() -> Optional[str]:
+    try:
+        from ray_tpu._private.runtime import get_ctx
+
+        s = get_ctx().call("waterfall")
+    except Exception:
+        return None
+    if not s or not s.get("folded"):
+        return None
+    return waterfall_top_row(s)
 
 
 def _firing_alerts() -> list[dict]:
@@ -379,6 +446,69 @@ def cmd_export(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# waterfall: the task-plane phase breakdown (head-folded histograms)
+# ---------------------------------------------------------------------------
+
+
+def render_waterfall(summary: dict) -> str:
+    """The ``obs waterfall`` table: one row per phase with p50/p95/p99
+    and sample count (``—`` below 2 samples, same contract as top)."""
+    lines = [
+        f"task-hop waterfall: {summary.get('folded', 0)} folded, "
+        f"{summary.get('incomplete', 0)} incomplete",
+        f"{'PHASE':<20} {'N':>6}  {'P50':>9} {'P95':>9} {'P99':>9}",
+    ]
+    for name, _i, _j in _wf_legs():
+        p = summary.get("legs", {}).get(name) or {}
+        n = p.get("count", 0)
+        if n < 2:
+            lines.append(f"{name:<20} {n:>6}  {'—':>9} {'—':>9} {'—':>9}")
+            continue
+        lines.append(
+            f"{name:<20} {n:>6}  {_fmt_us(p['p50']):>9} "
+            f"{_fmt_us(p['p95']):>9} {_fmt_us(p['p99']):>9}"
+        )
+    return "\n".join(lines)
+
+
+def run_waterfall_probe(n: int) -> None:
+    """Drive ``n`` sync noop tasks under one traced (sampled) context so
+    the head folds a full waterfall per task — the burst ``obs waterfall
+    --probe`` and the CI waterfall-probe job measure.  Sync on purpose:
+    one submit→reply round trip per task is the per-task IPC cost the
+    100k-tasks/s work needs broken down, with no pipelining to blur it."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def _wf_probe_noop(i):
+        return i
+
+    with tracing.trace_context():
+        for i in range(n):
+            ray_tpu.get(_wf_probe_noop.remote(i))
+
+
+def cmd_waterfall(args) -> int:
+    from ray_tpu._private.runtime import get_ctx
+
+    ray_tpu = _attach(args.address)
+    try:
+        if args.probe:
+            run_waterfall_probe(args.probe)
+        s = get_ctx().call("waterfall", recent=args.recent)
+        if args.json:
+            print(json.dumps(s))
+        else:
+            print(render_waterfall(s))
+            for rec in s.get("recent", []):
+                print(json.dumps(rec))
+        return 0 if s.get("folded") else 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # overhead: self-measured emit-path costs (no cluster needed)
 # ---------------------------------------------------------------------------
 
@@ -444,6 +574,26 @@ def measure_overhead(n: int = 200_000) -> dict:
     out["gauge_set_ns"] = bench(lambda: g.set(1.0))
     h = um.Histogram("obs_overhead_hist", "obs overhead probe")
     out["histogram_observe_ns"] = bench(lambda: h.observe(0.5))
+
+    # task-hop waterfall emit paths (util.waterfall): the sampled path is
+    # one clock read + list append per stamp; the UNSAMPLED path — what
+    # every untraced task pays at submit — must cost no more than a
+    # disabled record() (one type check; tests/test_obs_hotpath.py pins
+    # the ratio)
+    from ray_tpu.util import device_prof as dp
+    from ray_tpu.util import waterfall as wfl
+
+    out["waterfall_stamp_ns"] = bench(lambda: wfl.stamp([0.0]))
+    out["waterfall_unsampled_ns"] = bench(lambda: wfl.maybe_start(None))
+
+    # device-step profiler emit path (cache-size probe + tagged observe);
+    # the probe target has no _cache_size, like any non-jit callable
+    prof = dp.JitProfiler(event="obs.overhead.retrace")
+
+    def _plain():
+        return None
+
+    out["device_prof_note_ns"] = bench(lambda: prof.note("probe", _plain, 1e-4))
     return {k: round(v, 1) if isinstance(v, float) else v for k, v in out.items()}
 
 
@@ -461,6 +611,9 @@ def cmd_overhead(args) -> int:
         ("Counter.inc()", res["counter_inc_ns"]),
         ("Gauge.set()", res["gauge_set_ns"]),
         ("Histogram.observe()", res["histogram_observe_ns"]),
+        ("waterfall stamp (sampled)", res["waterfall_stamp_ns"]),
+        ("waterfall check (unsampled)", res["waterfall_unsampled_ns"]),
+        ("step-profiler note()", res["device_prof_note_ns"]),
     ]
     for label, v in rows:
         print(f"  {label:<28} {v:>9.1f} ns")
@@ -726,6 +879,19 @@ def main(argv=None) -> int:
                    help="force one evaluation pass before reporting (headless/CI)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "waterfall",
+        help="task-hop phase breakdown (submit→…→reply p50/p95/p99 "
+        "from the head's folded histograms)",
+    )
+    p.add_argument("--probe", type=int, default=0,
+                   help="first drive N sync noop tasks under a traced "
+                   "context (fresh clusters have no folded data)")
+    p.add_argument("--recent", type=int, default=0,
+                   help="also print the newest N raw stamp records")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_waterfall)
 
     p = sub.add_parser(
         "overhead",

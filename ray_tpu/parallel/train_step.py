@@ -52,9 +52,16 @@ def shard_train_state(params, p_specs, optimizer, mesh) -> TrainState:
         optimizer.init,
         out_shardings=_opt_shardings(optimizer, params, p_specs, mesh),
     )(params)
-    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
 
-    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+    # the step counter is placed REPLICATED ON THE MESH like every other
+    # state leaf: a bare jnp.zeros(()) carries SingleDeviceSharding, which
+    # differs from the step output's NamedSharding — the jitted train step
+    # then silently RETRACED (full fwd+bwd recompile) on its second call
+    # (found by util.device_prof's retrace detector)
+    step0 = global_put(np.zeros((), np.int32), NamedSharding(mesh, P()))
+    return TrainState(params, opt_state, step0)
 
 
 def make_step_fn(loss_fn, optimizer, mesh):
@@ -73,6 +80,34 @@ def make_step_fn(loss_fn, optimizer, mesh):
         in_shardings=(None, NamedSharding(mesh, batch_spec())),
         donate_argnums=(0,),
     )
+
+
+def profile_step_fn(step_fn, site: str = "train_step"):
+    """Opt-in device-step profiling for a jitted train step: wall time
+    per call into ``device_step_seconds{site=train_step}`` and runtime
+    retrace detection (``train.retrace`` events + the ``device_retraces``
+    counter feeding the retrace-storm SLO — ``util.device_prof``).
+
+    A WRAPPER on purpose: ``make_step_fn``'s return stays a bare
+    ``jax.jit`` call so raylint's dataflow summaries keep resolving its
+    ``donate_argnums`` for use-after-donation analysis at call sites.
+    The wrapped callable exposes ``.profiler`` (per-site stats) and
+    ``.__wrapped__`` (the raw jitted step)."""
+    import time
+
+    from ray_tpu.util.device_prof import JitProfiler
+
+    prof = JitProfiler(event="train.retrace")
+
+    def profiled(state, batch):
+        t0 = time.perf_counter()
+        out = step_fn(state, batch)
+        prof.note(site, step_fn, time.perf_counter() - t0)
+        return out
+
+    profiled.profiler = prof
+    profiled.__wrapped__ = step_fn
+    return profiled
 
 
 def build_train_step(

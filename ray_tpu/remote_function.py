@@ -16,6 +16,7 @@ from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.runtime import get_ctx
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.util import waterfall as _waterfall
 
 
 class RemoteFunction:
@@ -82,7 +83,22 @@ class RemoteFunction:
             tpl = RemoteFunction(self._fn, options)._template()
         num_returns = tpl["num_returns"]
         streaming = num_returns == "streaming"
+        # trace-context propagation (util.tracing): a submission under an
+        # active context ships it BY REFERENCE (sampled dict or shared
+        # unsampled token — the token keeps request-id forensics intact
+        # downstream while spans stay free); with no context at all the
+        # executing worker roots a lazy trace at the task's own id, so
+        # every task tree stays traceable without the submitter paying a
+        # per-task id mint
+        tctx = _tracing.get_trace_context()
+        sp_ctx = _tracing.context_for_spec(tctx) if tctx is not None else None
+        # task-hop waterfall (util.waterfall): SAMPLED request/reply tasks
+        # carry phase stamps; everything else ships nothing and pays one
+        # type check (streaming tasks reply long after exec — no waterfall)
+        wf = None if streaming else _waterfall.maybe_start(sp_ctx)
         s_args, s_kwargs = ctx.serialize_args(args, kwargs)
+        if wf is not None:
+            _waterfall.stamp(wf)  # serialize: args done, spec build next
         task_id, return_ids = ctx.new_task_returns(
             1 if streaming else max(num_returns, 1)
         )
@@ -94,18 +110,10 @@ class RemoteFunction:
             "kwargs": s_kwargs,
             "return_ids": return_ids,
         }
-        # trace-context propagation (util.tracing): a submission under an
-        # active context ships it BY REFERENCE (sampled dict or shared
-        # unsampled token — the token keeps request-id forensics intact
-        # downstream while spans stay free); with no context at all the
-        # executing worker roots a lazy trace at the task's own id, so
-        # every task tree stays traceable without the submitter paying a
-        # per-task id mint
-        tctx = _tracing.get_trace_context()
-        if tctx is not None:
-            sp_ctx = _tracing.context_for_spec(tctx)
-            if sp_ctx is not None:
-                spec["trace_ctx"] = sp_ctx
+        if sp_ctx is not None:
+            spec["trace_ctx"] = sp_ctx
+        if wf is not None:
+            spec["wf"] = wf
         ns = getattr(ctx, "namespace", "default")
         if ns != "default":
             # tasks inherit the submitter's namespace (reference: job-scoped

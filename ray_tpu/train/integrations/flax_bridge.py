@@ -90,6 +90,7 @@ def build_flax_train_step(
     from ray_tpu.parallel.train_step import (
         TrainState,
         make_step_fn,
+        profile_step_fn,
         shard_train_state,
     )
 
@@ -110,4 +111,7 @@ def build_flax_train_step(
         # only the sharding-rule source differs
         return shard_train_state(params, p_specs, optimizer, mesh)
 
-    return init_fn, make_step_fn(model_loss, optimizer, mesh)
+    # profiled: per-step wall time + runtime retrace detection ride the
+    # train plane's metrics (device_step_seconds{site=train_step}); the
+    # raw jitted step stays reachable via step_fn.__wrapped__
+    return init_fn, profile_step_fn(make_step_fn(model_loss, optimizer, mesh))

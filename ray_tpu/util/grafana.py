@@ -93,6 +93,54 @@ def _prefix_panels() -> list:
     ]
 
 
+def _profiling_panels() -> list:
+    """Continuous-profiling row, DERIVED from the profiling-plane metric
+    families (``util.waterfall.METRIC_NAMES``, ``util.device_prof
+    .METRIC_NAMES`` and the engine's ``llm_hbm_*`` ledger gauges — tests
+    cross-check this row against those registries): task-hop waterfall
+    percentiles per phase, device-step time per jit site, runtime
+    retraces, and the HBM ledger the tiered-KV spill decision reads."""
+    return [
+        ("Task-hop p99 by phase",
+         'histogram_quantile(0.99, rate(ray_tpu_core_task_phase_s_bucket{{phase=~".+"}}[5m]))',
+         "s",
+         "Per-hop task-plane latency (submit/serialize/socket_write/"
+         "head_dispatch/worker_deserialize/exec/reply/total) folded on "
+         "the head from sampled tasks' waterfall stamps."),
+        ("Waterfalls folded/s",
+         "rate(ray_tpu_core_task_waterfalls[1m])", "short",
+         "Complete 8-stamp records folded per second (sampled tasks "
+         "only; core_task_waterfall_incomplete counts partial replies)."),
+        ("Device step p99 by site",
+         'histogram_quantile(0.99, rate(ray_tpu_device_step_seconds_bucket{{site=~".+"}}[5m]))',
+         "s",
+         "Wall time per jitted entry-point call (decode/prefill/verify/"
+         "fork/train_step), compiles included."),
+        ("Jit retraces/s",
+         'rate(ray_tpu_device_retraces[5m])', "short",
+         "Sites recompiling AFTER warmup (RL014's runtime twin) — any "
+         "sustained rate fires the retrace-storm SLO rule."),
+        # one panel per ledger gauge — all five series are untagged, so a
+        # PromQL `a or b` would collapse to `a` (same pitfall the
+        # running/waiting panels document above)
+        ("HBM params bytes", "ray_tpu_llm_hbm_params_bytes", "bytes",
+         "Device bytes held by model params."),
+        ("HBM seq-owned KV bytes", "ray_tpu_llm_hbm_kv_seq_bytes", "bytes",
+         "KV blocks owned by ≥1 live sequence × block bytes."),
+        ("HBM cache-resident KV bytes", "ray_tpu_llm_hbm_kv_cache_bytes",
+         "bytes",
+         "Prefix-cache-ONLY residents — what a host-RAM tier would "
+         "reclaim (the tiered-KV spill signal)."),
+        ("HBM free KV bytes", "ray_tpu_llm_hbm_kv_free_bytes", "bytes",
+         "Free-list blocks × block bytes."),
+        ("HBM drafter bytes", "ray_tpu_llm_hbm_drafter_bytes", "bytes",
+         "Speculative drafter params (0 for the n-gram drafter)."),
+        ("KV pool footprint", "ray_tpu_llm_hbm_kv_pool_bytes", "bytes",
+         "Total device bytes of the paged-KV pool arrays (fixed at "
+         "engine start)."),
+    ]
+
+
 def _slo_panels() -> list:
     """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
     panels interpolate the same threshold/objective/window the head's alert
@@ -147,6 +195,11 @@ _LLM_NAMES = {
     "llm_prefix_cache_hit_tokens", "llm_prefix_cache_miss_tokens",
     "llm_prefix_cache_evicted_blocks", "llm_prefix_cache_hit_rate",
     "llm_prefix_cache_blocks", "llm_prefill_tokens",
+    # profiling row (core_task_* skips via the core_ prefix)
+    "device_step_seconds", "device_retraces",
+    "llm_hbm_params_bytes", "llm_hbm_kv_pool_bytes", "llm_hbm_kv_seq_bytes",
+    "llm_hbm_kv_cache_bytes", "llm_hbm_kv_free_bytes",
+    "llm_hbm_drafter_bytes",
 }
 
 
@@ -196,7 +249,8 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     y = 0
     pid = 0
     for title, expr, unit, desc in (_CORE_PANELS + _LLM_PANELS
-                                    + _prefix_panels() + _slo_panels()):
+                                    + _prefix_panels() + _profiling_panels()
+                                    + _slo_panels()):
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:

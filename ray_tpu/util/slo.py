@@ -262,6 +262,28 @@ def default_rules() -> list[SLORule]:
                         "rollout plane is falling behind the sync push.",
         ),
         SLORule(
+            name="retrace-storm",
+            metric="device_retraces",
+            kind="counter_burn",
+            # EVERY retrace is a bad event (bad_tags None selects all
+            # series): a jit site recompiling after its warmup baseline
+            # (util.device_prof — RL014's runtime twin) pays a full
+            # XLA compile mid-traffic, so any nonzero window rate burns
+            # the whole budget and fires while the storm is live; zero
+            # retraces is the steady state and evaluates as no-evidence
+            objective=_envf("RAY_TPU_SLO_RETRACE_OBJECTIVE", 0.99),
+            fast_window_s=fast,
+            slow_window_s=slow,
+            fast_burn=_envf("RAY_TPU_SLO_FAST_BURN", 14.4),
+            slow_burn=_envf("RAY_TPU_SLO_SLOW_BURN", 6.0),
+            resolve_after_s=resolve,
+            labels={"severity": "warn"},
+            description="A jitted entry point (decode/prefill/verify/"
+                        "fork/train step) is RECOMPILING after warmup — "
+                        "static shapes are broken somewhere; each retrace "
+                        "stalls every request in the batch for a compile.",
+        ),
+        SLORule(
             name="engine-stall",
             metric="llm_watchdog_step_age_s",
             kind="gauge_threshold",

@@ -486,13 +486,24 @@ def export_chrome_trace(path: Optional[str] = None) -> list[dict]:
     trace (reference: ``ray timeline``, ``_private/state.py:924``). Every
     span/flight-recorder event carrying a request_id additionally lands in
     a ``requests``-group lane keyed by its id, so one request's whole life
-    reads as a single row in chrome://tracing / Perfetto."""
+    reads as a single row in chrome://tracing / Perfetto. Sampled tasks'
+    folded waterfall records (util.waterfall) render as NESTED slices —
+    a total-duration slice with the seven hop legs inside it — on a
+    ``waterfall`` process group."""
     from ray_tpu._private import events as ev
     from ray_tpu.util import state as st
 
     spans = st.timeline() + collect_cluster_spans()
     recorder = ev.collect_cluster_events()
     events = spans + request_lanes(spans, recorder)
+    try:
+        from ray_tpu._private.runtime import get_ctx
+        from ray_tpu.util import waterfall as _wf
+
+        recent = get_ctx().call("waterfall", recent=_wf._RECENT_CAP)
+        events += _wf.chrome_slices(recent.get("recent", []))
+    except Exception:
+        pass  # head without the waterfall rpc / no folded records
     if path:
         with open(path, "w") as f:
             json.dump(events, f)

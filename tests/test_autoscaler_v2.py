@@ -118,6 +118,10 @@ def test_retry_budget_exhaustion(ray_start_regular):
     assert insts, "instance never gave up"
 
 
+# tier-1 budget (ISSUE 13): 10.5s measured on the dev box (real idle
+# timers have to elapse); the remaining v2 suite keeps scale-up/down
+# policy coverage in tier-1
+@pytest.mark.slow
 def test_idle_scale_down_respects_min_workers(ray_start_regular):
     from ray_tpu._private.runtime import get_ctx
 

@@ -88,6 +88,11 @@ def test_gpt_loss_fused_matches_naive():
     )
 
 
+# tier-1 budget (ISSUE 13): ~34s across the matrix on the dev box (9.5 +
+# 7.9 + 6.4 + 5.3 + 5.1s for the five heaviest params); grad-level remat
+# parity is value-independent of wall clock and the fused-vs-naive loss
+# parity tests below keep fused-CE correctness in tier-1
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "policy,attn_impl,seq",
     [

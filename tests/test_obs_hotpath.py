@@ -57,6 +57,13 @@ HOT_PATHS = (
     ("ray_tpu/util/metrics.py", "ray_tpu.util.metrics", "Gauge.set"),
     ("ray_tpu/util/metrics.py", "ray_tpu.util.metrics", "Histogram.observe"),
     ("ray_tpu/util/tracing.py", "ray_tpu.util.tracing", "span"),
+    # profiling plane (ISSUE 13): waterfall stamps ride every sampled
+    # submit/dispatch/exec hop, and the step-profiler note() runs per
+    # jitted call — all must stay lock-free like the paths above
+    ("ray_tpu/util/waterfall.py", "ray_tpu.util.waterfall", "maybe_start"),
+    ("ray_tpu/util/waterfall.py", "ray_tpu.util.waterfall", "stamp"),
+    ("ray_tpu/util/device_prof.py", "ray_tpu.util.device_prof",
+     "JitProfiler.note"),
 )
 
 
@@ -302,6 +309,40 @@ def test_unsampled_context_records_nothing(monkeypatch):
         with tracing.span("visible"):
             pass
     assert any(s["name"] == "visible" for s in tracing.get_spans())
+
+
+def test_waterfall_unsampled_path_costs_like_disabled_record():
+    """The satellite pin: an UNSAMPLED task's waterfall cost (one type
+    check in maybe_start) must stay in the same class as a disabled
+    record() — the cheapest thing the telemetry plane knows how to do.
+    Generous multiplier: this box's timing noise is ±30%, the contract
+    is about orders of magnitude (a lock or an allocation creeping into
+    the unsampled path shows up as 10-100x, not 3x)."""
+    from ray_tpu.obs import measure_overhead
+
+    res = measure_overhead(n=30_000)
+    budget = max(res["event_record_disabled_ns"] * 5, 1_000.0)
+    assert res["waterfall_unsampled_ns"] <= budget, res
+    # sampled stamps are clock+append — same class as a counter inc
+    assert res["waterfall_stamp_ns"] <= max(
+        res["counter_inc_ns"] * 10, 5_000.0
+    ), res
+    # the step profiler emits a tagged observe + a cache-size read per
+    # jitted call (ms-scale steps): must stay well under 100us
+    assert res["device_prof_note_ns"] <= 100_000.0, res
+
+
+def test_waterfall_maybe_start_only_stamps_sampled_dicts():
+    from ray_tpu.util import waterfall as wfl
+
+    assert wfl.maybe_start(None) is None
+    assert wfl.maybe_start(tracing.UnsampledContext("ab")) is None
+    lazy = tracing.LazyTaskContext(b"\x01" * 16)
+    assert wfl.maybe_start(lazy) is None  # rootless ships nothing
+    wf = wfl.maybe_start({"request_id": "ab"})
+    assert isinstance(wf, list) and len(wf) == 1
+    wfl.stamp(wf)
+    assert len(wf) == 2 and wf[1] >= wf[0]
 
 
 def test_lazy_task_context_materializes_on_demand():

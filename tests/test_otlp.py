@@ -146,6 +146,11 @@ class TestObsExportCli:
         assert recs[0]["body"]["stringValue"] == "crash.sigterm"
         assert doc["resourceSpans"] == [] and doc["resourceMetrics"] == []
 
+    # tier-1 budget (ISSUE 13): 12.3s measured on the dev box (boots a
+    # full cluster just for the export); the offline-export tests above
+    # pin the same field names, and CI's postmortem steps run the live
+    # CLI on every failure artifact anyway
+    @pytest.mark.slow
     def test_live_export_has_spans_events_and_series(self, tmp_path):
         """The acceptance shape: a live cluster with engine-style metrics,
         spans, and events exports ≥3 metric series plus spans and events,

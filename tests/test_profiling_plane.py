@@ -1,0 +1,552 @@
+"""Continuous profiling plane (ISSUE 13): task-hop waterfalls, the
+device-step/retrace profiler, and the HBM ledger.
+
+* **Waterfall**: sampled tasks carry 7 phase stamps through spec + reply
+  and the head folds reply_recv into per-phase histograms — ordering and
+  monotonicity pinned across real task, actor, and nested hops; an
+  UNSAMPLED context ships no stamps while its request id still reaches
+  the head's task events (the zero-cost contract's forensic half).
+* **Retrace detector**: a deliberately shape-varying jit call fires
+  exactly once per NEW trace (``util.device_prof`` — RL014's runtime
+  twin); a steady-state engine run fires zero; a storm trips the
+  ``retrace-storm`` SLO rule through the live alerts engine.
+* **HBM ledger**: the engine's byte gauges are conservation-checked
+  against ``KVBlockPool.audit()`` block counts × block bytes.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu.util import tracing
+from ray_tpu.util import waterfall as wfl
+from ray_tpu.util.device_prof import JitProfiler
+
+
+@pytest.fixture
+def fresh_waterfall():
+    wfl.clear()
+    yield
+    wfl.clear()
+
+
+def _fold_total() -> int:
+    return wfl.summary()["folded"]
+
+
+# ---------------------------------------------------------------------------
+# waterfall: stamping + folding across real hops
+# ---------------------------------------------------------------------------
+
+
+class TestWaterfall:
+    def test_task_actor_nested_hops_fold_monotone(self, fresh_waterfall):
+        # the per-leg histogram is process-lifetime (like every metric):
+        # earlier tests in one pytest process may have folded sampled
+        # tasks of their own, so every count assertion is a DELTA
+        base = {
+            name: wfl.summary()["legs"][name]["count"]
+            for name, _i, _j in wfl.LEGS
+        }
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            from ray_tpu._private.runtime import get_ctx
+
+            @ray_tpu.remote
+            def leaf(x):
+                return x + 1
+
+            @ray_tpu.remote
+            def parent(x):
+                # nested hop: the worker's (sampled) context re-ships and
+                # the nested spec earns its own stamp list
+                return ray_tpu.get(leaf.remote(x)) + 10
+
+            @ray_tpu.remote
+            class Act:
+                def m(self, x):
+                    return x * 2
+
+            with tracing.trace_context() as rid:
+                for i in range(5):
+                    assert ray_tpu.get(leaf.remote(i)) == i + 1
+                assert ray_tpu.get(parent.remote(1)) == 12
+                a = Act.remote()
+                assert ray_tpu.get(a.m.remote(3)) == 6
+            s = get_ctx().call("waterfall", recent=64)
+            # 5 leaves + parent + nested leaf + actor method = 8 folds
+            assert s["folded"] == 8
+            assert s["incomplete"] == 0
+            for name, _i, _j in wfl.LEGS:
+                assert s["legs"][name]["count"] - base[name] == 8, name
+            names = set()
+            for rec in s["recent"]:
+                stamps = rec["stamps"]
+                assert len(stamps) == len(wfl.PHASES)
+                assert stamps == sorted(stamps), (
+                    f"non-monotone stamps for {rec.get('name')}: {stamps}"
+                )
+                assert rec["request_id"] == rid
+                assert all(v >= 0 for v in rec["legs"].values())
+                names.add(rec.get("name"))
+            assert "Act.m" in names  # the actor hop folded
+            assert any(n and "leaf" in n for n in names)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_unsampled_ships_no_stamps_but_keeps_ids(
+        self, fresh_waterfall, monkeypatch
+    ):
+        monkeypatch.setenv("RAY_TPU_TRACE_SAMPLE", "0")
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        try:
+            from ray_tpu._private.runtime import get_ctx
+            from ray_tpu.util import state as st
+
+            @ray_tpu.remote
+            def f(x):
+                return x
+
+            before = get_ctx().call("waterfall")["folded"]
+            with tracing.trace_context() as rid:
+                assert ray_tpu.get(f.remote(1)) == 1
+            # rootless too: no context at all
+            assert ray_tpu.get(f.remote(2)) == 2
+            s = get_ctx().call("waterfall")
+            assert s["folded"] == before  # nothing stamped, nothing folded
+            assert s["incomplete"] == 0
+            # the request id still reaches the head's task events (the
+            # unsampled token rides the spec; forensics stay correlated)
+            rids = {t.get("request_id") for t in st.get_task_events()}
+            assert rid in rids
+        finally:
+            ray_tpu.shutdown()
+
+    def test_error_and_retry_replies_count_incomplete(self, fresh_waterfall):
+        """A task that raises never stamps exec_end: the head counts the
+        partial list instead of folding a bogus record."""
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        try:
+            from ray_tpu._private.runtime import get_ctx
+
+            @ray_tpu.remote
+            def boom():
+                raise ValueError("x")
+
+            with tracing.trace_context():
+                with pytest.raises(ValueError):
+                    ray_tpu.get(boom.remote())
+            s = get_ctx().call("waterfall")
+            assert s["folded"] == 0
+            assert s["incomplete"] >= 1
+        finally:
+            ray_tpu.shutdown()
+
+    def test_fold_unit_legs_and_clamp(self, fresh_waterfall):
+        t0 = 1000.0
+        stamps = [t0 + i * 0.001 for i in range(7)]
+        assert wfl.fold(list(stamps), {"name": "t", "kind": "task"})
+        s = wfl.summary(recent=1)
+        rec = s["recent"][0]
+        assert len(rec["stamps"]) == 8
+        for name, i, j in wfl.LEGS:
+            if name != "total" and j < 7:
+                assert rec["legs"][name] == pytest.approx(0.001)
+        # short/partial lists refuse to fold
+        assert not wfl.fold(list(stamps[:5]))
+        assert s["folded"] == 1
+        # a wall-clock step backwards clamps to zero, never negative
+        bad = [t0, t0 - 5.0] + [t0 + i for i in range(1, 6)]
+        assert wfl.fold(bad)
+        rec2 = wfl.summary(recent=1)["recent"][-1]
+        assert rec2["legs"]["submit"] == 0.0
+
+    def test_chrome_slices_nest_legs_inside_total(self, fresh_waterfall):
+        stamps = [1000.0 + i * 0.01 for i in range(7)]
+        wfl.fold(list(stamps), {
+            "name": "noop", "kind": "task", "trace_ctx": {"request_id": "ab"},
+        })
+        slices = wfl.chrome_slices(wfl.summary(recent=4)["recent"])
+        assert len(slices) == 1 + (len(wfl.LEGS) - 1)
+        total = slices[0]
+        assert total["pid"] == "waterfall" and total["tid"] == "req:ab"
+        for leg in slices[1:]:
+            assert leg["ts"] >= total["ts"]
+            # 1µs slack: ts*1e6 sits near 1e15 where float ulp ≈ 0.25µs
+            assert leg["ts"] + leg["dur"] <= total["ts"] + total["dur"] + 1.0
+
+
+class TestWaterfallCLI:
+    def test_obs_waterfall_probe_reports_8_phases(self, fresh_waterfall, capsys):
+        from ray_tpu.obs import main as obs_main
+
+        rc = obs_main(["waterfall", "--probe", "25", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        s = json.loads(out)
+        assert s["folded"] >= 25
+        assert len(s["phases"]) == 8
+        legs = s["legs"]
+        assert len(legs) == 8  # 7 hop legs + total
+        for name, _i, _j in wfl.LEGS:
+            assert legs[name]["count"] >= 25
+            assert legs[name]["p50"] >= 0.0
+            assert legs[name]["p99"] >= legs[name]["p50"] - 1e-9
+
+    def test_top_row_dash_below_two_samples(self):
+        from ray_tpu.obs import waterfall_top_row
+
+        row = waterfall_top_row({"legs": {"submit": {"count": 1}}})
+        # every leg below 2 samples renders the dash, never a number
+        assert row.count("—") == len(wfl.LEGS)
+        row2 = waterfall_top_row({
+            "legs": {
+                name: {"count": 5, "p50": 1e-4, "p99": 2e-3}
+                for name, _i, _j in wfl.LEGS
+            }
+        })
+        assert "—" not in row2
+        assert "submit=100us/2.0ms" in row2
+
+    def test_render_waterfall_table(self):
+        from ray_tpu.obs import render_waterfall
+
+        s = {
+            "folded": 3, "incomplete": 1,
+            "legs": {
+                name: {"count": 3, "p50": 1e-4, "p95": 1e-3, "p99": 2e-3}
+                for name, _i, _j in wfl.LEGS
+            },
+        }
+        txt = render_waterfall(s)
+        for name, _i, _j in wfl.LEGS:
+            assert name in txt
+        assert "3 folded" in txt
+
+
+# ---------------------------------------------------------------------------
+# device-step profiler: retrace goldens
+# ---------------------------------------------------------------------------
+
+
+class TestRetraceDetector:
+    def test_shape_varying_jit_fires_once_per_new_trace(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu._private import events
+
+        events.set_enabled(True)
+        fn = jax.jit(lambda x: x * 2)
+        prof = JitProfiler(event="llm.retrace")
+        before = [
+            e for e in events.snapshot() if e["type"] == "llm.retrace"
+        ]
+
+        def call(n):
+            t0 = time.perf_counter()
+            out = fn(jnp.ones(n))
+            return prof.note("probe_site", fn, time.perf_counter() - t0)
+
+        assert call(4) is False      # warmup: sets the baseline
+        assert call(4) is False      # cached: no retrace
+        assert call(8) is True       # NEW trace after warmup: fires
+        assert call(8) is False      # that shape is warm now
+        assert call(16) is True      # each new trace fires exactly once
+        st = prof.stats()["probe_site"]
+        assert st["retraces"] == 2
+        assert st["calls"] == 5
+        evs = [
+            e for e in events.snapshot()
+            if e["type"] == "llm.retrace" and e.get("site") == "probe_site"
+        ]
+        assert len(evs) - len([e for e in before if e.get("site") == "probe_site"]) == 2
+
+    def test_plain_callable_never_fires(self):
+        prof = JitProfiler()
+
+        def plain():
+            return None
+
+        for _ in range(5):
+            assert prof.note("plain", plain, 1e-4) is False
+        assert prof.retraces == 0
+
+    def test_engine_steady_state_zero_retraces(self):
+        import jax
+
+        from ray_tpu.llm.engine import EngineConfig, LLMEngine
+        from ray_tpu.llm.scheduler import SamplingParams
+        from ray_tpu.models.gpt import GPTConfig, gpt_init
+
+        cfg = GPTConfig(vocab_size=64, seq_len=64, d_model=32, n_layers=2, n_heads=2)
+        params = gpt_init(jax.random.PRNGKey(0), cfg)
+        eng = LLMEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, num_blocks=16, block_size=8,
+                         max_blocks_per_seq=8, spec_k=2),
+        )
+        eng.warmup()
+        for prompt in ([1, 2, 3], [4, 5, 6, 7], [1, 2, 3]):
+            eng.generate(prompt, SamplingParams(max_tokens=6))
+        assert eng.runner.prof.retraces == 0, eng.runner.prof.stats()
+        assert eng.stats()["retraces"] == 0
+
+    def test_profiled_train_step_counts_and_detects(self):
+        import jax
+        import optax
+
+        from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+        from ray_tpu.parallel.train_step import (
+            build_train_step,
+            profile_step_fn,
+        )
+
+        mesh = make_mesh(MeshConfig(dp=-1, fsdp=1, tp=1))
+        init_fn, raw_step = build_train_step(
+            lambda p, b: ((p["w"] * b) ** 2).mean(), optax.sgd(0.1), mesh
+        )
+        step = profile_step_fn(raw_step)
+        assert step.__wrapped__ is raw_step
+        state = init_fn({"w": np.ones(8, np.float32)})
+        batch = np.ones((8, 8), np.float32)
+        for _ in range(3):
+            state, _loss = step(state, batch)
+        st = step.profiler.stats()["train_step"]
+        assert st["calls"] == 3
+        assert st["retraces"] == 0
+
+
+class TestRetraceSLO:
+    def test_rule_golden_fires_on_any_retrace_window(self):
+        from ray_tpu.util import slo
+
+        rule = next(
+            r for r in slo.default_rules() if r.name == "retrace-storm"
+        )
+        assert rule.metric == "device_retraces"
+        now = 1000.0
+        merged = {
+            "device_retraces": {
+                "kind": "counter",
+                "series": {
+                    '{"site":"decode"}': [(now - 90, 0.0), (now - 30, 3.0)]
+                },
+            }
+        }
+        res = slo.evaluate_rule(rule, merged, now=now)
+        assert res["breached"], res
+        # zero retraces = no evidence, never a breach
+        quiet = {
+            "device_retraces": {
+                "kind": "counter",
+                "series": {'{"site":"decode"}': [(now - 90, 3.0), (now - 30, 3.0)]},
+            }
+        }
+        assert not slo.evaluate_rule(rule, quiet, now=now)["breached"]
+        assert not slo.evaluate_rule(rule, {}, now=now)["breached"]
+
+    def test_retrace_trips_live_alerts_engine(self, monkeypatch):
+        """The acceptance path: a site recompiling after warmup →
+        device_retraces increments → series ship → the retrace-storm rule
+        FIRES through the same alerts surface ``obs alerts --eval-once``
+        reads."""
+        import jax
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("RAY_TPU_ALERTS_INTERVAL_S", "3600")  # manual ticks
+        um._reset_series_for_tests()
+        ray_tpu.init(num_cpus=1, num_tpus=0)
+        try:
+            from ray_tpu._private.runtime import get_ctx
+
+            ctx = get_ctx()
+            # baseline sample so the window has a point to diff against
+            prof = JitProfiler(event="llm.retrace")
+            fn = jax.jit(lambda x: x + 1)
+
+            def call(n):
+                t0 = time.perf_counter()
+                fn(jnp.ones(n))
+                prof.note("slo_probe", fn, time.perf_counter() - t0)
+
+            call(2)  # warmup/baseline
+            um.sample_series_now()
+            um.flush()
+            alerts = ctx.call("alerts", eval_now=True)
+            by_rule = {a["rule"]: a for a in alerts}
+            assert by_rule["retrace-storm"]["status"] != "FIRING"
+            for n in (3, 4, 5):  # the storm
+                call(n)
+            assert prof.stats()["slo_probe"]["retraces"] == 3
+            um.sample_series_now()
+            um.flush()
+            alerts = ctx.call("alerts", eval_now=True)
+            by_rule = {a["rule"]: a for a in alerts}
+            assert by_rule["retrace-storm"]["status"] == "FIRING", by_rule[
+                "retrace-storm"
+            ]
+        finally:
+            ray_tpu.shutdown()
+            um._reset_series_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger conservation
+# ---------------------------------------------------------------------------
+
+
+class TestHBMLedger:
+    def _engine(self, **kw):
+        import jax
+
+        from ray_tpu.llm.engine import EngineConfig, LLMEngine
+        from ray_tpu.models.gpt import GPTConfig, gpt_init
+
+        cfg = GPTConfig(vocab_size=64, seq_len=128, d_model=32, n_layers=2, n_heads=2)
+        params = gpt_init(jax.random.PRNGKey(0), cfg)
+        eng_cfg = EngineConfig(
+            max_slots=2, num_blocks=24, block_size=8, max_blocks_per_seq=16, **kw
+        )
+        return LLMEngine(cfg, params, eng_cfg), params
+
+    def test_conservation_against_pool_audit(self):
+        import jax
+
+        from ray_tpu.llm.scheduler import SamplingParams
+
+        eng, params = self._engine()
+        eng.warmup()
+        # long shared prompts so full prompt blocks become cache-resident
+        base = list(range(1, 25))
+        eng.generate(base + [30], SamplingParams(max_tokens=4))
+        eng.generate(base + [31], SamplingParams(max_tokens=4))
+        bb = eng.pool.block_bytes
+        usable = eng.pool.cfg.num_blocks - 1
+
+        def check(led, aud):
+            # the ledger IS the audit's partition, in bytes
+            assert led["seq_bytes"] == aud["owned"] * bb
+            assert led["cache_bytes"] == aud["cached_only"] * bb
+            assert led["free_bytes"] == aud["free"] * bb
+            assert (
+                led["seq_bytes"] + led["cache_bytes"] + led["free_bytes"]
+                == usable * bb
+            )
+
+        led = eng.hbm_ledger()
+        aud = eng.pool.audit()
+        assert aud["ok"], aud
+        check(led, aud)
+        # both requests finished: their prompt blocks stay resident ONLY
+        # for the prefix tree (the reclaimable tier the spill signal reads)
+        assert led["cache_bytes"] > 0
+        # one still-running request: it MATCHES the cached prefix, so the
+        # shared blocks move from cache-only into seq-owned while the
+        # partition stays exact
+        req = eng.submit(base + [32], SamplingParams(max_tokens=64))
+        for _ in range(8):
+            eng.step()
+        assert not req.finished
+        led = eng.hbm_ledger()
+        aud = eng.pool.audit()
+        assert aud["ok"], aud
+        check(led, aud)
+        assert led["seq_bytes"] > 0
+        # params accounting matches the real device arrays
+        assert led["params_bytes"] == sum(
+            int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(params)
+        )
+        assert led["pool_bytes"] == eng.pool.k.nbytes + eng.pool.v.nbytes
+        assert led["pool_bytes"] == eng.pool.cfg.num_blocks * bb
+        req.cancelled.set()
+        while not req.finished:
+            eng.step()
+
+    def test_gauges_published_through_metrics(self):
+        from ray_tpu.llm.scheduler import SamplingParams
+
+        eng, _params = self._engine()
+        eng.warmup()
+        eng.generate([1, 2, 3], SamplingParams(max_tokens=2))
+        led = eng.hbm_ledger()
+        # local registry snapshot (no cluster needed): gauges are
+        # last-write-wins, so the values are THIS engine's newest publish
+        data = {
+            m.name: m._snapshot()["data"]
+            for m in um._registry
+            if m.name.startswith("llm_hbm_")
+        }
+        for metric, key in (
+            ("llm_hbm_params_bytes", "params_bytes"),
+            ("llm_hbm_kv_pool_bytes", "pool_bytes"),
+            ("llm_hbm_kv_seq_bytes", "seq_bytes"),
+            ("llm_hbm_kv_cache_bytes", "cache_bytes"),
+            ("llm_hbm_kv_free_bytes", "free_bytes"),
+            ("llm_hbm_drafter_bytes", "drafter_bytes"),
+        ):
+            vals = list(data.get(metric, {}).values())
+            assert vals, f"{metric} never published"
+            assert vals[0] == led[key], (metric, vals, led)
+
+    def test_drafter_bytes_counted_for_model_drafter(self):
+        import jax
+
+        from ray_tpu.llm.engine import EngineConfig, LLMEngine
+        from ray_tpu.models.gpt import GPTConfig, gpt_init
+
+        cfg = GPTConfig(vocab_size=64, seq_len=64, d_model=32, n_layers=2, n_heads=2)
+        params = gpt_init(jax.random.PRNGKey(0), cfg)
+        dcfg = GPTConfig(vocab_size=64, seq_len=32, d_model=16, n_layers=1, n_heads=2)
+        dparams = gpt_init(jax.random.PRNGKey(1), dcfg)
+        eng = LLMEngine(
+            cfg, params,
+            EngineConfig(max_slots=2, num_blocks=16, block_size=8,
+                         max_blocks_per_seq=8, spec_k=2, spec_drafter="model"),
+            draft_model_cfg=dcfg, draft_params=dparams,
+        )
+        expect = sum(
+            int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(dparams)
+        )
+        assert eng.hbm_ledger()["drafter_bytes"] == expect
+        # the n-gram drafter holds no device state
+        eng2, _ = self._engine(spec_k=2)
+        assert eng2.hbm_ledger()["drafter_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# registries: the profiling plane stays RL012-clean by construction
+# ---------------------------------------------------------------------------
+
+
+class TestRegistries:
+    def test_grafana_profiling_row_tracks_registries(self):
+        from ray_tpu.util import device_prof
+        from ray_tpu.util.grafana import _profiling_panels
+
+        exprs = " ".join(expr for _t, expr, _u, _d in _profiling_panels())
+        for name in wfl.METRIC_NAMES[:1] + device_prof.METRIC_NAMES:
+            assert name in exprs, f"profiling row lost {name}"
+        for name in (
+            "llm_hbm_params_bytes", "llm_hbm_kv_seq_bytes",
+            "llm_hbm_kv_cache_bytes", "llm_hbm_kv_free_bytes",
+            "llm_hbm_drafter_bytes", "llm_hbm_kv_pool_bytes",
+        ):
+            assert name in exprs, f"profiling row lost {name}"
+
+    def test_metric_names_registered(self):
+        from ray_tpu.llm import engine as eng_mod
+        from ray_tpu.util import device_prof
+
+        assert "core_task_phase_s" in wfl.METRIC_NAMES
+        assert "device_retraces" in device_prof.METRIC_NAMES
+        for n in (
+            "llm_hbm_params_bytes", "llm_hbm_kv_pool_bytes",
+            "llm_hbm_kv_seq_bytes", "llm_hbm_kv_cache_bytes",
+            "llm_hbm_kv_free_bytes", "llm_hbm_drafter_bytes",
+        ):
+            assert n in eng_mod.METRIC_NAMES

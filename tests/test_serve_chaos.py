@@ -113,6 +113,10 @@ def _kill_active_replica(controller, deadline_s=15.0) -> int:
     raise AssertionError("no replica was actively generating")
 
 
+# tier-1 budget (ISSUE 13, tier1-durations on the dev box): 17.8s greedy
+# + 16.1s sampled — the serve-chaos-smoke CI job runs this suite in full,
+# so the coverage lives there while the 870s tier-1 budget completes
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "kw",
     [dict(temperature=0.0),
@@ -139,6 +143,10 @@ def test_midstream_kill_resumes_token_identical(serve_instance, reference, kw):
     )
 
 
+# tier-1 budget (ISSUE 13): 427.2s on the dev box — HALF the 870s budget
+# for one test, and kill/respawn timing also flaked this run; the
+# serve-chaos-smoke CI job keeps running it on every push
+@pytest.mark.slow
 def test_chaos_soak_concurrent_streams_survive_kills(serve_instance, reference):
     """Sustained concurrent streaming while ServeReplicaKiller SIGKILLs
     replicas on a timer: every stream finishes, every token matches."""
@@ -188,6 +196,8 @@ def test_chaos_soak_concurrent_streams_survive_kills(serve_instance, reference):
         assert toks == expected, f"stream {i} diverged/truncated"
 
 
+# tier-1 budget (ISSUE 13): 27.0s measured — serve-chaos-smoke CI covers it
+@pytest.mark.slow
 def test_controller_kill_during_draining(serve_instance, reference):
     """Kill the CONTROLLER while a replica is draining from a downscale
     and a stream is in flight: the data plane keeps serving (streams
@@ -311,6 +321,8 @@ def test_http_proxy_capacity_shed_429(serve_instance):
     assert st == 200 and len(data.splitlines()) == 4
 
 
+# tier-1 budget (ISSUE 13): 12.3s measured — serve-chaos-smoke CI covers it
+@pytest.mark.slow
 def test_flight_recorder_sees_failover(serve_instance, reference, tmp_path,
                                        monkeypatch):
     """Observability contract: the failover leaves a forensic trail — the

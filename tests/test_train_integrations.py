@@ -57,6 +57,10 @@ class TestHuggingFace:
         got = np.asarray(gpt_forward(cfg, params, jnp.asarray(tokens)))
         np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)
 
+    # tier-1 budget (ISSUE 13): 15.0s measured on the dev box (HF model
+    # load + two forward paths); the logits-parity @slow test already
+    # covers the HF bridge in the slow tier
+    @pytest.mark.slow
     def test_vocab_padding(self):
         from ray_tpu.train.integrations import load_hf_gpt2
 
@@ -251,6 +255,9 @@ class TestGPTJ:
         naive = gptj_loss(dataclasses.replace(cfg32, fused_loss=False), params, tokens)
         np.testing.assert_allclose(float(fused), float(naive), atol=1e-4, rtol=1e-5)
 
+    # tier-1 budget (ISSUE 13): 10.9s measured on the dev box; fused-CE
+    # VJP parity is also pinned across configs by tests/test_fused_ce.py
+    @pytest.mark.slow
     def test_gptj_fused_loss_grads(self):
         """Bias-aware fused CE VJP: grads match the naive loss (incl. the
         lm_head bias grad, which only GPT-J exercises)."""
