@@ -273,10 +273,105 @@ def obs_ab_main() -> dict:
     return rec
 
 
+def batched_main() -> dict:
+    """Batched-path probe (``--batched``): tasks/s on the pipelined
+    submit/reply plane (ISSUE 14) plus the achieved batch sizes and
+    per-hop waterfall percentiles, in ONE JSON record (the last stdout
+    line). The CI waterfall-probe job uploads it next to the
+    core-obs-ab artifact so the IPC trajectory — hop microseconds AND
+    how much batching the plane actually achieves — is recorded per PR.
+    ``batched_tasks_nested_async`` fans out from a WORKER, which is the
+    path that rides submit_batch windows; driver-side async bursts ride
+    coalesced dispatch + reply batches."""
+    import ray_tpu
+    from ray_tpu.util import metrics as um
+
+    env = bench_environment()
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    def fan(n):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    def tasks_sync(n=600):
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        return n
+
+    def tasks_async(n=3000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    def tasks_nested_async(n=2000):
+        return ray_tpu.get(fan.remote(n))
+
+    results = [
+        timeit("batched_tasks_sync", tasks_sync),
+        timeit("batched_tasks_async", tasks_async),
+        timeit("batched_tasks_nested_async", tasks_nested_async),
+    ]
+
+    def hist(name: str):
+        for v in um.histogram_percentiles(name).get(name, {}).values():
+            return {"p50": v.get("p50"), "p99": v.get("p99"), "count": v.get("count")}
+        return None
+
+    # per-hop legs from a small TRACED burst, computed from the recent-
+    # record ring so ONLY the probe's records count (the head's leg
+    # histograms are process-lifetime and the nested arm's worker-side
+    # roots sample; the throughput arms themselves stay rootless so
+    # stamps never perturb the tasks/s numbers)
+    from ray_tpu._private.runtime import get_ctx
+    from ray_tpu.util import tracing
+
+    with tracing.trace_context():
+        for _ in range(250):
+            ray_tpu.get(noop.remote())
+    recent = get_ctx().call("waterfall", recent=250).get("recent", [])[-250:]
+
+    def leg_pcts(recs: list) -> dict:
+        out = {}
+        legs = {k for r in recs for k in r.get("legs", {})}
+        for leg in sorted(legs):
+            vals = sorted(r["legs"][leg] for r in recs if leg in r.get("legs", {}))
+            if vals:
+                out[leg] = {
+                    "p50": vals[len(vals) // 2],
+                    "p99": vals[min(len(vals) - 1, int(len(vals) * 0.99))],
+                    "count": len(vals),
+                }
+        return out
+
+    # read metrics BEFORE shutdown: the registry dies with the cluster
+    batch_hists = {
+        "core_submit_batch_size": hist("core_submit_batch_size"),
+        "core_reply_batch_size": hist("core_reply_batch_size"),
+    }
+    ray_tpu.shutdown()
+    env["spin_canary_mops_after"] = bench_environment()["spin_canary_mops"]
+    rec = {
+        "metric": "core_batched_path",
+        "env": env,
+        "detail": {r["metric"]: r["value"] for r in results},
+        "batch_hists": batch_hists,
+        "waterfall_legs": leg_pcts(recent),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 if __name__ == "__main__":
     import sys
 
     if "--obs-ab" in sys.argv:
         obs_ab_main()
+    elif "--batched" in sys.argv:
+        batched_main()
     else:
         main()

@@ -180,6 +180,32 @@ class Config:
     #: records, the oldest half is dropped (reference:
     #: ``task_events_max_num_task_in_gcs``).
     task_events_max_entries: int = 100_000
+    #: Pipelined submission (reference: lease-pipelined direct task
+    #: submission + ``max_grpc_message_size`` batching): socket contexts
+    #: buffer ``.remote()`` specs into one ``submit_batch`` message instead
+    #: of paying a send+reply rendezvous per task. A buffer flushes at this
+    #: many specs, before any other head RPC, or at the backstop below.
+    core_submit_batch_max: int = 64
+    #: Submit-window flow control: tasks allowed in un-acked submit windows
+    #: before a flush blocks for acks (the head acks WINDOWS, not tasks).
+    core_submit_window_tasks: int = 4096
+    #: Backstop flush period for a fire-and-forget submit buffer whose
+    #: owner never issues another head RPC (side-effect-only tasks).
+    core_submit_flush_backstop_s: float = 0.005
+    #: Worker completion coalescing: when the worker still has queued work,
+    #: finished-task replies accumulate (drained off-path by the reply
+    #: flusher thread) and ship as one ``tasks_done_batch``; an idle worker
+    #: always ships inline. Caps one batch message.
+    core_reply_batch_max: int = 64
+    #: Driver-side dispatch coalescing: an in-process submit leaves its
+    #: ``run_task`` in the head outbox unflushed until this many messages
+    #: queue (or until any blocking call / the outbox backstop flushes), so
+    #: an async submit burst ships as few ``run_task_batch`` socket writes.
+    core_dispatch_coalesce: int = 16
+    #: Hard cap on a submitted spec's total inline (by-value) argument
+    #: bytes on the batched submit path; beyond it the task's refs resolve
+    #: to an async error telling the caller to ``put()`` the argument.
+    core_max_spec_inline_bytes: int = 8 * 1024 * 1024
 
     # -- serving / dashboards ---------------------------------------------
     #: Default port of ``serve.start`` HTTP ingress proxies (reference:

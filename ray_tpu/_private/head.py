@@ -50,6 +50,39 @@ from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.shm_store import ShmLocation, ShmOwner
 from ray_tpu.util import waterfall as _waterfall
 
+#: raylint RL012 registry — batch-plane telemetry the head folds (ISSUE 14):
+#: one observation per submit window / reply batch, documented in
+#: OBSERVABILITY.md beside the waterfall legs they shrink
+METRIC_NAMES = ("core_submit_batch_size", "core_reply_batch_size")
+
+_BATCH_BOUNDARIES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+_BATCH_METRICS = None
+_BATCH_METRICS_LOCK = threading.Lock()
+
+
+def _batch_metrics() -> dict:
+    global _BATCH_METRICS
+    if _BATCH_METRICS is not None:
+        return _BATCH_METRICS
+    with _BATCH_METRICS_LOCK:
+        if _BATCH_METRICS is None:
+            from ray_tpu.util.metrics import Histogram
+
+            _BATCH_METRICS = {
+                "submit": Histogram(
+                    "core_submit_batch_size",
+                    "tasks per pipelined submit window received by the head",
+                    boundaries=_BATCH_BOUNDARIES,
+                ),
+                "reply": Histogram(
+                    "core_reply_batch_size",
+                    "completions per coalesced worker reply message",
+                    boundaries=_BATCH_BOUNDARIES,
+                ),
+            }
+    return _BATCH_METRICS
+
+
 # --------------------------------------------------------------------------
 # Object directory
 
@@ -163,11 +196,19 @@ class WorkerHandle:
         # which attempt of a spawn chain this handle is (0 = first); bounds
         # registration-timeout respawns (reference: worker_register_timeout_seconds)
         self.spawn_attempts = 0
+        # spec-header ids this worker already holds (cheaper per-task bytes:
+        # flush_outbox ships a function's static spec fields once per
+        # worker, steady-state run_task bodies reference them by id). Only
+        # the single active flush_outbox drainer mutates this.
+        self.sent_hdrs: set = set()
+        # spec headers THIS worker's submit_batch messages defined (the
+        # submitter side of the same split, keyed per connection)
+        self.submit_hdrs: dict = {}
 
     def send(self, msg) -> bool:
         try:
             with self.send_lock:
-                self.conn.send(msg)
+                ser.conn_send(self.conn, msg)
             return True
         except (OSError, ValueError, BrokenPipeError):
             return False
@@ -311,6 +352,9 @@ class ClientSession:
         self.conn = None
         self.disconnected_at: Optional[float] = None
         self.created_at = time.monotonic()
+        # spec headers this client's submit_batch messages defined (survives
+        # a reconnect-with-token: the client's header ids stay valid)
+        self.submit_hdrs: dict = {}
 
 
 # --------------------------------------------------------------------------
@@ -363,6 +407,9 @@ class _PendingQueue:
 
     @staticmethod
     def _sig(spec: dict) -> tuple:
+        sig = spec.get("_sig0")
+        if sig is not None:
+            return sig  # template-cached (resources/strategy are static)
         res = spec.get("resources") or {}
         strat = spec.get("strategy")
         lbl = spec.get("label_selector")
@@ -574,6 +621,14 @@ class Head:
         self._flush_event = threading.Event()
         # selector-served worker connections: conn -> (WorkerHandle, remote)
         self._io_conns: dict = {}
+        # bumped on every _io_conns mutation: drain callers re-sync their
+        # selector only when this moved (the dict snapshot + key compare
+        # were ~1.5us per pump — per sync task — with a stable conn set)
+        self._io_conns_gen = 0
+        # per-conn buffered framed readers (ser.ConnReader): one kernel
+        # read per drain round instead of two syscalls per message; owned
+        # by whoever holds _pump_mutex, reaped with the conn
+        self._io_readers: dict = {}
         self._io_thread: Optional[threading.Thread] = None
         # worker-conn pump ownership (see _pump_or_wait): a blocked getter
         # may take over the IO thread's job so a completion wakes the getter
@@ -600,6 +655,7 @@ class Head:
         self._pump_sel = _selectors.DefaultSelector()
         self._pump_sel.register(self._io_prog_r, _selectors.EVENT_READ)
         self._pump_registered: set = set()
+        self._pump_reg_gen = [-1]  # _io_conns generation the pump last synced
         self.pending_sched = _PendingQueue()  # dep-free tasks awaiting node pick
         # bumped whenever placement capacity can have INCREASED (release,
         # node add, pg placement): lets _schedule skip signatures that
@@ -765,6 +821,16 @@ class Head:
                                 n.stats = msg[1]
                 elif kind == "worker_stacks":
                     self._mailbox_post(msg[1]["req_id"], msg[1]["stacks"])
+                elif kind == "submit_batch":
+                    # pipelined submission from a ray:// driver session
+                    self._on_submit_batch(
+                        msg[1],
+                        session.submit_hdrs if session is not None else {},
+                        session=session,
+                    )
+                    self.flush_outbox()
+                    with self._conn_lock(conn):
+                        conn.send(("submit_ack", {"wid": msg[1]["wid"]}))
                 elif kind == "req":
                     _, seq, method, payload = msg
                     if session is not None:
@@ -791,6 +857,7 @@ class Head:
 
     def _adopt_worker_conn(self, conn, wh: WorkerHandle, remote: bool) -> None:
         self._io_conns[conn] = (wh, remote)
+        self._io_conns_gen += 1
         try:
             os.write(self._io_wake_w, b"c")  # pick up the new conn now
         except OSError:
@@ -804,7 +871,14 @@ class Head:
                 self._threads.append(self._io_thread)
 
     def _drain_io(
-        self, sel, registered: set, special_fd: int, timeout: float, budget: int = 64
+        self,
+        sel,
+        registered: set,
+        special_fd: int,
+        timeout: float,
+        budget: int = 64,
+        once: bool = False,
+        reg_gen: Optional[list] = None,
     ) -> bool:
         """Shared selector-drain for the IO thread and pumping getters
         (caller must hold ``_pump_mutex``): sync ``registered`` with the
@@ -814,13 +888,23 @@ class Head:
         readable ``special_fd`` (wake/progress pipe) is drained and ends
         the drain after the current event batch — the caller has a decision
         to make. Returns True when any worker message was handled."""
-        # atomic C-level snapshot: _adopt_worker_conn inserts concurrently,
-        # and iterating the live dict across threads can raise "dictionary
-        # changed size during iteration" out of a user's ray_tpu.get(). A
-        # conn missed by this snapshot is picked up next round (its adopt
-        # writes the wake pipe, so the next select returns immediately).
-        current = dict(self._io_conns)
-        if registered != current.keys():
+        # generation guard: with a stable conn set (every sync round trip)
+        # the snapshot + key compare below are skipped entirely. A conn
+        # adopted between the gen read and the snapshot is re-synced next
+        # round (the stored gen is stale, and adopt writes the wake pipe so
+        # the next select returns immediately).
+        gen = self._io_conns_gen
+        if reg_gen is not None and reg_gen[0] == gen:
+            current = None
+        else:
+            # atomic C-level snapshot: _adopt_worker_conn inserts
+            # concurrently, and iterating the live dict across threads can
+            # raise "dictionary changed size during iteration" out of a
+            # user's ray_tpu.get().
+            current = dict(self._io_conns)
+            if reg_gen is not None:
+                reg_gen[0] = gen
+        if current is not None and registered != current.keys():
             live = set(current)
             for c in registered - live:
                 try:
@@ -866,8 +950,14 @@ class Head:
                 if ent is None:
                     continue
                 wh, remote = ent
+                reader = self._io_readers.get(conn)
+                if reader is None:
+                    reader = self._io_readers[conn] = ser.ConnReader(conn)
                 try:
-                    msg = conn.recv()
+                    # one kernel read, every complete frame parsed — a
+                    # burst of coalesced replies costs one syscall, not
+                    # two per message (Connection.recv's header+body)
+                    msgs = reader.read_available()
                 except (EOFError, OSError):
                     try:
                         sel.unregister(conn)
@@ -876,9 +966,15 @@ class Head:
                     registered.discard(conn)
                     self._reap_io_conn(conn)
                     continue
-                progressed = True
-                budget -= 1
-                self._handle_worker_msg(conn, wh, remote, msg)
+                for msg in msgs:
+                    progressed = True
+                    budget -= 1
+                    self._handle_worker_msg(conn, wh, remote, msg)
+            if once and progressed:
+                # pumping getter: its completion most likely just landed —
+                # return to the readiness re-check instead of paying a
+                # second (usually empty) selector round per sync get
+                break
         return progressed
 
     def _worker_io_loop(self) -> None:
@@ -895,6 +991,7 @@ class Head:
         sel = selectors.DefaultSelector()
         sel.register(self._io_wake_r, selectors.EVENT_READ)
         registered: set = set()
+        reg_gen = [-1]
         while not self._shutdown:
             if self._pump_requests or (time.monotonic() - self._last_pump) < 0.003:
                 # a getter owns the pump (it is doing this loop's job) or
@@ -907,7 +1004,9 @@ class Head:
             if not self._pump_mutex.acquire(timeout=0.1):
                 continue
             try:
-                progressed = self._drain_io(sel, registered, self._io_wake_r, 0.1)
+                progressed = self._drain_io(
+                    sel, registered, self._io_wake_r, 0.1, reg_gen=reg_gen
+                )
                 if progressed:
                     self.flush_outbox()
                     if self._pump_requests:
@@ -919,20 +1018,28 @@ class Head:
                 self._pump_mutex.release()
 
     def _reap_io_conn(self, conn) -> None:
+        self._io_readers.pop(conn, None)
         ent = self._io_conns.pop(conn, None)
+        self._io_conns_gen += 1
         if ent is not None:
             self._on_worker_disconnect(ent[0])
             self.flush_outbox()
 
     def _handle_worker_msg(self, conn, wh: WorkerHandle, remote: bool, msg) -> None:
         kind = msg[0]
-        if kind == "req":
+        if kind == "task_done":  # hottest message first (one per task)
+            self._on_task_done(wh, msg[1])
+        elif kind == "req":
             _, seq, method, payload = msg
             self._dispatch_request(conn, wh, seq, method, payload, remote=remote)
-        elif kind == "task_done":
-            self._on_task_done(wh, msg[1])
         elif kind == "tasks_done_batch":
             self._on_task_done_batch(wh, msg[1])
+        elif kind == "submit_batch":
+            # pipelined nested submission from a worker: the whole window
+            # lands in one critical section; the ack returns window credits
+            # (per-window, never per-task)
+            self._on_submit_batch(msg[1], wh.submit_hdrs)
+            wh.send(("submit_ack", {"wid": msg[1]["wid"]}))
         elif kind == "stream_item":
             self._on_stream_item(wh, msg[1])
         elif kind == "actor_ready":
@@ -1019,6 +1126,16 @@ class Head:
                     session.refs.pop(oid, None)
                 else:
                     session.refs[oid] = n
+            elif method in ("free_refs", "free_refs_async"):
+                # the gc drain's COALESCED free (ISSUE 14): mirror the
+                # batched decrement or session expiry double-frees refs
+                # the client already dropped
+                for oid in payload["obj_ids"]:
+                    n = session.refs.get(oid, 0) - 1
+                    if n <= 0:
+                        session.refs.pop(oid, None)
+                    else:
+                        session.refs[oid] = n
             elif method == "get_actor_named" and payload.get("namespace") is None:
                 # safety net: clients normally send their namespace, but a
                 # None (pre-handshake or legacy caller) defaults to the
@@ -1214,9 +1331,9 @@ class Head:
         try:
             if worker is not None:
                 with worker.send_lock:
-                    conn.send(out)
+                    ser.conn_send(conn, out)
             else:
-                conn.send(out)
+                ser.conn_send(conn, out)
         except (OSError, ValueError, BrokenPipeError):
             pass
 
@@ -1529,6 +1646,27 @@ class Head:
         path, which its poll interval bounds."""
         self._outbox.append((wh, msg))
 
+    def _wire_spec(self, wh: WorkerHandle, spec: dict) -> dict:
+        """Header-split a dispatch (cheaper per-task bytes, ISSUE 14): a
+        spec carrying ``_hdr`` (header id + the static per-function fields
+        its submitter computed once) ships only its per-call body
+        (ser.split_spec_body — the shared elision rule) plus a header
+        reference; the first dispatch of a header to a worker inlines the
+        definition (``_hdr_def``), so a worker never misses — the conn is
+        FIFO and ``sent_hdrs`` is per-handle, so respawned or reassigned
+        workers start from a fresh set."""
+        hdr = spec.get("_hdr")
+        if hdr is None:
+            return spec
+        hid, fields = hdr
+        body = ser.split_spec_body(spec, fields)
+        if hid in wh.sent_hdrs:
+            body["_hdr_ref"] = hid
+        else:
+            wh.sent_hdrs.add(hid)
+            body["_hdr_def"] = hdr
+        return body
+
     def _flush_backstop_loop(self) -> None:
         while not self._shutdown:
             self._flush_event.wait(timeout=GLOBAL_CONFIG.outbox_flush_backstop_s)
@@ -1543,26 +1681,43 @@ class Head:
         FIFO execution depends on; the outer re-check catches items
         appended while the active drainer was releasing.
 
-        Consecutive run_task dispatches to the SAME worker coalesce into one
-        run_task_batch message (one pickle + one socket write for a burst of
-        pipelined leases), preserving each worker's FIFO order."""
+        run_task dispatches coalesce PER WORKER across the whole drain into
+        one run_task_batch message (one pickle + one socket write for a
+        burst of pipelined leases or a deferred submit storm). Only
+        cross-worker order is relaxed — no ordering contract spans workers;
+        each worker's own FIFO (including non-dispatch messages like exit,
+        which flush that worker's pending batch first) is preserved. Each
+        spec is header-split per worker at write time (_wire_spec): static
+        per-function fields ship once, steady-state bodies reference them."""
         while self._outbox:
             if not self._flush_lock.acquire(blocking=False):
                 return  # active drainer will pick ours up (or we re-enter)
             try:
-                pending_wh = None
-                pending_specs: list = []
+                if len(self._outbox) == 1:
+                    # sync round-trip fast path: one queued message, no
+                    # batching machinery — pop, wire, write
+                    try:
+                        wh, msg = self._outbox.popleft()
+                    except IndexError:
+                        continue
+                    if msg[0] == "run_task":
+                        msg = ("run_task", self._wire_spec(wh, msg[1]))
+                    if wh.alive and not wh.send(msg):
+                        self._on_worker_dead(wh)
+                    continue
+                batches: dict = {}  # wh -> [spec, ...] in dispatch order
 
-                def _flush_pending():
-                    nonlocal pending_wh, pending_specs
-                    if pending_wh is None:
+                def _flush_batch(wh0):
+                    specs = batches.pop(wh0, None)
+                    if not specs:
                         return
-                    wh0, specs = pending_wh, pending_specs
-                    pending_wh, pending_specs = None, []
-                    out = ("run_task", specs[0]) if len(specs) == 1 else (
-                        "run_task_batch", specs
+                    if not wh0.alive:
+                        return
+                    wire = [self._wire_spec(wh0, s) for s in specs]
+                    out = ("run_task", wire[0]) if len(wire) == 1 else (
+                        "run_task_batch", wire
                     )
-                    if wh0.alive and not wh0.send(out):
+                    if not wh0.send(out):
                         self._on_worker_dead(wh0)
 
                 while True:
@@ -1571,17 +1726,13 @@ class Head:
                     except IndexError:
                         break
                     if msg[0] == "run_task":
-                        if wh is pending_wh:
-                            pending_specs.append(msg[1])
-                            continue
-                        _flush_pending()
-                        pending_wh, pending_specs = wh, [msg[1]]
+                        batches.setdefault(wh, []).append(msg[1])
                         continue
-                    if wh is pending_wh:
-                        _flush_pending()  # non-dispatch msg: keep FIFO order
+                    _flush_batch(wh)  # non-dispatch msg: keep per-wh FIFO
                     if wh.alive and not wh.send(msg):
                         self._on_worker_dead(wh)
-                _flush_pending()
+                for wh in list(batches):
+                    _flush_batch(wh)
             finally:
                 self._flush_lock.release()
 
@@ -1689,7 +1840,97 @@ class Head:
 
     # ----------------------------------------------------------- scheduling
 
+    def _on_submit_batch(self, payload: dict, hdr_cache: dict, session=None) -> None:
+        """Rehydrate one pipelined submit window — items are ``(kind,
+        body)`` with bodies header-split against this connection's cache —
+        and run it through ``submit_task_batch``. Submit-time failures
+        (missing header after a protocol loss, oversized inline args)
+        surface asynchronously on that task's return refs; the window
+        always completes and always gets acked, so client credits can
+        never wedge on a poison task."""
+        hdrs = payload.get("hdrs")
+        if hdrs:
+            hdr_cache.update(hdrs)
+        cap = GLOBAL_CONFIG.core_max_spec_inline_bytes
+        items = []
+        for kind, body in payload["items"]:
+            hid = body.pop("_hdr_ref", None)
+            if hid is None:
+                spec = body
+            else:
+                fields = hdr_cache.get(hid)
+                if fields is None:
+                    with self.lock:
+                        for rid in body.get("return_ids", ()):
+                            self._store_error(
+                                rid,
+                                rex.RayError(
+                                    "submit window referenced an unknown spec "
+                                    "header (connection state lost); retry the task"
+                                ),
+                            )
+                    continue
+                spec = {**fields, **body}
+                spec["_hdr"] = (hid, fields)
+            size = 0
+            for a in spec.get("args", ()):
+                if a[0] == "v":
+                    size += len(a[1])
+            for a in spec.get("kwargs", {}).values():
+                if a[0] == "v":
+                    size += len(a[1])
+            if size > cap:
+                with self.lock:
+                    for rid in spec.get("return_ids", ()):
+                        self._store_error(
+                            rid,
+                            ValueError(
+                                f"task {spec.get('name')!r} carries {size} inline "
+                                f"argument bytes (cap {cap}); ray_tpu.put() large "
+                                f"arguments and pass the refs"
+                            ),
+                        )
+                continue
+            if session is not None:
+                self._session_track(
+                    session,
+                    "submit_task" if kind == "task" else "submit_actor_task",
+                    {"spec": spec},
+                )
+            items.append((kind, spec))
+        if items:
+            self.submit_task_batch(items)
+
     def submit_task(self, spec: dict) -> None:
+        with self.lock:
+            if self._submit_task_locked(spec):
+                self._schedule()
+
+    def submit_task_batch(self, items: list) -> None:
+        """Pipelined-submission entry (ISSUE 14): a whole burst of specs —
+        ``("task" | "actor_method", spec)`` in submission order — lands in
+        ONE critical section with ONE scheduling pass, instead of a lock
+        acquisition + schedule pass per ``.remote()``. Per-item failures
+        surface asynchronously on that item's return refs (the submitter
+        already holds them; there is no reply to raise into)."""
+        _batch_metrics()["submit"].observe(len(items))
+        with self.lock:
+            need_sched = False
+            for kind, spec in items:
+                try:
+                    if kind == "task":
+                        need_sched = self._submit_task_locked(spec) or need_sched
+                    else:
+                        self._submit_actor_task_locked(spec)
+                except Exception as e:  # noqa: BLE001 - surfaces on the refs
+                    for rid in spec.get("return_ids", ()):
+                        self._store_error(rid, e)
+            if need_sched:
+                self._schedule()
+
+    def _submit_task_locked(self, spec: dict) -> bool:
+        """Lock held. Returns True when the task joined ``pending_sched``
+        (the caller owes a scheduling pass)."""
         rec = {
             "task_id": spec["task_id"],
             "spec": spec,
@@ -1699,44 +1940,46 @@ class Head:
             "node": None,
             "retries_left": spec.get("max_retries", GLOBAL_CONFIG.default_max_retries),
         }
-        with self.lock:
-            # the submitter's refs on the return objects are taken HERE, not
-            # by per-id add_ref RPCs before the submit: for a worker
-            # submitting nested tasks that is one control round trip instead
-            # of 1 + num_returns (reference: task returns are born owned by
-            # the submitter, reference_count.h)
-            for rid in spec["return_ids"]:
-                ent = self.objects.get(rid)
-                if ent is None:
-                    ent = self.objects[rid] = ObjectEntry()
-                ent.refcount += 1
-            strategy = spec.get("strategy")
-            if strategy and strategy[0] == "pg":
-                # Fail fast if the task can never fit its designated bundle
-                # (reference: ValueError on infeasible bundle resources).
-                _, pg_id, bundle_idx, _ = strategy
-                pg = self.placement_groups.get(pg_id)
-                if pg is None:
-                    for rid in spec["return_ids"]:
-                        self._store_error(rid, ValueError("placement group removed"))
-                    return
-                res = self._effective_resources(spec)
-                bundles = [pg.bundles[bundle_idx]] if bundle_idx >= 0 else pg.bundles
-                if not any(
-                    all(b.get(k, 0.0) >= v for k, v in res.items()) for b in bundles
-                ):
-                    for rid in spec["return_ids"]:
-                        self._store_error(
-                            rid,
-                            ValueError(
-                                f"Task {spec.get('name')} requires {res} which can never fit "
-                                f"in placement group bundle(s) {bundles}; pass num_cpus=0 for "
-                                f"tasks in accelerator-only bundles"
-                            ),
-                        )
-                    return
-            self.tasks[spec["task_id"]] = rec
-            self._event(rec, "PENDING_ARGS_AVAIL")
+        # the submitter's refs on the return objects are taken HERE — at
+        # receive time, before any dispatch — not by per-id add_ref RPCs
+        # before the submit: for a worker submitting nested tasks that is
+        # one control round trip instead of 1 + num_returns, and for a
+        # batched window it means ownership exists the moment the head has
+        # the bytes (reference: task returns are born owned by the
+        # submitter, reference_count.h)
+        for rid in spec["return_ids"]:
+            ent = self.objects.get(rid)
+            if ent is None:
+                ent = self.objects[rid] = ObjectEntry()
+            ent.refcount += 1
+        strategy = spec.get("strategy")
+        if strategy and strategy[0] == "pg":
+            # Fail fast if the task can never fit its designated bundle
+            # (reference: ValueError on infeasible bundle resources).
+            _, pg_id, bundle_idx, _ = strategy
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                for rid in spec["return_ids"]:
+                    self._store_error(rid, ValueError("placement group removed"))
+                return False
+            res = self._effective_resources(spec)
+            bundles = [pg.bundles[bundle_idx]] if bundle_idx >= 0 else pg.bundles
+            if not any(
+                all(b.get(k, 0.0) >= v for k, v in res.items()) for b in bundles
+            ):
+                for rid in spec["return_ids"]:
+                    self._store_error(
+                        rid,
+                        ValueError(
+                            f"Task {spec.get('name')} requires {res} which can never fit "
+                            f"in placement group bundle(s) {bundles}; pass num_cpus=0 for "
+                            f"tasks in accelerator-only bundles"
+                        ),
+                    )
+                return False
+        self.tasks[spec["task_id"]] = rec
+        self._event(rec, "PENDING_ARGS_AVAIL")
+        if spec.get("args") or spec.get("kwargs"):
             for kind, payload in _iter_arg_refs(spec):
                 ent = self.objects.get(payload)
                 if ent is None:
@@ -1745,11 +1988,16 @@ class Head:
                 if not ent.ready:
                     rec["deps"].add(payload)
                     self.dep_waiters.setdefault(payload, set()).add(rec["task_id"])
-            if rec["deps"]:
-                rec["state"] = "WAITING_DEPS"
-            else:
-                self.pending_sched.append(rec)
-                self._schedule()
+        if rec["deps"]:
+            rec["state"] = "WAITING_DEPS"
+            return False
+        if not self.pending_sched and self._try_place(rec):
+            # direct placement: with nothing queued ahead policy order is
+            # unchanged, and the _PendingQueue signature machinery
+            # (append + schedule_pass) drops off the per-submit hot path
+            return False
+        self.pending_sched.append(rec)
+        return True
 
     def _deps_ready(self, obj_id: bytes):
         """Lock held. An object became available; activate waiting tasks."""
@@ -1766,6 +2014,33 @@ class Head:
         if activated:
             self._schedule()
 
+    def _try_place(self, rec: dict) -> bool:
+        """Lock held. One placement attempt for a dep-free task record —
+        the policy body shared by the scheduling pass and the direct
+        fast path (_submit_task_locked)."""
+        if self.cancelled and rec["task_id"] in self.cancelled:
+            self._finish_cancelled(rec)
+            return True
+        res = self._effective_resources(rec["spec"])
+        node = self._pick_node(rec["spec"], res)
+        if node is None:
+            if self._try_lease_dispatch(rec):
+                return True
+            self._warn_infeasible(rec)
+            return False
+        self._allocate_for(rec, node, res)
+        rec["node"] = node.node_id
+        rec["state"] = "ASSIGNED"
+        if rec["spec"]["kind"] == "actor_create":
+            self._start_actor_on(rec, node)
+        elif node.idle_workers:
+            wh = node.idle_workers.pop()
+            self._dispatch_to_worker(wh, rec)
+        else:
+            node.assigned.append(rec)
+            self._maybe_spawn(node)
+        return True
+
     def _schedule(self):
         """Lock held. Hybrid policy (reference hybrid_scheduling_policy.cc):
         prefer the first feasible node whose critical-resource utilization
@@ -1775,32 +2050,7 @@ class Head:
         signature once (see _PendingQueue) — O(signatures), not O(tasks)."""
         if not self.pending_sched:
             return  # hot path: every completion triggers a pass
-
-        def try_place(rec: dict) -> bool:
-            if rec["task_id"] in self.cancelled:
-                self._finish_cancelled(rec)
-                return True
-            node = self._pick_node(rec["spec"])
-            if node is None:
-                if self._try_lease_dispatch(rec):
-                    return True
-                self._warn_infeasible(rec)
-                return False
-            res = self._effective_resources(rec["spec"])
-            self._allocate_for(rec, node, res)
-            rec["node"] = node.node_id
-            rec["state"] = "ASSIGNED"
-            if rec["spec"]["kind"] == "actor_create":
-                self._start_actor_on(rec, node)
-            elif node.idle_workers:
-                wh = node.idle_workers.pop()
-                self._dispatch_to_worker(wh, rec)
-            else:
-                node.assigned.append(rec)
-                self._maybe_spawn(node)
-            return True
-
-        self.pending_sched.schedule_pass(try_place, self._sched_gen)
+        self.pending_sched.schedule_pass(self._try_place, self._sched_gen)
 
     def _warn_infeasible(self, rec):
         now = time.monotonic()
@@ -1820,11 +2070,33 @@ class Head:
                 )
 
     def _effective_resources(self, spec: dict) -> dict[str, float]:
+        eres = spec.get("_eres")
+        if eres is not None:
+            return eres  # template-cached (read-only by contract)
         return {k: v for k, v in spec.get("resources", {}).items() if v != 0}
 
-    def _pick_node(self, spec: dict) -> Optional[NodeState]:
-        res = self._effective_resources(spec)
+    def _pick_node(self, spec: dict, res: Optional[dict] = None) -> Optional[NodeState]:
+        if res is None:
+            res = self._effective_resources(spec)
         strategy = spec.get("strategy")
+        if strategy is None:
+            # hot path (plain tasks, no placement constraint): first node in
+            # stable order under the spread threshold — no alive-list or
+            # feasible-list allocation, the common single/few-node case
+            # resolves in one scan
+            thr = GLOBAL_CONFIG.scheduler_spread_threshold
+            best = None
+            best_u = None
+            for nid in self.node_order:
+                n = self.nodes[nid]
+                if not n.alive or not n.can_fit(res):
+                    continue
+                u = n.utilization(res)
+                if u <= thr:
+                    return n
+                if best_u is None or u < best_u:
+                    best, best_u = n, u
+            return best
         alive = [self.nodes[nid] for nid in self.node_order if self.nodes[nid].alive]
         if not alive:
             return None
@@ -2116,18 +2388,50 @@ class Head:
         self.cv.notify_all()
 
     def _on_task_done(self, wh: WorkerHandle, payload: dict):
-        self._on_task_done_batch(wh, [payload])
+        # singular fast lane (the sync round trip): same receipt contract
+        # as the batch path — reply_recv stamped before metrics/re-lay/
+        # lock — without the list wrap and second scan
+        wf = payload.get("wf")
+        if wf is not None and len(wf) == len(_waterfall.PHASES) - 1:
+            wf.append(time.time())
+        _batch_metrics()["reply"].observe(1)
+        results = payload.get("results")
+        if results:
+            for i, (rid, loc) in enumerate(results):
+                nloc = self._normalize_locator(loc)
+                if nloc is not loc:
+                    results[i] = (rid, nloc)
+        with self.lock:
+            self._task_done_locked(wh, payload)
+            self.cv.notify_all()
+            self._schedule()
 
     def _on_task_done_batch(self, wh: WorkerHandle, payloads: list[dict]):
         """Workers batch completions when they have more queued work
         (worker_main _emit_done): one lock region, one wakeup, one
         scheduling pass per batch instead of per task."""
+        now = None
         for payload in payloads:
-            if payload.get("results"):
-                # big inline results re-lay into shm BEFORE taking the lock
-                payload["results"] = [
-                    (rid, self._normalize_locator(loc)) for rid, loc in payload["results"]
-                ]
+            wf = payload.get("wf")
+            if wf is not None and len(wf) == len(_waterfall.PHASES) - 1:
+                # reply_recv stamps at RECEIPT — before metrics, the re-lay
+                # scan, and the head lock — so the reply leg measures the
+                # worker→head hop, not head-internal bookkeeping (fold
+                # detects the already-closed list)
+                if now is None:
+                    now = time.time()
+                wf.append(now)
+        _batch_metrics()["reply"].observe(len(payloads))
+        for payload in payloads:
+            results = payload.get("results")
+            if results:
+                # big inline results re-lay into shm BEFORE taking the
+                # lock; small locators pass through untouched (in-place —
+                # no per-task list rebuild)
+                for i, (rid, loc) in enumerate(results):
+                    nloc = self._normalize_locator(loc)
+                    if nloc is not loc:
+                        results[i] = (rid, nloc)
         with self.lock:
             for payload in payloads:
                 self._task_done_locked(wh, payload)
@@ -2240,6 +2544,8 @@ class Head:
         self.cv.notify_all()
 
     def _unpin_deps(self, spec: dict):
+        if not spec.get("args") and not spec.get("kwargs"):
+            return
         for kind, obj_id in _iter_arg_refs(spec):
             ent = self.objects.get(obj_id)
             if ent is not None:
@@ -2689,35 +2995,41 @@ class Head:
 
     def submit_actor_task(self, spec: dict) -> None:
         with self.lock:
-            for rid in spec["return_ids"]:  # submitter's refs (see submit_task)
-                ent = self.objects.get(rid)
-                if ent is None:
-                    ent = self.objects[rid] = ObjectEntry()
-                ent.refcount += 1
-            actor = self.actors.get(spec["actor_id"])
-            if actor is None or actor.state == ACTOR_DEAD:
-                cause = actor.death_cause if actor else "actor not found"
-                for rid in spec["return_ids"]:
-                    self._store_error(rid, rex.ActorDiedError(msg=f"Actor is dead: {cause}"))
-                return
-            rec = {"task_id": spec["task_id"], "spec": spec, "state": "PENDING", "worker": None, "retries_left": actor.max_task_retries}
-            self.tasks[spec["task_id"]] = rec
-            # Pin ObjectRef args until completion (mirrors submit_task); the
-            # actor worker fetches them at execution time.
-            for _kind, payload in _iter_arg_refs(spec):
-                ent = self.objects.get(payload)
-                if ent is None:
-                    ent = self.objects[payload] = ObjectEntry()
-                ent.pins += 1
-            if actor.state == ACTOR_ALIVE:
-                self._send_actor_task(actor, spec)
-            else:
-                actor.pending_calls.append(spec)
+            self._submit_actor_task_locked(spec)
+
+    def _submit_actor_task_locked(self, spec: dict) -> None:
+        for rid in spec["return_ids"]:  # submitter's refs (see submit_task)
+            ent = self.objects.get(rid)
+            if ent is None:
+                ent = self.objects[rid] = ObjectEntry()
+            ent.refcount += 1
+        actor = self.actors.get(spec["actor_id"])
+        if actor is None or actor.state == ACTOR_DEAD:
+            cause = actor.death_cause if actor else "actor not found"
+            for rid in spec["return_ids"]:
+                self._store_error(rid, rex.ActorDiedError(msg=f"Actor is dead: {cause}"))
+            return
+        rec = {"task_id": spec["task_id"], "spec": spec, "state": "PENDING", "worker": None, "retries_left": actor.max_task_retries}
+        self.tasks[spec["task_id"]] = rec
+        # Pin ObjectRef args until completion (mirrors submit_task); the
+        # actor worker fetches them at execution time.
+        for _kind, payload in _iter_arg_refs(spec):
+            ent = self.objects.get(payload)
+            if ent is None:
+                ent = self.objects[payload] = ObjectEntry()
+            ent.pins += 1
+        if actor.state == ACTOR_ALIVE:
+            self._send_actor_task(actor, spec)
+        else:
+            actor.pending_calls.append(spec)
 
     def _send_actor_task(self, actor: ActorState, spec: dict):
-        """Lock held. Actor calls go straight to the actor's worker in
-        submission order (socket FIFO = the reference's sequential actor
-        submit queue)."""
+        """Lock held. Actor calls reach the actor's worker in submission
+        order: the outbox is per-worker FIFO and flush_outbox preserves it,
+        so coalesced actor-call bursts ride one ``run_task_batch`` write
+        (socket FIFO = the reference's sequential actor submit queue). A
+        dead conn surfaces at flush as worker death, which runs the actor
+        restart machinery — dispatch can no longer fail synchronously."""
         actor.inflight[spec["task_id"]] = spec
         rec = self.tasks.get(spec["task_id"])
         if rec is not None:
@@ -2725,15 +3037,8 @@ class Head:
             rec["worker"] = actor.worker
         wf = spec.get("wf")
         if wf is not None:
-            _waterfall.stamp(wf)  # head_dispatch: about to send to the actor
-        if not actor.worker.send(("run_task", spec)):
-            # route through the DEDUPLICATING death path (wh.alive guard) —
-            # calling _on_actor_worker_death directly left the handle alive,
-            # and the conn reap then ran the death machinery a SECOND time:
-            # an extra restart charge, a kill of the restarting actor, and a
-            # leaked allocation when its in-flight respawn came up
-            self._handle_worker_death_locked(actor.worker)
-            self._schedule()
+            _waterfall.stamp(wf)  # head_dispatch: about to queue the send
+        self._enqueue_send(actor.worker, ("run_task", spec))
 
     def _on_actor_worker_death(self, actor_id: bytes):
         """Lock held. Actor restart state machine (reference
@@ -2886,6 +3191,10 @@ class Head:
         already pumps, parks on the condition variable. Single pump at a
         time via _pump_mutex; the IO thread defers while _pump_requests>0.
         Never called with the head lock held."""
+        if self._outbox:
+            # deferred dispatches (coalesced submits, lineage rebuilds) must
+            # ride out BEFORE this thread parks waiting on their results
+            self.flush_outbox()
         with self._pump_count_lock:
             self._pump_requests += 1
             self._last_pump = time.monotonic()
@@ -2911,7 +3220,8 @@ class Head:
                         self.cv.wait(timeout=min(t, 0.01))
                     return
                 progressed = self._drain_io(
-                    self._pump_sel, self._pump_registered, self._io_prog_r, t
+                    self._pump_sel, self._pump_registered, self._io_prog_r, t,
+                    once=True, reg_gen=self._pump_reg_gen,
                 )
                 if progressed:
                     self.flush_outbox()
@@ -2934,6 +3244,27 @@ class Head:
             # its own completions and never needs the IO thread anyway.
 
     def get_locators(self, obj_ids: list[bytes], timeout: Optional[float]) -> list:
+        if len(obj_ids) == 1:
+            # single-ref get (the sync round-trip pattern): no index
+            # machinery, one dict probe per readiness check
+            oid = obj_ids[0]
+            deadline = None if timeout is None else time.monotonic() + timeout
+            objects = self.objects
+            while True:
+                with self.lock:
+                    ent = objects.get(oid)
+                    if ent is not None and ent.ready:
+                        if ent.small is None and ent.shm is None:
+                            self._restore_spilled(oid, ent)
+                        if ent.ready:  # restore may fail INTO lineage rebuild
+                            ent.last_access = ent.last_read = time.monotonic()
+                            return [ent.locator()]
+                    if self._shutdown:
+                        raise rex.RayError("shutting down")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise rex.GetTimeoutError(f"Get timed out on {ObjectID(oid)}")
+                self._pump_or_wait(min(remaining, 0.05) if remaining else 0.05)
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         i = 0
@@ -2988,6 +3319,17 @@ class Head:
                 return
             ent.refcount -= 1
             self._maybe_evict(obj_id, ent)
+
+    def remove_refs(self, obj_ids: list) -> None:
+        """Batched decrement (GC drains coalesce ref drops): one lock
+        region for a whole burst of ``ObjectRef.__del__`` frees instead of
+        a head round trip per dead ref."""
+        with self.lock:
+            for obj_id in obj_ids:
+                ent = self.objects.get(obj_id)
+                if ent is not None:
+                    ent.refcount -= 1
+                    self._maybe_evict(obj_id, ent)
 
     def _maybe_evict(self, obj_id: bytes, ent: ObjectEntry):
         if ent.refcount <= 0 and ent.pins <= 0 and ent.ready:
@@ -3089,7 +3431,11 @@ class Head:
 
     def _lineage_spec_size(self, spec: dict) -> int:
         n = 512
-        for a in list(spec.get("args", ())) + list(spec.get("kwargs", {}).values()):
+        args = spec.get("args")
+        kwargs = spec.get("kwargs")
+        if not args and not kwargs:
+            return n
+        for a in list(args or ()) + list(kwargs.values() if kwargs else ()):
             if a[0] != "r":
                 n += len(a[1])
         return n
@@ -3831,6 +4177,10 @@ class Head:
         self.remove_ref(obj_id)
         return True
 
+    def rpc_free_refs(self, obj_ids):
+        self.remove_refs(obj_ids)
+        return True
+
     def rpc_tcp_address(self):
         return self.tcp_address
 
@@ -4252,16 +4602,23 @@ class Head:
 
     def _event(self, rec, state):
         # hot path (3 events per task): store a compact tuple; consumers
-        # (rpc_task_events -> state API / timeline) expand to dicts lazily
-        spec = rec["spec"]
-        tctx = spec.get("trace_ctx")
+        # (rpc_task_events -> state API / timeline) expand to dicts lazily.
+        # The static fields are resolved once per rec, not per event
+        pre = rec.get("_ev")
+        if pre is None:
+            spec = rec["spec"]
+            tctx = spec.get("trace_ctx")
+            pre = rec["_ev"] = (
+                rec["task_id"], spec.get("name"), spec.get("kind"),
+                tctx.get("request_id") if tctx else None,
+            )
         self.task_events.append(
-            (rec["task_id"], spec.get("name"), state, time.time(),
-             spec.get("kind"), tctx.get("request_id") if tctx else None)
+            (pre[0], pre[1], state, time.time(), pre[2], pre[3])
         )
         if len(self.task_events) > GLOBAL_CONFIG.task_events_max_entries:
             # floor of 1 so tiny settings still trim instead of growing forever
             del self.task_events[: max(1, GLOBAL_CONFIG.task_events_max_entries // 2)]
+
 
 
 def _iter_arg_refs(spec: dict):

@@ -17,6 +17,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 from ray_tpu import exceptions as rex
@@ -28,6 +29,50 @@ from ray_tpu._private.shm_store import ShmReader
 
 _ctx: Optional["BaseContext"] = None
 _ctx_lock = threading.Lock()
+
+#: raylint RL012 registry — the submitter side of the pipelined task plane
+#: (ISSUE 14): window credits left before a submit flush blocks for acks
+METRIC_NAMES = ("core_submit_credits",)
+
+_CREDIT_GAUGE = None
+
+#: gc-queue wake sent by ObjectRef.__del__ on the free buffer's
+#: empty→non-empty edge (one futex wake per quiescent burst, never per ref)
+_FREE_TICK = object()
+
+#: shared no-arg spec constants (see serialize_args): identity-elided
+#: against spec headers so the steady-state no-arg body ships without them
+EMPTY_ARGS: tuple = ()
+EMPTY_KWARGS: dict = {}
+
+
+def _credit_gauge():
+    global _CREDIT_GAUGE
+    if _CREDIT_GAUGE is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _CREDIT_GAUGE = Gauge(
+            "core_submit_credits",
+            "remaining pipelined-submission window credits (tasks) in this process",
+        )
+    return _CREDIT_GAUGE
+
+
+def _split_for_wire(spec: dict, sent: set, hdrs_out: dict) -> dict:
+    """Header-split one spec for a submit window (cheaper per-task bytes):
+    static per-function fields already known to the receiver are elided
+    (ser.split_spec_body), new headers ride the window's ``hdrs`` map
+    exactly once per connection."""
+    hdr = spec.get("_hdr")
+    if hdr is None:
+        return spec
+    hid, fields = hdr
+    body = ser.split_spec_body(spec, fields)
+    body["_hdr_ref"] = hid
+    if hid not in sent:
+        sent.add(hid)
+        hdrs_out[hid] = fields
+    return body
 
 
 def get_ctx() -> "BaseContext":
@@ -87,15 +132,29 @@ class ObjectRef:
     def __del__(self):
         # GC-safety: __del__ can fire at ANY allocation point, including in a
         # thread that holds (or is awaited by a holder of) the head lock or a
-        # connection send lock. The only safe operation here is a reentrant
-        # SimpleQueue.put; a dedicated drain thread performs the real
-        # decrement (reference: reference_count.h posts decrements to the
-        # io_context for the same reason — never block in a destructor).
-        if self._owned and _ctx is not None and not _ctx.closed:
-            try:
-                _ctx.enqueue_gc("call", ("free_ref_async", {"obj_id": self._id}))
-            except Exception:
-                pass
+        # connection send lock. The only safe operations here are a reentrant,
+        # lock-free deque append and a reentrant SimpleQueue.put; the gc
+        # drain thread ships the buffered ids as coalesced free batches
+        # (reference: reference_count.h posts decrements to the io_context
+        # for the same reason — never block in a destructor). Only the
+        # empty→non-empty EDGE wakes the drain: at task rates one futex
+        # wake per dead ref was a measurable share of the sync round trip,
+        # and a busy drain coalesces every append that lands meanwhile.
+        ctx = _ctx
+        if self._owned and ctx is not None and not ctx.closed:
+            if ctx._poisoned:
+                # a poisoned (failed fire-and-forget) ref's error entry
+                # lives exactly as long as the ref: dropping the last
+                # handle drops the entry, so repeated reconnect storms
+                # cannot grow the dict forever (dict.pop is reentrant-safe)
+                ctx._poisoned.pop(self._id, None)
+            buf = ctx._free_buf
+            buf.append(self._id)
+            if len(buf) == 1:
+                try:
+                    ctx._gc_q.put(_FREE_TICK)
+                except Exception:
+                    pass
 
     def __reduce__(self):
         nonce = None
@@ -224,7 +283,9 @@ class BaseContext:
         self.authkey: Optional[bytes] = None  # data-plane auth (set by subclasses)
         self.head_host: str = "127.0.0.1"  # host we reach the control plane on
         self._data_addrs: dict = {}  # node bin -> (host, port) cache
-        self._uploaded_funcs: set[bytes] = set()
+        # func_id -> the INTERNED id bytes: returning one object per id lets
+        # spec headers elide func_id by identity (_split_for_wire)
+        self._uploaded_funcs: dict[bytes, bytes] = {}
         self._readers: dict[bytes, ShmReader] = {}
         self._readers_lock = threading.Lock()
         # task-id source (see new_task_returns): nonce drawn once per context
@@ -247,6 +308,15 @@ class BaseContext:
         # critical section can never re-enter head/connection locks. The
         # drain thread performs the real (possibly blocking) calls.
         self._gc_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        # dead ObjectRef ids awaiting a coalesced free (ObjectRef.__del__
+        # appends, the gc drain tick ships): a C-level deque, so the
+        # destructor path is one append — no lock, no wake, no allocation
+        self._free_buf: deque = deque()
+        # refs whose fire-and-forget submission died with the connection
+        # (un-acked window / unsent outbox at a reconnect): obj_id -> the
+        # retriable error get() raises. The head may never learn these ids,
+        # so resolving them locally is what keeps a ref from hanging.
+        self._poisoned: dict[bytes, Exception] = {}
         self._thunk_threads: list[threading.Thread] = []
         self._gc_thread = threading.Thread(
             target=self._gc_drain_loop, name="gc-drain", daemon=True
@@ -260,13 +330,61 @@ class BaseContext:
         self._gc_q.put((kind, payload))
 
     def _gc_drain_loop(self) -> None:
+        free_buf = self._free_buf
+
+        def flush_free() -> None:
+            # ref drops dominate GC work at high task rates (one per
+            # consumed result): ship whatever __del__ buffered as chunked
+            # free batches — one head call / one socket write per chunk
+            # instead of a lock round trip per dead ref
+            while free_buf:
+                ids: list[bytes] = []
+                try:
+                    while len(ids) < 8192:
+                        ids.append(free_buf.popleft())
+                except IndexError:
+                    pass
+                if not ids:
+                    return
+                try:
+                    self._free_refs_rpc(ids)
+                except Exception as e:
+                    # transient failure (reconnect blip): put the popped
+                    # chunk BACK so the next tick retries — dropping it
+                    # would pin these objects' head refcounts (and their
+                    # shm bytes) for the session's life
+                    free_buf.extendleft(reversed(ids))
+                    warn_throttled("gc drain loop", e)
+                    return
+
         while True:
-            item = self._gc_q.get()
+            try:
+                # near-IDLE when the free buffer is empty (0.5Hz fallback —
+                # 1000 workers polling at 100Hz once saturated a 1-core box,
+                # test_envelope_1k_actors); while ids are buffered, the 5ms
+                # timeout is the coalescing tick: refs dropped since the
+                # last pass ship a few ms late, and a busy submit loop never
+                # pays a gc wakeup per dead ref. __del__'s empty→non-empty
+                # edge tick wakes us promptly; the 2s fallback covers the
+                # tick's benign race (two concurrent appends can both see
+                # len==2 and neither tick) so a lost wake self-heals
+                item = self._gc_q.get(timeout=0.005 if free_buf else 2.0)
+            except queue.Empty:
+                if not self.closed:
+                    flush_free()
+                continue
             if item is None:
+                flush_free()  # shutdown drains queued work BEFORE closing
                 return
             if self.closed:
                 continue  # keep draining so shutdown's sentinel is reached
+            if item is _FREE_TICK:
+                continue  # buffer went non-empty: re-enter the timed get
             kind, payload = item
+            if kind == "call" and payload[0] == "free_ref_async":
+                free_buf.append(payload[1]["obj_id"])
+                continue
+            flush_free()  # non-free work: frees precede blocking thunks
             try:
                 if kind == "call":
                     method, kwargs = payload
@@ -338,7 +456,25 @@ class BaseContext:
     ) -> bytes:
         raise NotImplementedError
 
+    def _free_refs_rpc(self, ids: list) -> None:
+        """Ship a coalesced ref-free batch, RAISING on transport failure —
+        the gc drain's re-queue-and-retry path depends on seeing the error
+        (the generic ``call`` fire-and-forget branches swallow it, which
+        would silently drop up to a whole chunk of decrements and pin those
+        objects' head refcounts for the session's life)."""
+        if len(ids) == 1:
+            self.call("free_ref_async", obj_id=ids[0])
+        else:
+            self.call("free_refs_async", obj_ids=ids)
+
     def get(self, refs: list[ObjectRef], timeout: Optional[float]) -> list[Any]:
+        if self._poisoned:
+            for r in refs:
+                err = self._poisoned.get(r.binary())
+                if err is not None:
+                    # asking the head would hang forever: it may never have
+                    # seen this id (failed fire-and-forget submission)
+                    raise err
         locators = self.call("get", obj_ids=[r.binary() for r in refs], timeout=timeout)
         out = []
         for r, loc in zip(refs, locators):
@@ -436,6 +572,8 @@ class BaseContext:
     def _materialize(self, obj_id: bytes, locator, _retry: bool = True):
         kind, payload, is_err = locator
         if kind == "inline":
+            if payload == ser.NONE_BYTES:
+                return None  # one bytes compare beats a full deserialize
             return ser.deserialize_value(ser.SerializedValue.from_bytes(payload))
         force_dp = (
             self._force_dp
@@ -493,22 +631,42 @@ class BaseContext:
 
     def wait(self, refs, num_returns, timeout, fetch_local=True):
         ids = [r.binary() for r in refs]
-        ready_ids = set(self.call("wait", obj_ids=ids, num_returns=num_returns, timeout=timeout))
+        # a poisoned ref is RESOLVED (get raises its retriable error): count
+        # it ready UP FRONT and only ask the head about the rest — the head
+        # never learned these ids, so including them would park the wait for
+        # its whole timeout even when poisoned refs already make the count
+        ready_ids = {i for i in ids if i in self._poisoned} if self._poisoned else set()
+        remaining = [i for i in ids if i not in ready_ids]
+        need = min(num_returns - len(ready_ids), len(remaining))
+        if need > 0:
+            ready_ids.update(
+                self.call("wait", obj_ids=remaining, num_returns=need, timeout=timeout)
+            )
         ready, not_ready = [], []
         for r in refs:
             (ready if r.binary() in ready_ids and len(ready) < num_returns else not_ready).append(r)
         return ready, not_ready
 
     # -- functions --------------------------------------------------------
-    def upload_function(self, blob: bytes) -> bytes:
-        func_id = hashlib.sha1(blob).digest()[:16]
-        if func_id not in self._uploaded_funcs:
-            self.call("put_function", func_id=func_id, blob=blob)
-            self._uploaded_funcs.add(func_id)
+    def upload_function(self, blob: bytes, func_id: Optional[bytes] = None) -> bytes:
+        if func_id is None:
+            func_id = hashlib.sha1(blob).digest()[:16]
+        cached = self._uploaded_funcs.get(func_id)
+        if cached is not None:
+            return cached
+        self.call("put_function", func_id=func_id, blob=blob)
+        self._uploaded_funcs[func_id] = func_id
         return func_id
 
     # -- spec building ----------------------------------------------------
     def serialize_args(self, args, kwargs):
+        if not args and not kwargs:
+            # SHARED empty constants (never mutated downstream — all spec
+            # arg access is read-only): a no-arg call's args/kwargs then
+            # match its spec header by IDENTITY and drop off the wire
+            # entirely (_split_for_wire / _wire_spec)
+            return EMPTY_ARGS, EMPTY_KWARGS
+
         def one(v):
             if isinstance(v, ObjectRef):
                 return ("r", v.binary())
@@ -522,28 +680,22 @@ class BaseContext:
         return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
 
     def submit_task(self, spec: dict) -> list[ObjectRef]:
-        # the head takes the submitter's refs on the return ids inside
-        # submit_task itself — one round trip, not 1 + num_returns
+        # the head takes the submitter's refs on the return ids at receive
+        # time — one message (or one SHARE of a batched window), never
+        # 1 + num_returns round trips. Submission is fire-and-forget: the
+        # refs are minted client-side and submit-time errors surface on
+        # them asynchronously (_enqueue_submit per context).
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
-        wf = spec.get("wf")
-        if wf is not None:
-            # deferred import (util package ↔ runtime cycle); only the
-            # sampled-and-stamped path pays the sys.modules lookup
-            from ray_tpu.util import waterfall as _waterfall
-
-            _waterfall.stamp(wf)  # socket_write: the submit RPC begins
-        self.call("submit_task", spec=spec)
+        self._enqueue_submit("task", spec)
         return refs
 
     def submit_actor_task(self, spec: dict) -> list[ObjectRef]:
         refs = [ObjectRef(rid, owned=True) for rid in spec["return_ids"]]
-        wf = spec.get("wf")
-        if wf is not None:
-            from ray_tpu.util import waterfall as _waterfall
-
-            _waterfall.stamp(wf)  # socket_write: the submit RPC begins
-        self.call("submit_actor_task", spec=spec)
+        self._enqueue_submit("actor_method", spec)
         return refs
+
+    def _enqueue_submit(self, kind: str, spec: dict) -> None:
+        raise NotImplementedError
 
     def new_task_returns(self, num_returns: int):
         # Task ids end in 4 zero bytes so a return ObjectID's 12-byte prefix
@@ -587,7 +739,66 @@ class DriverContext(BaseContext):
         self.node_id_bin = node_id_bin
         self.authkey = head.authkey
 
+    def _enqueue_submit(self, kind: str, spec: dict) -> None:
+        """In-process submission: the head call IS the 'socket write' (no
+        round trip exists to pipeline away), but the worker-bound dispatch
+        it queued stays in the head outbox until ``core_dispatch_coalesce``
+        messages gather — an async submit burst then ships per worker as
+        one ``run_task_batch`` write. Any blocking call (get/wait flush at
+        entry, ``_pump_or_wait`` re-checks) or the outbox backstop bounds
+        how long a dispatch can sit."""
+        wf = spec.get("wf")
+        if wf is not None:
+            # deferred import (util package ↔ runtime cycle); only the
+            # sampled-and-stamped path pays the sys.modules lookup
+            from ray_tpu.util import waterfall as _waterfall
+
+            _waterfall.stamp(wf)  # socket_write: entering the head
+        head = self.head
+        was_idle = not head._outbox
+        try:
+            if kind == "task":
+                head.submit_task(spec)
+            else:
+                head.submit_actor_task(spec)
+        finally:
+            if (was_idle and head._outbox) or len(
+                head._outbox
+            ) >= GLOBAL_CONFIG.core_dispatch_coalesce:
+                # idle-plane submit (the sync round-trip pattern): the
+                # dispatch rides out NOW — deferring it to the caller's
+                # next head RPC charges that RPC's entry path to the
+                # head_dispatch leg. A burst (outbox already non-empty)
+                # keeps coalescing until the batch fills.
+                head.flush_outbox()
+
+    def get(self, refs, timeout: Optional[float]) -> list:
+        if len(refs) == 1 and not self._poisoned:
+            # sync round-trip fast path: the call() indirection and the
+            # id-list/zip machinery drop out of the reply-side corridor
+            head = self.head
+            if head._outbox:
+                head.flush_outbox()
+            oid = refs[0]._id
+            loc = head.get_locators([oid], timeout)[0]
+            value = self._materialize(oid, loc)
+            if loc[2]:  # error locator: raise, never return
+                if isinstance(value, rex.RayTaskError):
+                    raise value.as_instanceof_cause()
+                raise value
+            return [value]
+        return super().get(refs, timeout)
+
     def call(self, method: str, **payload):
+        if self.head._outbox:
+            # deferred dispatches (coalesced submits) ride out before any
+            # other head interaction — get/wait must never park behind an
+            # unflushed run_task they are waiting on
+            self.head.flush_outbox()
+        if method == "get":  # hottest two first (once per ray.get/wait)
+            return self.head.get_locators(payload["obj_ids"], payload.get("timeout"))
+        if method == "wait":
+            return self.head.wait_objects(payload["obj_ids"], payload["num_returns"], payload.get("timeout"))
         if method == "subscribe":
             return self.head.subscribe_local(payload["channel"], self.on_pub)
         if method == "unsubscribe":
@@ -600,17 +811,13 @@ class DriverContext(BaseContext):
                 return self.head.remove_ref(payload["obj_id"])
             finally:
                 self.head.flush_outbox()
-        if method == "add_ref":
-            return self.head.add_ref(payload["obj_id"])
-        if method == "get":
-            return self.head.get_locators(payload["obj_ids"], payload.get("timeout"))
-        if method == "wait":
-            return self.head.wait_objects(payload["obj_ids"], payload["num_returns"], payload.get("timeout"))
-        if method == "submit_task":  # hot path: skip the getattr dispatch
+        if method == "free_refs_async":
             try:
-                return self.head.submit_task(payload["spec"])
+                return self.head.remove_refs(payload["obj_ids"])
             finally:
                 self.head.flush_outbox()
+        if method == "add_ref":
+            return self.head.add_ref(payload["obj_id"])
         try:
             return getattr(self.head, "rpc_" + method)(**payload)
         finally:
@@ -652,6 +859,27 @@ class WorkerContext(BaseContext):
         self._send_lock = threading.Lock()
         self._pending: dict[int, list] = {}
         self._pending_lock = threading.Lock()
+        # pipelined submission (ISSUE 14): .remote() buffers here and a
+        # whole burst ships as ONE submit_batch message — no send+reply
+        # rendezvous per task. The head acks WINDOWS; _submit_inflight
+        # counts tasks in un-acked windows against the credit limit.
+        # _submit_send serializes window build+send end to end (FIFO);
+        # the cv itself is never held across a socket write.
+        self._submit_send = threading.Lock()
+        self._submit_cv = threading.Condition()
+        # the thread that processes submit_acks (worker recv loop / driver
+        # pump): it must NEVER park in _flush_submits — it is the only
+        # thread that can replenish credits, and an exec thread in the
+        # credit wait holds _submit_send, so blocking here is a self-
+        # deadlock. send_raw/call skip the flush on this thread.
+        self._recv_ident: Optional[int] = None
+        self._submit_buf: list = []  # (kind, spec) in submission order
+        self._submit_wid = 0
+        self._submit_unacked: dict[int, tuple] = {}  # wid -> (ids, conn)
+        self._submit_inflight = 0
+        self._submit_last_flush = 0.0
+        self._submit_backstop: Optional[threading.Event] = None
+        self._sent_hdrs: set = set()
 
     # message pump (run by worker_main's receiver thread)
     def on_response(self, seq, ok, payload):
@@ -661,7 +889,197 @@ class WorkerContext(BaseContext):
             slot[1] = (ok, payload)
             slot[0].set()
 
+    # ---------------------------------------------------------- submission
+    def _enqueue_submit(self, kind: str, spec: dict) -> None:
+        """Fire-and-forget submission with burst coalescing: the first
+        submit after a quiet period flushes immediately (a lone nested
+        task must not sit in the buffer), while submits arriving on the
+        heels of a flush are a burst — they buffer and ship as one window
+        when the batch fills, before the next head RPC (every call()/
+        send_raw flushes first), or at the 5ms backstop."""
+        now = time.monotonic()
+        with self._submit_cv:
+            self._submit_buf.append((kind, spec))
+            defer = (
+                now - self._submit_last_flush
+                < GLOBAL_CONFIG.core_submit_flush_backstop_s / 8
+                and len(self._submit_buf) < GLOBAL_CONFIG.core_submit_batch_max
+            )
+        if defer:
+            evt = self._submit_backstop
+            if evt is None:
+                evt = self._ensure_submit_backstop()
+            evt.set()  # backstop bounds the burst tail's sit time
+            return
+        self._flush_submits()
+
+    def _ensure_submit_backstop(self) -> threading.Event:
+        with self._submit_cv:
+            if self._submit_backstop is not None:
+                return self._submit_backstop
+            evt = self._submit_backstop = threading.Event()
+
+        def loop():
+            period = GLOBAL_CONFIG.core_submit_flush_backstop_s
+            while not self.closed:
+                evt.wait()
+                evt.clear()
+                while not self.closed:
+                    time.sleep(period)
+                    if not self._submit_buf:
+                        break  # quiet again: park on the event
+                    try:
+                        self._flush_submits()
+                    except Exception as e:
+                        warn_throttled("submit backstop flush", e)
+
+        threading.Thread(target=loop, name="submit-backstop", daemon=True).start()
+        return evt
+
+    def _flush_submits(self) -> None:
+        """Ship every buffered spec as one submit_batch window. Window
+        ORDER is the FIFO contract (per-actor FIFO is submission order):
+        the outer ``_submit_send`` lock serializes build+send end to end.
+        The wire write itself happens OUTSIDE ``_submit_cv`` — the recv
+        thread must be able to process submit_acks (which take the cv)
+        even while a send is blocked on a full socket, or head and worker
+        wedge against each other's full buffers (each blocked writing,
+        neither reading)."""
+        while True:
+            with self._submit_send:
+                with self._submit_cv:
+                    if not self._submit_buf or self.closed:
+                        return
+                    while (
+                        self._submit_inflight
+                        >= GLOBAL_CONFIG.core_submit_window_tasks
+                    ):
+                        # window credits exhausted: the head is behind —
+                        # park until acks return credits (recv loop fills
+                        # them; a reconnect sweep resets them)
+                        if self.closed:
+                            return
+                        self._submit_cv.wait(timeout=0.1)
+                    if not self._submit_buf:
+                        continue  # a reconnect sweep drained it while we waited
+                    items = self._submit_buf
+                    self._submit_buf = []
+                    self._submit_wid += 1
+                    wid = self._submit_wid
+                    ids = [rid for _k, s in items for rid in s["return_ids"]]
+                    # capture the conn the window will ACTUALLY ride: the
+                    # send below must use this same object, or a reconnect
+                    # between build and send makes _fail_submits(not_on=
+                    # fresh) poison a window that was delivered on the
+                    # fresh conn — and the caller's retry double-submits
+                    conn0 = self.conn
+                    self._submit_unacked[wid] = (ids, conn0)
+                    self._submit_inflight += len(ids)
+                    self._submit_last_flush = time.monotonic()
+                    self._set_credit_gauge()
+                    hdrs: dict = {}
+                    wire = []
+                    stamped = False
+                    for kind, spec in items:
+                        wf = spec.get("wf")
+                        if wf is not None:
+                            if not stamped:
+                                from ray_tpu.util import waterfall as _waterfall
+
+                                stamped = True
+                            _waterfall.stamp(wf)  # socket_write: batch write begins
+                        wire.append((kind, _split_for_wire(spec, self._sent_hdrs, hdrs)))
+                    payload = {"wid": wid, "items": wire}
+                    if hdrs:
+                        payload["hdrs"] = hdrs
+                try:
+                    with self._send_lock:
+                        ser.conn_send(conn0, ("submit_batch", payload))
+                except Exception as e:
+                    # the window never reached the head: resolve its refs
+                    # locally with a retriable error (fail, never replay —
+                    # at-most-once is the pinned reconnect semantic)
+                    with self._submit_cv:
+                        ent = self._submit_unacked.pop(wid, None)
+                        if ent is not None:
+                            # a reconnect sweep may have raced us here and
+                            # already failed this window — decrementing
+                            # again would drive the credit counter negative
+                            # and quietly widen the flow-control window
+                            self._submit_inflight -= len(ids)
+                            # header definitions riding this (or any
+                            # earlier) window may be lost with the conn:
+                            # future windows must re-ship them (idempotent
+                            # receiver-side)
+                            self._sent_hdrs.clear()
+                            err = rex.RayError(
+                                "connection to the cluster was lost while "
+                                "submitting a task window; the tasks did "
+                                f"not run — retry ({e})"
+                            )
+                            for rid in ids:
+                                self._poisoned[rid] = err
+                            self._set_credit_gauge()
+                    return
+
+    def _on_submit_ack(self, wid: int) -> None:
+        with self._submit_cv:
+            ent = self._submit_unacked.pop(wid, None)
+            if ent is not None:
+                self._submit_inflight -= len(ent[0])
+                self._set_credit_gauge()
+                self._submit_cv.notify_all()
+
+    def _set_credit_gauge(self) -> None:
+        _credit_gauge().set(
+            max(0, GLOBAL_CONFIG.core_submit_window_tasks - self._submit_inflight)
+        )
+
+    def _fail_submits(self, not_on=None) -> None:
+        """Connection died: resolve every ref in un-acked windows (the head
+        may or may not have processed them — the ack was lost with the
+        socket) and every unsent buffered spec to a retriable error.
+        FAIL, never replay, is the pinned choice: blind replay of a window
+        the head DID process would double-submit its tasks. ``not_on``
+        spares windows already sent on the fresh post-reconnect conn."""
+        err = rex.RayError(
+            "connection to the cluster was lost before this task's submit "
+            "window was acknowledged; it may not have run — retry the call"
+        )
+        with self._submit_cv:
+            doomed: list[bytes] = []
+            for wid, (ids, conn0) in list(self._submit_unacked.items()):
+                if not_on is None or conn0 is not not_on:
+                    self._submit_unacked.pop(wid, None)
+                    self._submit_inflight -= len(ids)
+                    doomed.extend(ids)
+            if not_on is None:
+                # full-failure sweep (reconnect not yet attempted or gave
+                # up): unsent buffered specs would otherwise sit forever —
+                # fail them too. A post-reconnect sweep (not_on=fresh)
+                # KEEPS the buffer: those specs never touched any conn
+                # (shipping them on the fresh one cannot double-submit),
+                # and some may postdate the reconnect entirely.
+                for _kind, spec in self._submit_buf:
+                    doomed.extend(spec["return_ids"])
+                self._submit_buf = []
+            # header defs sent on the dead conn may not have survived
+            # receiver-side (a fresh WorkerHandle starts with empty
+            # submit_hdrs): re-ship every header on the next window —
+            # idempotent for receivers that did keep them
+            self._sent_hdrs.clear()
+            for rid in doomed:
+                self._poisoned[rid] = err
+            self._set_credit_gauge()
+            self._submit_cv.notify_all()
+
     def call(self, method: str, **payload):
+        if self._submit_buf and threading.get_ident() != self._recv_ident:
+            # buffered fire-and-forget submits precede every other RPC —
+            # a get on their refs must find the head already owning them.
+            # Never from the ack-processing thread: it parks in the credit
+            # wait that only it can un-park (see _recv_ident)
+            self._flush_submits()
         if method == "free_ref_async":
             # fire-and-forget decrement; workers never block on GC
             try:
@@ -669,6 +1087,27 @@ class WorkerContext(BaseContext):
             except Exception:
                 pass
             return None
+        if method == "free_refs_async":
+            try:
+                self._send(("req", 0, "free_refs", {"obj_ids": payload["obj_ids"]}))
+            except Exception:
+                pass
+            return None
+        return self._call_blocking(method, payload)
+
+    def _free_refs_rpc(self, ids: list) -> None:
+        # seq-0 send WITHOUT the fire-and-forget swallow: the gc drain
+        # re-queues the chunk on failure (a raise means the kernel never
+        # took the bytes — no double-decrement on retry). Routed through
+        # send_raw, which flushes buffered submits first: a free racing
+        # ahead of the submit window that CREATES its ref would be
+        # consumed as a no-op and leave the ref pinned forever.
+        if len(ids) == 1:
+            self.send_raw(("req", 0, "free_ref", {"obj_id": ids[0]}))
+        else:
+            self.send_raw(("req", 0, "free_refs", {"obj_ids": ids}))
+
+    def _call_blocking(self, method: str, payload: dict):
         seq = next(self._seq)
         ev = threading.Event()
         # slot[2] records the conn this call actually went out on (set by
@@ -711,9 +1150,17 @@ class WorkerContext(BaseContext):
                     if not ok:
                         raise err
                 slot[2] = self.conn  # the conn the bytes actually ride
-            self.conn.send(msg)
+            ser.conn_send(self.conn, msg)
 
     def send_raw(self, msg):
+        if self._submit_buf and threading.get_ident() != self._recv_ident:
+            # completions/stream items must not overtake the submits that
+            # preceded them (nested fan-out: parent's task_done after its
+            # children's submit window). The recv thread is exempt (see
+            # _recv_ident): its sends — exit-flush, header-miss errors —
+            # have no causal order against exec threads' buffered submits,
+            # and parking it wedges the worker permanently
+            self._flush_submits()
         self._send(msg)
 
     def put_serialized(self, sv, is_error=False, take_ref=False) -> bytes:
@@ -812,8 +1259,11 @@ class RemoteDriverContext(WorkerContext):
                 # calls that raced into the dying socket's kernel buffer
                 # produced no error yet got no reply: fail everything not
                 # already sent on the FRESH conn (they retry; a silent hang
-                # would be the alternative)
+                # would be the alternative). Same contract for submit
+                # windows: un-acked ones fail retriably — their acks died
+                # with the old socket and a blind replay could double-submit
                 self._fail_pending(not_on=conn)
+                self._fail_submits(not_on=conn)
                 # head-side pubsub routing died with the old conn: re-send
                 # subscribes for every channel with live sinks. Raw seq-0
                 # requests — a blocking call() here would deadlock (this IS
@@ -831,14 +1281,23 @@ class RemoteDriverContext(WorkerContext):
         return False
 
     def _pump_loop(self):
+        # this thread processes submit_acks (see _recv_ident): the
+        # send_raw/call flush guards exempt it from the credit wait
+        self._recv_ident = threading.get_ident()
         while not self.closed:
             try:
                 msg = self.conn.recv()
-            except (EOFError, OSError):
+            # TypeError: a concurrent local close (chaos shutdown_conn, a
+            # reconnect swap losing the race) nulls the Connection's handle
+            # mid-_recv and CPython raises it instead of OSError — without
+            # catching it here the pump thread dies silently and the session
+            # never redials (every later call fails for the session's life)
+            except (EOFError, OSError, ValueError, TypeError):
                 # fail in-flight calls FIRST (they will never get replies;
                 # failing after the swap could catch a call already sent on
                 # the fresh connection), then redial with the session token
                 self._fail_pending()
+                self._fail_submits()
                 if self.closed or not self._try_reconnect():
                     return
                 continue
@@ -847,6 +1306,8 @@ class RemoteDriverContext(WorkerContext):
                 self.on_response(seq, ok, payload)
             elif msg[0] == "pub":
                 self.on_pub(msg[1], msg[2])
+            elif msg[0] == "submit_ack":
+                self._on_submit_ack(msg[1]["wid"])
 
     def shutdown(self):
         super().shutdown()

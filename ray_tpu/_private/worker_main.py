@@ -24,14 +24,30 @@ from typing import Optional
 from ray_tpu import exceptions as rex
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.runtime import ObjectRef, WorkerContext, set_ctx
 
 
 class WorkerState:
     def __init__(self, ctx: WorkerContext):
         self.ctx = ctx
-        self.task_queue: "queue.Queue" = queue.Queue()
+        # SimpleQueue: the recv->exec handoff runs once per dispatched task
+        # and the C implementation shaves the pure-Python Condition dance
+        # off the head_dispatch leg
+        self.task_queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self.func_cache: dict[bytes, object] = {}
+        # spec headers (cheaper per-task bytes, ISSUE 14): the head ships a
+        # function's static spec fields once per worker; steady-state
+        # run_task bodies reference them by id and rehydrate here
+        self.hdr_cache: dict = {}
+        # reply coalescing (ISSUE 14): finished-task payloads buffer here
+        # while more work is queued and ship as ONE tasks_done_batch —
+        # drained off-path by the reply flusher so a slow follower can
+        # never withhold a finished result (an idle worker ships inline)
+        self.reply_buf: list = []
+        self.reply_lock = threading.Lock()  # guards reply_buf
+        self.reply_send = threading.Lock()  # serializes drain+send (FIFO)
+        self.reply_evt: Optional[threading.Event] = None
         self.actor_instance = None
         self.actor_id: Optional[bytes] = None
         self.actor_pool = None  # ThreadPoolExecutor for max_concurrency > 1
@@ -257,6 +273,14 @@ def _try_reconnect(state: WorkerState, ctx: WorkerContext):
             )
             conn.send(("actor_ready", {"actor_id": state.actor_id, "error": None}))
             ctx.conn = conn
+            # un-acked submit windows died with the OLD conn (their acks
+            # are unrecoverable and the restored head may never have seen
+            # them): fail them retriably and re-ship header definitions on
+            # the next window (fail-not-replay, the pinned semantic).
+            # not_on=conn spares a window a concurrent exec thread already
+            # delivered on the FRESH conn — poisoning that one would make
+            # the caller's retry a double-submit
+            ctx._fail_submits(not_on=conn)
             return conn
         except Exception:
             time.sleep(0.5)
@@ -264,31 +288,49 @@ def _try_reconnect(state: WorkerState, ctx: WorkerContext):
 
 
 def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
+    # this thread processes submit_acks: it must never park in the submit
+    # credit wait (runtime._recv_ident — send_raw/call skip the flush here)
+    ctx._recv_ident = threading.get_ident()
+    # buffered framed reads (ser.ConnReader): one syscall per kernel batch
+    # instead of two per message; this loop is the conn's only reader
+    reader = ser.ConnReader(conn)
     while state.running:
         try:
-            msg = conn.recv()
-        except (EOFError, OSError):
+            msg = reader.recv()
+        # ValueError/TypeError: a concurrent local close nulls the conn's
+        # handle mid-read (same contract as the driver pump loop)
+        except (EOFError, OSError, ValueError, TypeError):
             if state.actor_id is not None and getattr(state, "detached", False):
                 newconn = _try_reconnect(state, ctx)
                 if newconn is not None:
                     conn = newconn
+                    reader = ser.ConnReader(conn)
                     continue
             state.running = False
             state.task_queue.put(None)
             return
         kind = msg[0]
-        if kind == "resp":
+        if kind == "run_task":  # hottest message first (one per task)
+            spec = _rehydrate_spec(state, msg[1])
+            if spec is not None:  # None = header miss, already failed
+                _stamp_deserialized(spec)
+                state.task_queue.put(spec)
+        elif kind == "resp":
             _, seq, ok, payload = msg
             ctx.on_response(seq, ok, payload)
         elif kind == "pub":
             ctx.on_pub(msg[1], msg[2])
-        elif kind == "run_task":
-            state.task_queue.put(msg[1])
         elif kind == "run_task_batch":
-            # head coalesced consecutive dispatches (flush_outbox); FIFO
-            # order within the batch is the dispatch order
+            # head coalesced dispatches (flush_outbox); FIFO order within
+            # the batch is the dispatch order
             for spec in msg[1]:
-                state.task_queue.put(spec)
+                spec = _rehydrate_spec(state, spec)
+                if spec is not None:
+                    _stamp_deserialized(spec)
+                    state.task_queue.put(spec)
+        elif kind == "submit_ack":
+            # window credits for this worker's own pipelined submissions
+            ctx._on_submit_ack(msg[1]["wid"])
         elif kind == "cancel":
             _handle_cancel(state, msg[1])
         elif kind == "stream_ack":
@@ -305,13 +347,81 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
+            try:
+                _flush_done(state)  # deferred completions must not die with us
+            except Exception as e:
+                # conn already dead: nothing left to ship them on
+                warn_throttled("exit-flush deferred completions", e)
             if _prof_exit is not None:
                 _prof_exit()
             os._exit(0)
 
 
+def _stamp_deserialized(spec: dict) -> None:
+    """worker_deserialize stamp, taken in the RECV loop where the spec's
+    bytes were actually parsed (ConnReader) and its header rehydrated —
+    not at ``_run_task`` entry on the exec thread. The distinction is the
+    honest-attribution contract under batching (ISSUE 14): task #64 of a
+    ``run_task_batch`` waits its whole queue depth for the exec thread,
+    and that wait belongs to the worker_deserialize→exec_start leg (the
+    worker's own backlog), not to ``head_dispatch`` (the head+wire hop)."""
+    wf = spec.get("wf")
+    if wf is not None:
+        if _waterfall is None:
+            _bind_task_mods()
+        _waterfall.stamp(wf)  # worker_deserialize
+
+
+def _rehydrate_spec(state: WorkerState, spec: dict) -> dict:
+    """Expand a header-split run_task body back into a full spec. Header
+    definitions ride the same FIFO conn before any reference to them, so a
+    miss means connection-state loss — fail the task's refs instead of
+    crashing the recv loop."""
+    hd = spec.pop("_hdr_def", None)
+    if hd is not None:
+        state.hdr_cache[hd[0]] = hd[1]
+        return {**hd[1], **spec}
+    hid = spec.pop("_hdr_ref", None)
+    if hid is None:
+        return spec
+    fields = state.hdr_cache.get(hid)
+    if fields is None:
+        err = rex.RayTaskError.from_exception(
+            spec.get("name", "task"),
+            rex.RayError("run_task referenced a spec header this worker never saw"),
+        )
+        results = [
+            (rid, ("inline", ser.serialize(err).to_bytes(), True))
+            for rid in spec.get("return_ids", ())
+        ]
+        try:
+            state.ctx.send_raw(
+                ("task_done",
+                 {"task_id": spec["task_id"], "results": results, "results_error": True})
+            )
+        except Exception:
+            pass
+        return None
+    return {**fields, **spec}
+
+
 _profile_gate = threading.Lock()
 _prof_exit = None  # set by main() when RAY_TPU_WORKER_CPROFILE is on
+
+# lazily-bound task-path modules: imported on the FIRST task (workers
+# deliberately keep startup import-light), then the per-task path pays
+# module-global loads instead of sys.modules lookups
+_renv = None
+_tracing = None
+_waterfall = None
+
+
+def _bind_task_mods() -> None:
+    global _renv, _tracing, _waterfall
+    from ray_tpu._private import runtime_env as renv
+    from ray_tpu.util import tracing, waterfall
+
+    _renv, _tracing, _waterfall = renv, tracing, waterfall
 
 
 def _start_profile(ctx, req: dict) -> None:
@@ -442,8 +552,12 @@ def _resolve_function(state: WorkerState, func_id: bytes):
 def _load_args(state: WorkerState, spec: dict):
     """Deserialize by-value args; fetch by-ref args from the store. Errors in
     dependencies propagate (reference: RayTaskError poisoning dependents)."""
+    s_args = spec.get("args", ())
+    s_kwargs = spec.get("kwargs")
+    if not s_args and not s_kwargs:
+        return [], {}  # hot path: no-arg calls skip the fetch machinery
     ref_ids = []
-    for a in list(spec.get("args", ())) + list(spec.get("kwargs", {}).values()):
+    for a in list(s_args) + list(s_kwargs.values() if s_kwargs else ()):
         if a[0] == "r":
             ref_ids.append(a[1])
     fetched = {}
@@ -472,6 +586,10 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
     straight to shm from this process (zero extra copies)."""
     return_ids = spec["return_ids"]
     n = len(return_ids)
+    if value is None and not is_error and n == 1:
+        # the most common result: ship the precomputed constant, skip the
+        # whole cloudpickle + SerializedValue round per task
+        return [(return_ids[0], ("inline", ser.NONE_BYTES, False))]
     if is_error or n == 1:
         values = [value] * n if n else []
     else:
@@ -512,7 +630,8 @@ def _stream_results(state: WorkerState, spec: dict, gen) -> None:
     on an async actor's done-pool thread — (re-)install the submitter's
     trace context here so spans/events inside streaming bodies (the serve
     LLM path) keep their request_id for the stream's whole life."""
-    from ray_tpu.util import tracing as _tracing
+    if _tracing is None:
+        _bind_task_mods()
 
     prev_trace = _tracing.set_trace_context(
         _tracing.task_context(spec.get("trace_ctx"), spec["task_id"])
@@ -607,20 +726,19 @@ def _sync_over_asyncgen(agen, loop):
 
 
 def _run_task(state: WorkerState, spec: dict):
-    from ray_tpu._private import runtime_env as renv
-    from ray_tpu.util import tracing as _tracing
-    from ray_tpu.util import waterfall as _waterfall
+    if _renv is None:
+        _bind_task_mods()
+    renv = _renv
 
     task_id = spec["task_id"]
     state.current_task_id = task_id
     state.task_threads[task_id] = threading.get_ident()
-    # task-hop waterfall: a sampled spec arrives with the submitter's and
-    # head's stamps; worker_deserialize marks the start of fn resolve +
-    # arg fetch, exec_start/exec_end bracket the body, and the list rides
-    # the task_done payload back so the head can fold reply_recv
+    # task-hop waterfall: a sampled spec arrives with the submitter's,
+    # head's, and recv loop's stamps (worker_deserialize is taken at
+    # receipt — _stamp_deserialized); exec_start/exec_end bracket the
+    # body, and the list rides the task_done payload back so the head
+    # can fold reply_recv
     wf = spec.get("wf")
-    if wf is not None:
-        _waterfall.stamp(wf)  # worker_deserialize
     # re-install the submitter's trace context on the executing thread:
     # spans/events inside the task body (and any nested .remote() hops)
     # carry the same request_id end-to-end (util.tracing module doc).
@@ -650,8 +768,14 @@ def _run_task(state: WorkerState, spec: dict):
             args, kwargs = _load_args(state, spec)
             if wf is not None:
                 _waterfall.stamp(wf)  # exec_start
-            with renv.applied(spec.get("runtime_env"), state.ctx):
+            env = spec.get("runtime_env")
+            if not env:
+                # no runtime env: skip the contextmanager protocol — its
+                # enter/exit generator dance is pure overhead per task
                 value = fn(*args, **kwargs)
+            else:
+                with renv.applied(env, state.ctx):
+                    value = fn(*args, **kwargs)
         if wf is not None:
             _waterfall.stamp(wf)  # exec_end
     except BaseException as e:  # noqa: BLE001
@@ -684,13 +808,101 @@ def _run_task(state: WorkerState, spec: dict):
 
 
 def _emit_done(state: WorkerState, payload: dict) -> None:
-    # Completions ship immediately. An earlier revision batched them while
-    # more tasks were queued locally, but that withholds a finished task's
-    # result for the DURATION of the next pipelined task (a slow follower
-    # could stall an unrelated ray.get for minutes) and measured no
-    # throughput win — the head still accepts tasks_done_batch for any
-    # future sender that can batch safely.
-    state.ctx.send_raw(("task_done", payload))
+    """Ship a completion — coalescing a burst into one reply message.
+
+    An idle worker (nothing else queued) ships INLINE: the sync round trip
+    pays zero added latency and no thread handoff. With more work queued,
+    the payload joins the reply buffer and the off-path flusher thread
+    drains whatever accumulated into ONE tasks_done_batch pickle+write —
+    unlike the defer-until-queue-empty idea (tried and reverted pre-PR
+    13), a finished result is only ever withheld for the flusher's wakeup,
+    never for the DURATION of the next pipelined task."""
+    if not state.reply_buf and state.task_queue.empty():
+        # idle fast path (the sync round trip): nothing buffered, nothing
+        # queued — one send under the drain lock, no buffer round trip.
+        # Out-of-order risk is nil: completions are per-task keyed and the
+        # in-lock re-check keeps us behind any concurrently buffered batch
+        with state.reply_send:
+            if not state.reply_buf:
+                state.ctx.send_raw(("task_done", payload))
+                return
+    with state.reply_lock:
+        state.reply_buf.append(payload)
+        n = len(state.reply_buf)
+    if (
+        n < GLOBAL_CONFIG.core_reply_batch_max
+        and state.running
+        and not state.task_queue.empty()
+    ):
+        _reply_flusher_evt(state).set()
+        return
+    try:
+        _flush_done(state)
+    except Exception:
+        # conn churn: the batch is back on the buffer — hand it to the
+        # flusher's retry loop instead of crashing the exec thread (a
+        # detached actor survives the reconnect and re-ships)
+        _reply_flusher_evt(state).set()
+
+
+def _flush_done(state: WorkerState) -> None:
+    with state.reply_send:  # one drainer at a time = completion-order FIFO
+        with state.reply_lock:
+            batch = state.reply_buf
+            state.reply_buf = []
+        if not batch:
+            return
+        msg = ("task_done", batch[0]) if len(batch) == 1 else (
+            "tasks_done_batch", batch
+        )
+        try:
+            state.ctx.send_raw(msg)
+        except Exception:
+            # conn died mid-flush: put the batch BACK (front, order kept)
+            # so the post-reconnect flush re-ships it — a raise here means
+            # the kernel never accepted the bytes, so re-sending on the
+            # fresh conn cannot double-deliver
+            with state.reply_lock:
+                state.reply_buf = batch + state.reply_buf
+            raise
+
+
+def _reply_flusher_evt(state: WorkerState) -> threading.Event:
+    evt = state.reply_evt
+    if evt is not None:
+        return evt
+    with state.reply_send:  # double-checked: one flusher per worker
+        evt = state.reply_evt
+        if evt is not None:
+            return evt
+        evt = threading.Event()
+
+        def loop():
+            import time
+
+            while state.running:
+                evt.wait()
+                evt.clear()
+                while state.running:
+                    try:
+                        _flush_done(state)
+                        break
+                    except (BrokenPipeError, ConnectionResetError, EOFError,
+                            OSError, ValueError, TypeError):
+                        # conn churn (head gone, or a detached-actor
+                        # reconnect mid-swap): the batch went back on the
+                        # buffer — retry until the fresh conn lands or the
+                        # worker exits. NEVER return: a dead flusher with
+                        # a live event would silently withhold buffered
+                        # completions for up to core_reply_batch_max tasks
+                        time.sleep(0.1)
+                    except Exception:  # noqa: BLE001 - flusher must survive
+                        traceback.print_exc()
+                        time.sleep(0.1)
+
+        threading.Thread(target=loop, name="reply-flusher", daemon=True).start()
+        state.reply_evt = evt
+    return evt
 
 
 def _resolve_actor_method(state: WorkerState, name: str):
@@ -845,18 +1057,18 @@ async def _arun(state: WorkerState, spec: dict):
     import functools
     import inspect
 
-    from ray_tpu.util import tracing as _tracing
-    from ray_tpu.util import waterfall as _waterfall
+    if _tracing is None:
+        _bind_task_mods()
 
     loop = asyncio.get_running_loop()
     task_id = spec["task_id"]
     state.async_tasks[task_id] = asyncio.current_task()
     is_error = False
-    # task-hop waterfall (sampled specs only; see _run_task). exec_start
-    # is stamped after the arg fetch below; exec_end after the method.
+    # task-hop waterfall (sampled specs only; see _run_task — the
+    # worker_deserialize stamp was taken at receipt in the recv loop).
+    # exec_start is stamped after the arg fetch below; exec_end after
+    # the method.
     wf = spec.get("wf")
-    if wf is not None:
-        _waterfall.stamp(wf)  # worker_deserialize
     # best-effort trace context for async actors: the loop thread is shared,
     # so interleaved coroutines can momentarily see each other's context —
     # spans inside async methods still tag correctly in the common

@@ -73,6 +73,11 @@ class ActorHandle:
         self._methods = methods
         self._class_name = class_name
         self._owned = owned
+        # spec headers per (method, num_returns): the static call fields
+        # ship once per connection/worker, bodies reference them by id
+        # (cheaper per-task bytes, ISSUE 14); rebuilt fresh after
+        # deserialization — header ids are connection-lifetime cheap
+        self._hdr_cache: dict = {}
 
     @property
     def _actor_id_hex(self) -> str:
@@ -114,16 +119,37 @@ class ActorHandle:
         task_id, return_ids = ctx.new_task_returns(
             1 if streaming else max(num_returns, 1)
         )
+        hdr = self._hdr_cache.get((name, num_returns))
+        if hdr is None:
+            from ray_tpu._private.runtime import EMPTY_ARGS, EMPTY_KWARGS
+
+            fields = {
+                "kind": "actor_method",
+                "actor_id": self._actor_id,
+                "method_name": name,
+                "num_returns": num_returns,
+                "name": f"{self._class_name}.{name}",
+                # no-arg calls elide these by identity (serialize_args
+                # returns the same constants)
+                "args": EMPTY_ARGS,
+                "kwargs": EMPTY_KWARGS,
+            }
+            # CONTENT-derived id (ser.spec_header_id), not per-instance
+            # urandom: every deserialized copy of this handle
+            # (handle-per-task serve patterns mint thousands) produces the
+            # SAME id for the same fields, so receiver-side header caches
+            # dedupe instead of growing one entry per handle copy forever
+            hid = ser.spec_header_id(
+                b"actor_method", self._actor_id, name, num_returns
+            )
+            hdr = self._hdr_cache[(name, num_returns)] = (hid, fields)
         spec = {
+            **hdr[1],
             "task_id": task_id,
-            "kind": "actor_method",
-            "actor_id": self._actor_id,
-            "method_name": name,
             "args": s_args,
             "kwargs": s_kwargs,
-            "num_returns": num_returns,
             "return_ids": return_ids,
-            "name": f"{self._class_name}.{name}",
+            "_hdr": hdr,
         }
         if sp_ctx is not None:
             spec["trace_ctx"] = sp_ctx

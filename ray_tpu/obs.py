@@ -180,6 +180,9 @@ def _render_top() -> None:
     wf_line = _waterfall_top_line()
     if wf_line:
         lines.append(wf_line)
+    batch_line = core_batch_top_row(metrics, histogram_percentiles())
+    if batch_line:
+        lines.append(batch_line)
     if "llm_running_requests" in metrics:
         acc = gauge("llm_spec_acceptance_rate")
         # runtime retrace count (device_prof): nonzero after warmup means
@@ -221,6 +224,32 @@ def _render_top() -> None:
             )
         )
     print("\n".join(lines), flush=True)
+
+
+def core_batch_top_row(metrics: dict, pcts: dict) -> Optional[str]:
+    """The ``obs top`` task-plane batching row (ISSUE 14): submit-window
+    and reply-batch size p50/p99 plus the submitter's remaining window
+    credits. Same below-2-samples contract as the waterfall row — a
+    histogram with fewer than two observations renders ``—``."""
+    if (
+        "core_submit_batch_size" not in metrics
+        and "core_reply_batch_size" not in metrics
+    ):
+        return None
+
+    def hist(name: str) -> str:
+        p = _first_series(pcts.get(name, {})) or {}
+        if p.get("count", 0) < 2:
+            return "—"
+        return f"{p['p50']:.0f}/{p['p99']:.0f}"
+
+    credits = _first_series(metrics.get("core_submit_credits", {}))
+    return (
+        "core-batch(p50/p99): "
+        f"submit={hist('core_submit_batch_size')} "
+        f"reply={hist('core_reply_batch_size')}"
+        + (f" credits={int(credits)}" if credits is not None else "")
+    )
 
 
 def waterfall_top_row(summary: dict) -> str:

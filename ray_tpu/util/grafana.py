@@ -101,6 +101,29 @@ def _profiling_panels() -> list:
     percentiles per phase, device-step time per jit site, runtime
     retraces, and the HBM ledger the tiered-KV spill decision reads."""
     return [
+        ("Submit window size p50",
+         'histogram_quantile(0.5, rate(ray_tpu_core_submit_batch_size_bucket[5m]))',
+         "short",
+         "Tasks per pipelined submit window received by the head "
+         "(core_submit_batch_size) — 1 means the plane is running "
+         "un-batched sync round trips; bursts should push this toward "
+         "core_submit_batch_max."),
+        ("Submit window size p99",
+         'histogram_quantile(0.99, rate(ray_tpu_core_submit_batch_size_bucket[5m]))',
+         "short",
+         "Tail submit-window size — how big bursts actually get before "
+         "the core_submit_batch_max cap or a blocking RPC flushes them."),
+        ("Reply batch size p50",
+         'histogram_quantile(0.5, rate(ray_tpu_core_reply_batch_size_bucket[5m]))',
+         "short",
+         "Completions per coalesced worker reply message "
+         "(core_reply_batch_size); pair with core_submit_credits on the "
+         "submitter to spot window-credit stalls."),
+        ("Reply batch size p99",
+         'histogram_quantile(0.99, rate(ray_tpu_core_reply_batch_size_bucket[5m]))',
+         "short",
+         "Tail reply-batch size under load (the off-path flusher drains "
+         "whatever accumulated, capped at core_reply_batch_max)."),
         ("Task-hop p99 by phase",
          'histogram_quantile(0.99, rate(ray_tpu_core_task_phase_s_bucket{{phase=~".+"}}[5m]))',
          "s",

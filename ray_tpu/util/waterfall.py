@@ -37,6 +37,21 @@ Clocks: stamps are ``time.time()`` so they compare across processes on
 one host (workers share the head's clock).  A wall-clock step can
 produce a negative leg; the fold clamps legs at zero rather than
 discarding the record.
+
+Batched legs (PR 14): the 7-phase contract survives submission
+pipelining and reply coalescing — each task keeps its OWN stamp list,
+and batching moves WHERE a stamp is taken, never whether.  A spec
+buffered in a driver/worker submit outbox takes ``socket_write`` when
+its batch is actually written (queue time charges the socket_write leg);
+``head_dispatch`` covers the head outbox + coalesced ``run_task_batch``
+write + the worker recv loop's receive-and-parse, with
+``worker_deserialize`` stamped AT that receipt — so task #64 of a deep
+batch charges its exec-queue wait to its own
+worker_deserialize→exec_start leg, not to the head's hop; a completion
+deferred into the worker's reply outbox charges the defer + batch write
+to its ``reply`` leg.  Phases are never dropped for batched tasks, and
+per-task stamps stay monotonic because every boundary is stamped at the
+moment that task's bytes (or its batch's bytes) move.
 """
 
 from __future__ import annotations
@@ -88,6 +103,10 @@ _PHASE_BOUNDARIES = (
 
 _METRICS = None
 _METRICS_LOCK = threading.Lock()
+
+#: per-leg tag dicts built once — fold() runs on the head at every reply
+#: receipt, and 8 dict literals per fold showed up in the reply-leg p50
+_LEG_TAGS = {name: {"phase": name} for name, _i, _j in LEGS}
 
 # newest folded records, for chrome-trace nested slices (obs timeline)
 # and obs waterfall --recent; bounded drop-oldest
@@ -160,17 +179,21 @@ def fold(wf: list, spec: Optional[dict] = None) -> bool:
     Returns True when the record folded."""
     global _folded, _incomplete
     m = _metrics()
-    if len(wf) != len(PHASES) - 1:
+    if len(wf) == len(PHASES):
+        wf = list(wf)  # reply_recv already stamped at message receipt
+    elif len(wf) != len(PHASES) - 1:
         _incomplete += 1
         m["incomplete"].inc()
         return False
-    wf = list(wf)
-    wf.append(time.time())
+    else:
+        wf = list(wf)
+        wf.append(time.time())
     legs = {}
+    observe = m["phase"].observe
     for name, i, j in LEGS:
         dur = max(0.0, wf[j] - wf[i])  # clamp wall-clock steps
         legs[name] = dur
-        m["phase"].observe(dur, tags={"phase": name})
+        observe(dur, tags=_LEG_TAGS[name])
     m["folded"].inc()
     _folded += 1
     rec = {"stamps": wf, "legs": legs}

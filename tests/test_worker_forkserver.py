@@ -61,13 +61,31 @@ def test_forked_worker_runs_plain_tasks(cluster):
     )
 
 
+def _spin_mops(n: int = 2_000_000) -> float:
+    """The BENCH_r06 spin canary: integer adds per second, the ambient-load
+    probe every bench round records next to its numbers."""
+    t0 = time.perf_counter()
+    x = 0
+    for i in range(n):
+        x += i
+    return n / (time.perf_counter() - t0) / 1e6
+
+
 # tier-1 budget (ISSUE 13): 24.8s measured on the dev box — and the
 # 100-actor wave's registration timing flaked the same run; the wave is
 # a scale probe, not a correctness gate, so it rides the slow tier
 @pytest.mark.slow
 def test_spawn_wave_no_registration_respawns(cluster):
     """A 100-actor wave must complete without a single registration-timeout
-    respawn (r4: the wave drowned in 30s-timeout retry loops)."""
+    respawn (r4: the wave drowned in 30s-timeout retry loops).
+
+    Load tolerance (ISSUE 14 deflake): the PR 13 full-suite timing run
+    flaked this wave under `-m slow` load — the 30s registration window
+    and the rate floor were measuring the NEIGHBORS, not the spawn path.
+    When the assertions fail AND the spin canary shows the box is
+    contended (this box idles at ~24-29 Mops across BENCH_r06-r08; a
+    saturated run measured <10), skip with the measurement cited instead
+    of failing; an unloaded box still gates at full strength."""
 
     @ray_tpu.remote(num_cpus=0)
     class E:
@@ -83,10 +101,19 @@ def test_spawn_wave_no_registration_respawns(cluster):
     retried = [
         w for w in node.all_workers if w.actor_id is not None and w.spawn_attempts > 0
     ]
+    rate = 100 / dt
+    if retried or rate <= 5:
+        canary = _spin_mops()
+        if canary < 12.0:
+            pytest.skip(
+                f"box contended (spin canary {canary:.1f} Mops < 12): wave "
+                f"{rate:.1f}/s with {len(retried)} registration respawns is "
+                "ambient load, not a spawn-path regression"
+            )
     assert not retried, f"{len(retried)} workers hit the registration-timeout respawn"
     # spawn-rate floor: generous vs the >=20/s target so a loaded CI box
     # doesn't flake, but far above r4's 0.88/s
-    assert 100 / dt > 5, f"spawn wave too slow: {100 / dt:.1f}/s"
+    assert rate > 5, f"spawn wave too slow: {rate:.1f}/s"
     for x in wave:
         ray_tpu.kill(x)
 
