@@ -1,0 +1,572 @@
+"""raylint phase 1.9: the thread-root / shared-state model (RL017, RL018).
+
+PR 14 made the task plane fire-and-forget and multiplied its concurrency
+surface: submit outboxes flushed by a backstop thread, an off-path reply
+flusher, credit/window state touched by both the ack-processing recv
+thread and submitters, reconnect sweeps racing in-flight sends. Every
+post-review hardening round on PRs 11-14 found exactly this bug class by
+hand. This module mechanizes that review, RacerD-style:
+
+* **Thread roots** — every spawn site the index recorded
+  (``threading.Thread(target=...)`` incl. lambda bodies, executor
+  ``.submit()``/``run_in_executor`` hand-offs) resolved to the function
+  the new thread runs. A target that is a nested def (opaque to
+  ``resolve_call``) falls back to the ENCLOSING function as the root
+  body: the scanner folded the nested body's accesses into it, so they
+  are attributed to the right thread (plus the spawner's own accesses —
+  a documented over-approximation). One synthetic ``<caller>`` root
+  stands for everything an external thread can invoke directly: the
+  closure of functions with no resolvable project callers (public entry
+  points), excluding pure thread bodies and ``__init__``.
+* **Reachability with must-held locks** — per root, a worklist pass over
+  resolvable calls computes the lock set DEFINITELY held at each
+  function's entry (intersection over call paths, union with the locks
+  held at each call site — ``CallSite.held_rt``, which also counts
+  linear ``.acquire()``/``.release()`` bracketing). An access site's
+  guard set is entry-held ∪ site-held.
+* **Guarded-by inference** — for every shared-state node (a class
+  attribute resolved through self/annotated-param chains, or a module
+  global accessed under a ``global`` decl / without local shadowing),
+  the inferred guard is the INTERSECTION of lock sets across all its
+  access sites. RL017 fires when ≥2 distinct roots reach the state, at
+  least one access writes, and the intersection is empty.
+* **LOCKFREE declarations** — deliberate lock-free designs are declared
+  in a module-level ``LOCKFREE`` tuple next to the state they cover
+  (mirroring ``LOCK_ORDER``), and the declaration is VERIFIED, not
+  trusted: a bare ``"Owner._attr"`` entry asserts single-writer (error
+  when ≥2 roots write), ``"Owner._attr: atomic"`` asserts every write is
+  one GIL-atomic operation (plain store / subscript store / one mutating
+  call — a read-modify-write ``+=`` fails), and an entry matching no
+  accessed state is stale (like a stale LOCK_ORDER entry).
+
+Precision choices (documented under-approximations, like the rest of
+raylint — each one keeps a benign pattern from demanding a declaration):
+
+* ``__init__`` bodies are pre-publication and contribute no sites.
+* Plain rebinds (``x.conn = fresh``, ``x.running = False``) are
+  GIL-atomic reference/flag publishes: they cannot tear, so a state
+  whose every write is a plain store never fires — the residual risk is
+  STALENESS, which is RL018's check-then-act territory, not corruption.
+* The corrupting access is a MUTATING write (``+=`` read-modify-write,
+  container mutation): RL017 fires on a pair of write sites from
+  different roots with disjoint lock sets where at least one is
+  aug/mutate — or, when that mutating write holds NO lock at all, on a
+  conflict with any other-root access (an unguarded dict/list mutation
+  can corrupt a concurrent reader mid-iteration). A mutating write whose
+  sites all share one lock conflicts with nothing but other writes.
+* Attributes holding thread-safe stdlib primitives (Queue/SimpleQueue/
+  Event/Lock/Condition/Semaphore/ThreadPoolExecutor by constructor or
+  annotation evidence) are internally synchronized and exempt.
+* Read-only state and state whose every access is caller-rooted (no
+  spawned-thread evidence) never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._lint.index import FuncInfo, ProjectIndex
+
+#: the synthetic root standing for any externally-calling thread
+CALLER = "<caller>"
+
+_WRITE_KINDS = ("store", "aug", "mutate")
+
+#: constructors whose product is internally synchronized — an attribute
+#: holding one needs no external lock for its own method calls
+_SYNC_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "local", "ThreadPoolExecutor",
+}
+
+_SYNC_ANN_RE = None  # built lazily (re import kept top-level-light)
+
+
+def _sync_annotation(text: str) -> bool:
+    import re as _re
+
+    global _SYNC_ANN_RE
+    if _SYNC_ANN_RE is None:
+        _SYNC_ANN_RE = _re.compile(
+            r"\b(Event|Lock|RLock|Condition|Semaphore|Barrier|"
+            r"Queue|SimpleQueue|ThreadPoolExecutor)\b"
+        )
+    return bool(_SYNC_ANN_RE.search(text))
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One shared-state access as seen from one thread root."""
+
+    state: Tuple                      # see ThreadModel._attr_state/_global_state
+    root: str                         # root label (CALLER or "thread:<qualname>")
+    kind: str                         # read | store | aug | mutate
+    locks: frozenset                  # lock keys definitely held at the site
+    node: ast.AST
+    func: FuncInfo
+    const_store: bool = False
+
+
+def state_display(state: Tuple) -> str:
+    """The LOCKFREE / diagnostic spelling of a state node:
+    ``Owner._attr`` for class attributes, ``<module>.<name>`` for module
+    globals (same convention as lock keys)."""
+    if state[0] == "attr":
+        return f"{state[2]}.{state[3]}"
+    return f"{state[1]}.{state[2]}"
+
+
+def parse_lockfree(entry: str) -> Tuple[str, Optional[str]]:
+    """``"Owner._attr: atomic"`` -> ("Owner._attr", "atomic")."""
+    if ":" in entry:
+        key, _, qual = entry.partition(":")
+        return key.strip(), qual.strip() or None
+    return entry.strip(), None
+
+
+class ThreadModel:
+    """Whole-program thread-root + access model, built once per lint run
+    (memoized on the index via :func:`get_model`)."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        #: root label -> root body FuncInfo
+        self.roots: Dict[str, FuncInfo] = {}
+        #: state node -> [Access, ...]
+        self.accesses: Dict[Tuple, List[Access]] = {}
+        #: state display key -> state node (for LOCKFREE verification)
+        self.by_display: Dict[str, List[Tuple]] = {}
+        self._build_roots()
+        self._collect()
+
+    # ------------------------------------------------------------- roots
+
+    def _spawn_sites(self):
+        for info in self.index.functions.values():
+            for chain, _daemon in info.thread_targets:
+                yield info, chain
+            for chain in info.exec_submits:
+                yield info, chain
+
+    def _build_roots(self) -> None:
+        index = self.index
+        spawned_bodies: Dict[str, FuncInfo] = {}
+        self.spawn_fallbacks: set = set()
+        for info, chain in self._spawn_sites():
+            callee = index.resolve_call(info, chain)
+            if callee is None and len(chain) == 1:
+                # nested-def target: the scanner folded its body into the
+                # enclosing function — use the spawner as the root body
+                callee = info
+                self.spawn_fallbacks.add(info.key)
+            if callee is not None:
+                spawned_bodies.setdefault(callee.key, callee)
+        for key, body in spawned_bodies.items():
+            self.roots[f"thread:{body.qualname}"] = body
+        # caller seeds: functions no project code resolvably calls, minus
+        # pure thread bodies — the public surface an external thread hits
+        called: set = set()
+        for info in self.index.functions.values():
+            for cs in info.calls:
+                callee = index.resolve_call(info, cs.chain)
+                if callee is not None and callee.key != info.key:
+                    called.add(callee.key)
+        # A method no project code resolvably calls is usually invoked
+        # through an unresolvable local receiver (`node.release(res)`) —
+        # its REAL lock context is its callers', which the index cannot
+        # see, and claiming "no lock" there would manufacture races. Only
+        # module-level functions (the public API surface) and rpc_*
+        # methods (the head's dynamic getattr dispatch — genuinely hit by
+        # concurrent conn threads with no locks held) count as caller
+        # seeds; everything else under-approximates.
+        self.caller_seeds = [
+            f
+            for f in index.functions.values()
+            if f.key not in called
+            and f.key not in spawned_bodies
+            and f.name not in ("__init__", "<module>")
+            and (f.cls is None or f.name.startswith("rpc_"))
+        ]
+
+    # ----------------------------------------------- reach with held locks
+
+    def _lock_keys(self, chains, func: FuncInfo) -> frozenset:
+        out = set()
+        for c in chains:
+            k = self.index.lock_key(c, func)
+            if k is not None:
+                out.add(k)
+        return frozenset(out)
+
+    def _reach_with_held(self, bodies: List[FuncInfo]) -> Dict[str, frozenset]:
+        """{function key: lock set definitely held at entry} over the
+        closure of resolvable calls from ``bodies`` (a must-analysis:
+        intersection over call paths)."""
+        index = self.index
+        entry: Dict[str, frozenset] = {b.key: frozenset() for b in bodies}
+        work = list(bodies)
+        while work:
+            f = work.pop()
+            base = entry[f.key]
+            for cs in f.calls:
+                callee = index.resolve_call(f, cs.chain)
+                if callee is None or callee.key == f.key:
+                    continue
+                if callee.name == "__init__":
+                    continue  # construction is pre-publication
+                held = base | self._lock_keys(cs.held_rt or cs.held, f)
+                cur = entry.get(callee.key)
+                new = held if cur is None else (cur & held)
+                if new != cur:
+                    entry[callee.key] = new
+                    work.append(callee)
+        return entry
+
+    # ------------------------------------------------------------ accesses
+
+    def _attr_state(self, info: FuncInfo, chain: Tuple[str, ...]) -> Optional[Tuple]:
+        """Resolve an access chain to ("attr", module, Class, attr)."""
+        index = self.index
+        owner = None
+        rest = ()
+        if info.self_name is not None and chain[0] == info.self_name:
+            if info.cls is None:
+                return None
+            owner, rest = info.cls.key, chain[1:]
+        elif chain[0] in info.param_classes:
+            owner, rest = info.param_classes[chain[0]], chain[1:]
+        if owner is None or not rest:
+            return None
+        ci = index.classes.get(owner)
+        if ci is None:
+            return None
+        if len(rest) >= 2:
+            # cross-object: `self.ctx._poisoned` resolves through the
+            # member's class when the index knows it; else unattributable
+            ck = ci.attr_classes.get(rest[0])
+            if ck is None or index.classes.get(ck) is None:
+                return None
+            ci = index.classes[ck]
+            owner, rest = ck, rest[1:]
+            if len(rest) != 1:
+                return None
+        attr = rest[0]
+        if attr not in ci.attr_assigns:
+            return None  # methods, properties, inherited/unknown names
+        kinds = [k for _in_init, k, _v in ci.attr_assigns[attr]]
+        if "jit_wrapper" in kinds:
+            return None
+        if self._is_sync_attr(ci, attr):
+            return None  # internally-synchronized primitive
+        return ("attr", owner[0], owner[1], attr)
+
+    def _is_sync_attr(self, ci, attr: str) -> bool:
+        cache = getattr(ci, "_sync_attr_cache", None)
+        if cache is None:
+            cache = ci._sync_attr_cache = {}
+        got = cache.get(attr)
+        if got is None:
+            got = False
+            for _in_init, _k, value in ci.attr_assigns.get(attr, []):
+                if isinstance(value, ast.Call):
+                    d = _chain(value.func)
+                    if d and d[-1] in _SYNC_CTORS:
+                        got = True
+                        break
+            if not got:
+                ann = ci.attr_annotations.get(attr)
+                got = bool(ann) and _sync_annotation(ann)
+            cache[attr] = got
+        return got
+
+    def _global_candidates(self, info: FuncInfo) -> dict:
+        """{name: is_global} for the module-global names this function can
+        touch: declared ``global``, or read without any local binding."""
+        mi = self.index.modules.get(info.module)
+        if mi is None:
+            return {}
+        names = {a.name for a in info.name_accesses}
+        if not names:
+            return {}
+        local_stores = {
+            a.name for a in info.name_accesses if a.kind in ("store", "aug")
+        }
+        out = {}
+        for name in names:
+            if name not in mi.globals and name not in _module_global_names(mi):
+                continue
+            if mi.globals.get(name) in ("lock", "sync"):
+                continue  # the synchronization object itself
+            if name in info.param_names:
+                continue
+            if name in info.global_decls:
+                out[name] = True
+            elif name not in local_stores:
+                out[name] = True  # pure reads of a module global
+        return out
+
+    def _collect(self) -> None:
+        index = self.index
+        groups: List[Tuple[str, Dict[str, frozenset]]] = []
+        for label, body in self.roots.items():
+            groups.append((label, self._reach_with_held([body])))
+        if self.caller_seeds:
+            groups.append((CALLER, self._reach_with_held(self.caller_seeds)))
+        for label, entry in groups:
+            for key, entry_held in entry.items():
+                func = index.functions.get(key)
+                if func is None or func.name == "__init__":
+                    continue
+                self._collect_func(func, label, entry_held)
+        for state, accs in self.accesses.items():
+            self.by_display.setdefault(state_display(state), []).append(state)
+
+    def _nested_call_locks(self, func: FuncInfo) -> Dict[str, frozenset]:
+        """{nested def name: locks held at EVERY local call site} — the
+        scanner modeled the nested body at its def site, so a helper
+        defined before a ``with cv:`` but only called inside it gets the
+        cv credited back here (intersection over call sites)."""
+        got = getattr(func, "_nested_call_locks", None)
+        if got is not None:
+            return got
+        out: Dict[str, frozenset] = {}
+        for cs in func.calls:
+            if len(cs.chain) != 1:
+                continue
+            name = cs.chain[0]
+            locks = self._lock_keys(cs.held_rt or cs.held, func)
+            cur = out.get(name)
+            out[name] = locks if cur is None else (cur & locks)
+        func._nested_call_locks = out
+        return out
+
+    def _collect_func(self, func: FuncInfo, label: str, entry_held: frozenset):
+        add = self._add
+        nested_locks = None
+        for a in func.attr_accesses:
+            state = self._attr_state(func, a.chain)
+            if state is None:
+                continue
+            locks = entry_held | self._lock_keys(a.held, func)
+            if a.nested is not None:
+                if nested_locks is None:
+                    nested_locks = self._nested_call_locks(func)
+                locks = locks | nested_locks.get(a.nested, frozenset())
+            add(state, label, a.kind, locks, a.node, func, a.const_store)
+        gc = self._global_candidates(func)
+        if gc:
+            for a in func.name_accesses:
+                if a.name not in gc:
+                    continue
+                if a.kind in ("store", "aug") and a.name not in func.global_decls:
+                    continue  # local shadow (filtered above, belt+braces)
+                state = ("global", func.module, a.name)
+                locks = entry_held | self._lock_keys(a.held, func)
+                add(state, label, a.kind, locks, a.node, func, False)
+
+    def _add(self, state, label, kind, locks, node, func, const_store):
+        self.accesses.setdefault(state, []).append(
+            Access(
+                state=state, root=label, kind=kind, locks=locks,
+                node=node, func=func, const_store=const_store,
+            )
+        )
+
+    # ------------------------------------------------------------- queries
+
+    def races(self):
+        """Yield (state, accesses, (s1, s2), roots) for every state node
+        with a concurrency conflict — RL017's firing condition,
+        pre-LOCKFREE. ``s1`` is a MUTATING write (aug/mutate: the only
+        access kinds that can corrupt — plain rebinds are GIL-atomic
+        publishes); ``s2`` is a conflicting access from a DIFFERENT
+        thread root with a disjoint lock set: another write always
+        conflicts, and when ``s1`` holds no lock at all, any access does
+        (an unguarded container mutation can corrupt a concurrent
+        reader). Witness pairs are deterministic (sorted by site) so
+        inline suppressions stay anchored."""
+        for state, accs in sorted(
+            self.accesses.items(), key=lambda kv: state_display(kv[0])
+        ):
+            roots = {a.root for a in accs}
+            if len(roots) < 2 or roots == {CALLER}:
+                continue
+            muts = sorted(
+                (a for a in accs if a.kind in ("aug", "mutate")),
+                key=_site_key,
+            )
+            if not muts:
+                continue
+            pair = None
+            for s1 in muts:
+                others = (
+                    a for a in accs
+                    if a.root != s1.root
+                    and not (s1.locks & a.locks)
+                    and (a.kind in _WRITE_KINDS or not s1.locks)
+                )
+                s2 = min(others, key=_site_key, default=None)
+                if s2 is not None:
+                    pair = (s1, s2)
+                    break
+            if pair is not None:
+                yield state, accs, pair, roots
+
+
+def _site_key(a: Access):
+    return (a.func.ctx.display_path, getattr(a.node, "lineno", 0), a.root)
+
+
+def _module_global_names(mi) -> set:
+    got = getattr(mi, "_global_name_cache", None)
+    if got is None:
+        got = set(mi.globals)
+        # module-scope assignments of any kind count (mi.globals only
+        # holds names with an inferred kind)
+        for stmt in mi.ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        got.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                got.add(stmt.target.id)
+        mi._global_name_cache = got
+    return got
+
+
+def get_model(index: ProjectIndex) -> ThreadModel:
+    model = getattr(index, "_thread_model", None)
+    if model is None:
+        model = ThreadModel(index)
+        index._thread_model = model
+    return model
+
+
+# ------------------------------------------------------------------- RL018
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckThenAct:
+    lock: str
+    attr: str
+    check_node: ast.AST
+    act_node: ast.AST
+    gate_node: ast.AST
+
+
+def check_then_act(index: ProjectIndex, info: FuncInfo) -> List[CheckThenAct]:
+    """The PR 14 credit-window bug shape: an attribute READ under ``with
+    L`` in one block, a WRITE of the same attribute under a SEPARATE
+    ``with L`` later in the same function, with the act gated by a test
+    on the checked value — the lock was released between the check and
+    the act, so the checked condition can be stale by the time the act
+    runs. Only fires when the gate demonstrably consumes the check (the
+    If/While test reads a local bound inside the check block, or the
+    attribute itself)."""
+    from ray_tpu._lint.dataflow import iter_expr
+
+    self_name = info.self_name
+    if self_name is None:
+        return []
+
+    blocks: List[Tuple[str, ast.With, set, set, set]] = []
+    for node in ast.walk(info.node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        keys = []
+        for item in node.items:
+            chain = _chain(item.context_expr)
+            if chain is None:
+                continue
+            k = index.lock_key(chain, info)
+            if k is not None:
+                keys.append(k)
+        if not keys:
+            continue
+        reads: set = set()
+        writes: set = set()
+        bound: set = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                chain = _chain(sub)
+                if chain and len(chain) == 2 and chain[0] == self_name:
+                    if isinstance(sub.ctx, ast.Load):
+                        reads.add(chain[1])
+                    else:
+                        writes.add(chain[1])
+            elif isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Attribute):
+                chain = _chain(sub.target)
+                if chain and len(chain) == 2 and chain[0] == self_name:
+                    writes.add(chain[1])
+                    reads.add(chain[1])
+            elif isinstance(sub, ast.Assign):
+                value_attrs = {
+                    c[1]
+                    for e in iter_expr(sub.value)
+                    if isinstance(e, ast.Attribute)
+                    for c in [_chain(e)]
+                    if c and len(c) == 2 and c[0] == self_name
+                }
+                if value_attrs:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            bound.add(t.id)
+        for k in keys:
+            blocks.append((k, node, reads, writes, bound))
+
+    blocks.sort(key=lambda b: b[1].lineno)
+    out: List[CheckThenAct] = []
+    for i, (lk1, n1, reads1, _w1, bound1) in enumerate(blocks):
+        for lk2, n2, _r2, writes2, _b2 in blocks[i + 1:]:
+            if lk1 != lk2 or n2 is n1 or n2.lineno <= n1.lineno:
+                continue
+            if _encloses(n1, n2) or _encloses(n2, n1):
+                continue  # nested withs share the outer critical section
+            common = reads1 & writes2
+            if not common:
+                continue
+            gate = _gate_between(info, n2, bound1, common, self_name)
+            if gate is None:
+                continue
+            attr = sorted(common)[0]
+            out.append(
+                CheckThenAct(
+                    lock=lk1, attr=attr, check_node=n1, act_node=n2,
+                    gate_node=gate,
+                )
+            )
+    return out
+
+
+def _chain(expr) -> Optional[Tuple[str, ...]]:
+    from ray_tpu._lint.index import dotted_parts
+
+    return dotted_parts(expr)
+
+
+def _encloses(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(sub is inner for sub in ast.walk(outer))
+
+
+def _gate_between(info, act_with, bound, attrs, self_name):
+    """The If/While ancestor of ``act_with`` whose test reads a name bound
+    in the check block or the checked attribute itself."""
+    from ray_tpu._lint.dataflow import iter_expr
+
+    ctx = info.ctx
+    for anc in ctx.ancestors(act_with):
+        if anc is info.node:
+            break
+        if not isinstance(anc, (ast.If, ast.While)):
+            continue
+        for e in iter_expr(anc.test):
+            if isinstance(e, ast.Name) and e.id in bound:
+                return anc
+            if isinstance(e, ast.Attribute):
+                c = _chain(e)
+                if c and len(c) == 2 and c[0] == self_name and c[1] in attrs:
+                    return anc
+    return None

@@ -29,7 +29,20 @@ This module builds the whole-program index those rules need:
   non-blocking — a bounded acquire cannot deadlock), and which locks were
   held at every call site and blocking-operation site;
 * **thread targets** — functions handed to ``threading.Thread(target=...)``
-  (the roots of the daemon-reachability closure RL011 uses);
+  (the roots of the daemon-reachability closure RL011 uses), including
+  ``target=lambda: self._loop()`` bodies, plus executor ``.submit()``
+  hand-offs (``exec_submits``) — together the spawn sites RL017's
+  thread-root model is built from;
+* **shared-state access sites** — every ``self.<attr>`` / annotated-param
+  ``state.<attr>`` read, store, aug-store and mutating method call, and
+  every module-global (``_underscore``/``UPPER``) name access, each with
+  the locks held at the site (``attr_accesses``/``name_accesses``) — the
+  raw material of RL017's guarded-by inference;
+* **wire-protocol sites** — message kinds produced (a ``("kind", ...)``
+  tuple literal reaching ``send``/``send_raw``/``conn_send``/``_send``,
+  directly or through one local/ternary hop) and message kinds handled
+  (``kind == "lit"`` comparisons on recv-rooted values) for RL019's
+  drift check;
 * **emitted observability names** — string literals passed to
   ``events.record``/``events.emit`` and to the ``Counter``/``Gauge``/
   ``Histogram`` constructors, declared ``METRIC_NAMES``/``EVENT_NAMES``
@@ -89,6 +102,28 @@ _BLOCKING_CALLS = {
 # it IS a metric export for RL012 purposes
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "safe_counter"}
 
+#: mutating container/queue methods: a call through an attribute chain
+#: ending in one of these WRITES the state the chain names (RL017's
+#: access-kind classification; dict.get/list indexing stay reads)
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "discard", "remove", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault", "put", "put_nowait",
+}
+
+#: names that look like module-global state (the repo's idiom is
+#: ``_underscore`` privates and ``UPPER`` constants); only these are
+#: recorded as name accesses to bound the index size
+_GLOBALISH_RE = re.compile(r"^(_[A-Za-z]|[A-Z][A-Z0-9_]*$)")
+
+#: executor receivers whose ``.submit(fn, ...)`` runs ``fn`` on another
+#: thread (RL017 thread roots)
+_EXECUTOR_RECV_RE = re.compile(r"(pool|executor)s?$", re.I)
+
+#: wire send functions; the message argument position is 1 for
+#: ``conn_send(conn, msg)`` / ``_enqueue_send(wh, msg)`` and 0 otherwise
+_SEND_FUNCS = {"send": 0, "send_raw": 0, "conn_send": 1, "_send": 0, "_enqueue_send": 1}
+
 #: repo docs that count as observability-name documentation for RL012
 DOC_FILES = ("OBSERVABILITY.md", "RESILIENCE.md")
 
@@ -100,6 +135,16 @@ PROMQL_SOURCE_MODULES = ("grafana", "slo", "dashboard")
 
 _DOC_NAME_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_.*{}]*)`")
 _PROM_REF_RE = re.compile(r"ray_tpu_([a-z][a-z0-9_]*)")
+
+
+def _is_head_subscript(expr: ast.AST) -> bool:
+    """``<name>[0]`` — the message-kind projection (RL019)."""
+    return (
+        isinstance(expr, ast.Subscript)
+        and isinstance(expr.value, ast.Name)
+        and isinstance(expr.slice, ast.Constant)
+        and expr.slice.value == 0
+    )
 
 
 def dotted_parts(node: ast.AST) -> Optional[Tuple[str, ...]]:
@@ -170,6 +215,10 @@ class CallSite:
     chain: Tuple[str, ...]
     node: ast.Call
     held: Tuple[Tuple[str, ...], ...]   # lock chains held at this call
+    #: like ``held`` but ALSO counting linear ``.acquire()``/``.release()``
+    #: bracketing (try/finally idiom) — used by RL017's guarded-by
+    #: inference only, so RL010/RL011 edge behavior is unchanged
+    held_rt: Tuple[Tuple[str, ...], ...] = ()
 
 
 @dataclasses.dataclass
@@ -203,6 +252,47 @@ class EmitSite:
     node: ast.AST
 
 
+@dataclasses.dataclass
+class AttrAccess:
+    """One shared-state access site (RL017): an attribute chain rooted at
+    ``self`` (alias-normalized) or at an annotated parameter."""
+
+    chain: Tuple[str, ...]
+    node: ast.AST
+    kind: str                 # 'read' | 'store' | 'aug' | 'mutate'
+    held: Tuple[Tuple[str, ...], ...]   # lock chains held (incl. acquire())
+    const_store: bool = False  # a plain store of a literal (atomic flag)
+    #: innermost enclosing NESTED def name, if any — the scanner models a
+    #: nested body at its def site, so the locks its LOCAL CALL SITES
+    #: hold are credited back by the thread model (``_take`` defined
+    #: before a ``with cv:`` but only called inside it)
+    nested: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NameAccess:
+    """One module-global access site (RL017); only ``_underscore``/``UPPER``
+    names are recorded (the repo's global idiom — see _GLOBALISH_RE)."""
+
+    name: str
+    node: ast.AST
+    kind: str                 # 'read' | 'store' | 'aug' | 'mutate'
+    held: Tuple[Tuple[str, ...], ...]
+
+
+@dataclasses.dataclass
+class MsgCompare:
+    """One ``<recv-rooted> == "kind"`` comparison (RL019 handler site).
+    ``root`` is ``"recv"`` when the compared value is recv-rooted inside
+    this function, or ``("msg", param)`` / ``("kind", param)`` when it
+    derives from a parameter — promoted to handled when a caller passes a
+    recv-rooted message / kind value at that position."""
+
+    kind: str
+    node: ast.AST
+    root: object
+
+
 class FuncInfo:
     """Everything the cross-module rules need to know about one def (or
     the module top-level scope, ``qualname == '<module>'``). The scan
@@ -231,6 +321,25 @@ class FuncInfo:
         self.self_reads: List[Tuple[str, ast.AST]] = []   # self.<attr> loads
         self.jit_sites: List[JitSite] = []
         self.thread_targets: List[Tuple[Tuple[str, ...], bool]] = []
+        self.exec_submits: List[Tuple[str, ...]] = []   # executor .submit(fn)
+        # RL017 raw material (see AttrAccess/NameAccess)
+        self.attr_accesses: List[AttrAccess] = []
+        self.name_accesses: List[NameAccess] = []
+        self.global_decls: set = set()        # names in `global` statements
+        self.param_names: set = (
+            {a.arg for a in args.args + args.kwonlyargs} if args is not None else set()
+        )
+        #: param name -> (module, class) from annotations (finalize pass)
+        self.param_classes: dict[str, Tuple[str, str]] = {}
+        # RL019 raw material
+        self.msg_sends: List[Tuple[str, ast.AST]] = []
+        #: sends whose tuple head is one of THIS function's parameters —
+        #: the kind arrives from callers (``_broadcast_rendezvous(msg_kind,
+        #: ...)``); promoted one call level by the rule
+        self.msg_param_sends: List[Tuple[str, ast.AST]] = []
+        self.msg_compares: List[MsgCompare] = []
+        self.recv_names: set = set()          # locals holding a recv'd message
+        self.kindvar_names: set = set()       # locals holding msg[0]
 
     @property
     def key(self) -> str:
@@ -249,6 +358,8 @@ class ClassInfo:
         self.methods: dict[str, FuncInfo] = {}
         # attr -> list of (in_init, kind-or-None, value node-or-None)
         self.attr_assigns: dict[str, list] = {}
+        # attr -> annotation source text (from `self.x: T = ...` sites)
+        self.attr_annotations: dict[str, str] = {}
         # attr -> (module, class) of a resolved project class
         self.attr_classes: dict[str, Tuple[str, str]] = {}
         # __init__ param name -> coarse kind from annotation/default
@@ -302,6 +413,7 @@ class ModuleInfo:
         self.globals: dict[str, str] = {}      # name -> kind (incl. 'lock')
         self.registries: dict[str, Tuple[list, ast.AST]] = {}
         self.lock_orders: List[Tuple[list, ast.AST]] = []
+        self.lockfree: List[Tuple[list, ast.AST]] = []   # RL017 declarations
         self.string_prom_refs: List[Tuple[str, ast.AST]] = []
         self.scope: Optional[FuncInfo] = None  # module top-level pseudo-func
 
@@ -327,17 +439,49 @@ class _FunctionScanner(ast.NodeVisitor):
         self.info = info
         self.index = index
         self.held: list[Tuple[str, ...]] = []
+        # linear .acquire()/.release() bracketing (try/finally idiom): a
+        # second stack layered on `held` for the runtime-access records
+        # only — the approximation (source order stands in for control
+        # flow) is fine for RL017's guarded-by inference but must not
+        # perturb RL010/RL011's with-nesting edges
+        self.acq_held: list[Tuple[str, ...]] = []
         self.self_aliases = {info.self_name} if info.self_name else set()
         # `sched = self.scheduler` — local handles onto member objects;
         # calls through them resolve like the spelled-out attribute chain
         self.attr_aliases: dict[str, Tuple[str, ...]] = {}
+        # `msg = ("task_done", p) if one else ("tasks_done_batch", b)` —
+        # locals holding kind-headed wire tuples (RL019 send extraction)
+        self.tuple_kind_locals: dict[str, Tuple[str, ...]] = {}
+        self.nested_defs: list[str] = []  # names of enclosing nested defs
         self.root = info.node
         self.module_scope = isinstance(info.node, ast.Module)
+
+    def _held_rt(self) -> Tuple[Tuple[str, ...], ...]:
+        return tuple(self.held) + tuple(self.acq_held)
 
     # -- helpers --
 
     def _is_lockish(self, chain: Tuple[str, ...]) -> bool:
-        return bool(LOCK_ATTR_RE.search(chain[-1]))
+        """Lock-ish by NAME (*_lock/mutex/cv/...), or by CONSTRUCTOR for
+        self-attrs the class table shows assigned from threading.Lock()
+        and friends — PR 14 named its window-build serializer
+        ``_submit_send`` (what it serializes, not what it is), and the
+        lock graph must still see it (methods scan after __init__ in
+        source order, so the ctor evidence is normally present)."""
+        if LOCK_ATTR_RE.search(chain[-1]):
+            return True
+        cls = self.info.cls
+        if cls is None or len(chain) < 2:
+            return False
+        norm = self._self_chain(chain)
+        if norm is None or len(norm) != 2:
+            return False
+        for _in_init, _k, value in cls.attr_assigns.get(norm[1], []):
+            if isinstance(value, ast.Call):
+                d = dotted_parts(value.func)
+                if d and d[-1] in ("Lock", "RLock", "Condition", "Semaphore"):
+                    return True
+        return False
 
     def _self_chain(self, chain: Tuple[str, ...]) -> Optional[Tuple[str, ...]]:
         """Normalize an alias-rooted chain (``runner.arch`` after
@@ -362,11 +506,66 @@ class _FunctionScanner(ast.NodeVisitor):
         root = self.info.self_name or "self"
         return (root,) + norm[1:]
 
+    def _access_chain(self, chain: Tuple[str, ...]) -> Optional[Tuple[str, ...]]:
+        """Normalize a chain to a recordable shared-state access: rooted at
+        the real self name, or at a parameter (``state.reply_buf`` in a
+        ``def f(state: WorkerState)``); None for locals/imports."""
+        if not chain or len(chain) < 2:
+            return None
+        norm = self._norm(chain)
+        root = norm[0]
+        info = self.info
+        if info.self_name is not None and root == info.self_name:
+            return norm
+        if root in info.param_names and root != info.self_name:
+            return norm
+        return None
+
+    def _record_access(
+        self, chain: Tuple[str, ...], node: ast.AST, kind: str,
+        const_store: bool = False,
+    ) -> None:
+        norm = self._access_chain(chain)
+        if norm is not None:
+            self.info.attr_accesses.append(
+                AttrAccess(
+                    chain=norm, node=node, kind=kind, held=self._held_rt(),
+                    const_store=const_store,
+                    nested=self.nested_defs[-1] if self.nested_defs else None,
+                )
+            )
+        elif len(chain) == 1 and _GLOBALISH_RE.match(chain[0]):
+            self.info.name_accesses.append(
+                NameAccess(
+                    name=chain[0], node=node, kind=kind, held=self._held_rt()
+                )
+            )
+
+    def _wire_kinds(self, expr: ast.AST) -> Tuple[str, ...]:
+        """Message kinds an expression can be: a kind-headed tuple literal,
+        a ternary of those, or a local bound to one (RL019 send sites)."""
+        if isinstance(expr, ast.Tuple) and expr.elts:
+            h = expr.elts[0]
+            if isinstance(h, ast.Constant) and isinstance(h.value, str):
+                return (h.value,)
+            return ()
+        if isinstance(expr, ast.IfExp):
+            return self._wire_kinds(expr.body) + self._wire_kinds(expr.orelse)
+        if isinstance(expr, ast.Name):
+            return self.tuple_kind_locals.get(expr.id, ())
+        return ()
+
     # -- structure --
 
     def visit_FunctionDef(self, node):
-        if node is self.root or not self.module_scope:
+        if node is self.root:
             self.generic_visit(node)
+        elif not self.module_scope:
+            self.nested_defs.append(node.name)
+            try:
+                self.generic_visit(node)
+            finally:
+                self.nested_defs.pop()
         # module scope skips top-level defs: they get their own FuncInfo
 
     visit_AsyncFunctionDef = visit_FunctionDef
@@ -387,29 +586,179 @@ class _FunctionScanner(ast.NodeVisitor):
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Name):
                         self.attr_aliases[tgt.id] = vnorm
+        # RL019 provenance: `msg = conn.recv()` / `k, p = conn.recv()` /
+        # `kind = msg[0]` / a local bound to a kind-headed wire tuple
+        if isinstance(v, ast.Call):
+            c = dotted_parts(v.func)
+            if c and c[-1] in ("recv", "read_available"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.info.recv_names.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple) and tgt.elts and isinstance(
+                        tgt.elts[0], ast.Name
+                    ):
+                        self.info.kindvar_names.add(tgt.elts[0].id)
+        elif _is_head_subscript(v):
+            base = v.value.id
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if base in self.info.recv_names:
+                    self.info.kindvar_names.add(tgt.id)
+                elif base in self.info.param_names:
+                    self.tuple_kind_locals.pop(tgt.id, None)
+                    self._param_kindvars()[tgt.id] = base
+        kinds = self._wire_kinds(v)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if kinds:
+                    self.tuple_kind_locals[tgt.id] = kinds
+                else:
+                    # rebinding to a non-kind value invalidates the local:
+                    # a later send of it must not report a phantom kind
+                    self.tuple_kind_locals.pop(tgt.id, None)
         for tgt in node.targets:
             if isinstance(tgt, (ast.Tuple, ast.List)):
                 for elt in tgt.elts:
                     self._record_attr_assign(elt, None)
+            elif isinstance(tgt, ast.Subscript):
+                # `self._pending[seq] = slot` / `_rings[id(r)] = r` mutate
+                # the container the base chain names
+                bchain = dotted_parts(tgt.value)
+                if bchain:
+                    self._record_access(self._norm(bchain), node, "mutate")
             else:
                 self._record_attr_assign(tgt, v)
+                if isinstance(tgt, ast.Name) and _GLOBALISH_RE.match(tgt.id):
+                    self.info.name_accesses.append(
+                        NameAccess(tgt.id, node, "store", self._held_rt())
+                    )
         self.generic_visit(node)
+
+    def _param_kindvars(self) -> dict:
+        got = getattr(self.info, "_param_kindvars", None)
+        if got is None:
+            got = self.info._param_kindvars = {}
+        return got
 
     def visit_AnnAssign(self, node):
         if node.value is not None:
             self._record_attr_assign(node.target, node.value)
+        if isinstance(node.target, ast.Attribute) and self.info.cls is not None:
+            chain = dotted_parts(node.target)
+            norm = self._self_chain(chain) if chain else None
+            if norm is not None and len(norm) == 2:
+                try:
+                    self.info.cls.attr_annotations.setdefault(
+                        norm[1], ast.unparse(node.annotation)
+                    )
+                except Exception:
+                    pass
         self.generic_visit(node)
 
     def visit_AugAssign(self, node):
-        self._record_attr_assign(node.target, None)
+        self._record_attr_assign(node.target, None, record_access=False)
+        tgt = node.target
+        if isinstance(tgt, ast.Attribute):
+            chain = dotted_parts(tgt)
+            if chain:
+                self._record_access(self._norm(chain), node, "aug")
+        elif isinstance(tgt, ast.Name) and _GLOBALISH_RE.match(tgt.id):
+            self.info.name_accesses.append(
+                NameAccess(tgt.id, node, "aug", self._held_rt())
+            )
+        elif isinstance(tgt, ast.Subscript):
+            bchain = dotted_parts(tgt.value)
+            if bchain:
+                self._record_access(self._norm(bchain), node, "mutate")
         self.generic_visit(node)
 
-    def _record_attr_assign(self, tgt: ast.AST, value: Optional[ast.AST]) -> None:
+    def visit_Global(self, node):
+        self.info.global_decls.update(node.names)
+
+    def visit_For(self, node):
+        # `for msg in reader.read_available():` — the loop target is a
+        # recv-rooted message (RL019)
+        it = node.iter
+        rooted = False
+        if isinstance(it, ast.Call):
+            c = dotted_parts(it.func)
+            rooted = bool(c) and c[-1] in ("recv", "read_available")
+        elif isinstance(it, ast.Name):
+            rooted = it.id in self.info.recv_names
+        if rooted and isinstance(node.target, ast.Name):
+            self.info.recv_names.add(node.target.id)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_Compare(self, node):
+        # `<kind-var> == "lit"` / `msg[0] != "lit"` / `kind in ("a", "b")`
+        # — RL019 handler sites, counted only for recv-/param-rooted values
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.Eq, ast.NotEq, ast.In)):
+            lits: list[str] = []
+            sides = [node.left, node.comparators[0]]
+            expr = None
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    lits.append(s.value)
+                elif isinstance(s, ast.Tuple) and isinstance(node.ops[0], ast.In):
+                    lits.extend(
+                        e.value
+                        for e in s.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    )
+                else:
+                    expr = s
+            if lits and expr is not None:
+                root = self._kind_root(expr)
+                if root is not None:
+                    for lit in lits:
+                        self.info.msg_compares.append(
+                            MsgCompare(kind=lit, node=node, root=root)
+                        )
+        self.generic_visit(node)
+
+    def _kind_root(self, expr: ast.AST) -> Optional[object]:
+        info = self.info
+        if _is_head_subscript(expr):
+            base = expr.value.id
+            if base in info.recv_names:
+                return "recv"
+            if base in info.param_names and base != info.self_name:
+                return ("msg", base)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in info.kindvar_names:
+                return "recv"
+            pk = getattr(info, "_param_kindvars", None)
+            if pk and expr.id in pk:
+                return ("msg", pk[expr.id])
+        return None
+
+    def visit_Name(self, node):
+        if (
+            isinstance(node.ctx, ast.Load)
+            and _GLOBALISH_RE.match(node.id)
+            and node.id not in self.info.param_names
+        ):
+            self.info.name_accesses.append(
+                NameAccess(node.id, node, "read", self._held_rt())
+            )
+
+    def _record_attr_assign(
+        self, tgt: ast.AST, value: Optional[ast.AST], record_access: bool = True
+    ) -> None:
         if not isinstance(tgt, ast.Attribute):
             return  # rebinding a local (even a self-alias) mutates no attr
         chain = dotted_parts(tgt)
         if not chain:
             return
+        if record_access:
+            self._record_access(
+                chain, tgt, "store",
+                const_store=isinstance(value, ast.Constant),
+            )
         norm = self._self_chain(chain)
         cls = self.info.cls
         if norm is not None and len(norm) == 2 and cls is not None:
@@ -486,16 +835,66 @@ class _FunctionScanner(ast.NodeVisitor):
                         via_with=False, held=tuple(self.held),
                     )
                 )
+                self.acq_held.append(chain[:-1])
+            if (
+                chain[-1] == "release"
+                and len(chain) > 1
+                and self._is_lockish(chain[:-1])
+                and chain[:-1] in self.acq_held
+            ):
+                self.acq_held.remove(chain[:-1])
             if chain[-1] == "Thread":
                 target = None
                 daemon = False
                 for kw in node.keywords:
                     if kw.arg == "target":
                         target = dotted_parts(kw.value)
+                        if target is None and isinstance(kw.value, ast.Lambda):
+                            # target=lambda: self._loop() — the body call is
+                            # the real thread root
+                            body = kw.value.body
+                            if isinstance(body, ast.Call):
+                                target = dotted_parts(body.func)
+                        if target is not None:
+                            target = self._norm(target)
                     elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
                         daemon = bool(kw.value.value)
                 if target is not None:
                     self.info.thread_targets.append((target, daemon))
+            if (
+                chain[-1] == "submit"
+                and len(chain) > 1
+                and _EXECUTOR_RECV_RE.search(chain[-2])
+                and node.args
+            ):
+                t = dotted_parts(node.args[0])
+                if t is not None:
+                    self.info.exec_submits.append(self._norm(t))
+            if chain[-1] == "run_in_executor" and len(node.args) >= 2:
+                t = dotted_parts(node.args[1])
+                if t is None and isinstance(node.args[1], ast.Call):
+                    # functools.partial(fn, ...) — unwrap to fn
+                    inner = dotted_parts(node.args[1].func)
+                    if inner and inner[-1] == "partial" and node.args[1].args:
+                        t = dotted_parts(node.args[1].args[0])
+                if t is not None:
+                    self.info.exec_submits.append(self._norm(t))
+            if chain[-1] in MUTATING_METHODS and len(chain) >= 2:
+                self._record_access(chain[:-1], node, "mutate")
+            send_arg = _SEND_FUNCS.get(chain[-1])
+            if send_arg is not None and len(node.args) > send_arg:
+                marg = node.args[send_arg]
+                kinds = self._wire_kinds(marg)
+                for kind in kinds:
+                    self.info.msg_sends.append((kind, node))
+                if (
+                    not kinds
+                    and isinstance(marg, ast.Tuple)
+                    and marg.elts
+                    and isinstance(marg.elts[0], ast.Name)
+                    and marg.elts[0].id in self.info.param_names
+                ):
+                    self.info.msg_param_sends.append((marg.elts[0].id, node))
             site = self.index._jit_site_from_call(node)
             if site is not None:
                 self.info.jit_sites.append(site)
@@ -511,7 +910,10 @@ class _FunctionScanner(ast.NodeVisitor):
             if emit is not None:
                 self.index.emits.append((emit, self.info))
             self.info.calls.append(
-                CallSite(chain=chain, node=node, held=tuple(self.held))
+                CallSite(
+                    chain=chain, node=node, held=tuple(self.held),
+                    held_rt=self._held_rt(),
+                )
             )
         self.generic_visit(node)
 
@@ -522,6 +924,7 @@ class _FunctionScanner(ast.NodeVisitor):
                 norm = self._self_chain(chain)
                 if norm is not None and len(norm) >= 2:
                     self.info.self_reads.append((norm[1], node))
+                self._record_access(chain, node, "read")
         self.generic_visit(node)
 
 
@@ -545,6 +948,7 @@ class ProjectIndex:
         self._deferred_mutations: list = []
         self._deferred_attr_ctors: list = []
         self._deferred_param_anns: list = []
+        self._deferred_func_param_anns: list = []
         self._locks_memo: dict[str, frozenset] = {}
         self._block_memo: dict[str, list] = {}
         for ctx in contexts:
@@ -618,6 +1022,8 @@ class ProjectIndex:
             d = dotted_parts(v.func)
             if d and d[-1] in ("Lock", "RLock", "Condition", "Semaphore"):
                 kind = "lock"
+            elif d and d[-1] in ("Event", "Queue", "SimpleQueue", "LifoQueue"):
+                kind = "sync"  # internally synchronized, not lockable
         for name in names:
             if kind:
                 mi.globals[name] = kind
@@ -637,14 +1043,29 @@ class ProjectIndex:
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)
                 ]
                 mi.lock_orders.append((vals, stmt))
+            if name == "LOCKFREE" and isinstance(v, (ast.Tuple, ast.List)):
+                vals = [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                mi.lockfree.append((vals, stmt))
 
     def _scan_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
         ci = ClassInfo(node, mi.ctx, mi.module)
         mi.classes[node.name] = ci
         self.classes[ci.key] = ci
-        for stmt in node.body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._add_function(mi, stmt, cls=ci)
+        # __init__ scans FIRST regardless of source position: the
+        # scanner's ctor-typed lock classification (_is_lockish) reads
+        # the attr table mid-scan, and a method defined above __init__
+        # must still see `self._submit_send = threading.Lock()` evidence
+        methods = [
+            s for s in node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        methods.sort(key=lambda s: s.name != "__init__")
+        for stmt in methods:
+            self._add_function(mi, stmt, cls=ci)
         init = ci.methods.get("__init__")
         if init is None:
             return
@@ -677,6 +1098,15 @@ class ProjectIndex:
             site = self._jit_decorator(dec, info)
             if site is not None:
                 self.jit_sites.append((site, info))
+        # param annotations resolve to project classes in _finalize (the
+        # annotated class may live in a module not yet scanned) — this is
+        # what anchors `state.reply_buf` / `ctx._fail_submits()` chains in
+        # worker_main-style module functions
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                if a.annotation is not None:
+                    self._deferred_func_param_anns.append((info, a.arg, a.annotation))
         _FunctionScanner(info, self).visit(node)
 
     def _finalize(self) -> None:
@@ -694,6 +1124,13 @@ class ProjectIndex:
             ck = self._class_from_annotation(ann, mi)
             if ck is not None:
                 ci.param_classes.setdefault(pname, ck)
+        for info, pname, ann in self._deferred_func_param_anns:
+            mi = self.modules.get(info.module)
+            if mi is None:
+                continue
+            ck = self._class_from_annotation(ann, mi)
+            if ck is not None:
+                info.param_classes.setdefault(pname, ck)
         # ctor-callsite param→class inference; two sweeps so attr_classes
         # resolved in sweep 1 feed argument chains resolved in sweep 2
         for _ in range(2):
@@ -952,6 +1389,18 @@ class ProjectIndex:
                 if ck is not None:
                     return f"{ck[1]}.{chain[2]}"
             return f"{info.cls.name}.{'.'.join(chain[1:])}"
+        if chain[0] in info.param_classes and len(chain) >= 2:
+            # annotated-parameter root: `state.reply_send` in a module
+            # function `def f(state: WorkerState)` owns like self chains
+            ck = info.param_classes[chain[0]]
+            if len(chain) == 2:
+                return f"{ck[1]}.{chain[1]}"
+            owner = self.classes.get(ck)
+            if owner is not None and len(chain) == 3:
+                ck2 = owner.attr_classes.get(chain[1])
+                if ck2 is not None:
+                    return f"{ck2[1]}.{chain[2]}"
+            return None
         if len(chain) == 1:
             if mi and mi.globals.get(chain[0]) == "lock":
                 return f"{info.module}.{chain[0]}"
@@ -990,6 +1439,19 @@ class ProjectIndex:
                     owner = self.classes.get(ck)
                     if owner is not None:
                         return owner.methods.get(chain[2])
+            return None
+        if chain[0] in info.param_classes and len(chain) in (2, 3):
+            # `ctx._fail_submits(...)` / `state.ctx.send_raw(...)` in a
+            # module function with annotated params
+            owner = self.classes.get(info.param_classes[chain[0]])
+            if owner is not None:
+                if len(chain) == 2:
+                    return owner.methods.get(chain[1])
+                ck2 = owner.attr_classes.get(chain[1])
+                if ck2 is not None:
+                    owner2 = self.classes.get(ck2)
+                    if owner2 is not None:
+                        return owner2.methods.get(chain[2])
             return None
         if len(chain) == 1:
             if chain[0] in mi.functions:
@@ -1143,6 +1605,16 @@ class ProjectIndex:
         out = []
         for mi in self.modules.values():
             for vals, node in mi.lock_orders:
+                out.append((mi.module, vals, node, mi.ctx))
+        return out
+
+    def lockfree_decls(self):
+        """Declared RL017 exemptions: (module, entries, anchor, ctx). An
+        entry is ``"Owner._attr"`` / ``"<module>.<global>"``, optionally
+        qualified ``"...: atomic"`` — see concurrency.parse_lockfree."""
+        out = []
+        for mi in self.modules.values():
+            for vals, node in mi.lockfree:
                 out.append((mi.module, vals, node, mi.ctx))
         return out
 

@@ -1425,3 +1425,346 @@ class UnreleasedResourceOnRaise(ProjectRule):
                     f"{'/'.join(a.release_methods)}() and no with/finally "
                     "covers it; release it on the exception path",
                 )
+
+
+# ---------------------------------------------------------------------------
+# RL017-RL019: thread/ownership + wire-protocol rules (phase 1.9,
+# ray_tpu._lint.concurrency)
+# ---------------------------------------------------------------------------
+
+
+# --------------------------------------------------------------------- RL017
+
+
+@register
+class CrossThreadRace(ProjectRule):
+    id = "RL017"
+    name = "cross-thread-race"
+    description = (
+        "A mutable attribute or module global is MUTATED (augmented "
+        "assignment / container mutation — the access kinds that corrupt; "
+        "plain rebinds are GIL-atomic publishes) from one thread root "
+        "while another root writes it under a disjoint lock set — or "
+        "accesses it at all when the mutation holds no lock. Thread roots "
+        "come from the index's spawn sites (threading.Thread targets "
+        "incl. lambdas, executor .submit()/run_in_executor hand-offs) "
+        "plus the external-caller surface; guards come from RacerD-style "
+        "guarded-by inference over per-site held-lock sets, including "
+        "linear .acquire()/.release() bracketing and locks inherited "
+        "through the call graph. __init__ is pre-publication; attributes "
+        "holding Queue/Event/Lock-style primitives are internally "
+        "synchronized; both are exempt. Deliberate lock-free designs are "
+        "DECLARED in a module-level LOCKFREE tuple (like LOCK_ORDER) and "
+        "VERIFIED: a bare 'Owner._attr' entry asserts single-writer (≥2 "
+        "writing roots is an error), 'Owner._attr: atomic' asserts every "
+        "write is one GIL-atomic operation (a read-modify-write += fails "
+        "verification), and an entry matching no accessed state is "
+        "stale. Anything else gets a lock, or an inline suppression with "
+        "a written justification."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import concurrency
+
+        model = concurrency.get_model(index)
+        declared: dict = {}
+        for module, entries, node, ctx in index.lockfree_decls():
+            for entry in entries:
+                key, qual = concurrency.parse_lockfree(entry)
+                if "." not in key:
+                    # a bare name declares a global of the DECLARING module
+                    key = f"{module}.{key}"
+                declared[key] = (qual, node, ctx, module, entry)
+
+        for state, accs, (s1, s2), roots in model.races():
+            key = concurrency.state_display(state)
+            if key in declared:
+                continue  # verified separately below
+            # anchor at the LESS-guarded write when there is one (that is
+            # where the fix or the justified suppression belongs — e.g. a
+            # test-hook reset racing a locked hot path)
+            if s2.kind != "read" and len(s2.locks) <= len(s1.locks):
+                s1, s2 = s2, s1
+            l1 = ",".join(sorted(s1.locks)) or "no lock"
+            l2 = ",".join(sorted(s2.locks)) or "no lock"
+            verb1 = "read" if s1.kind == "read" else (
+                "mutated" if s1.kind in ("aug", "mutate") else "written"
+            )
+            verb2 = "reads" if s2.kind == "read" else (
+                "mutates" if s2.kind in ("aug", "mutate") else "writes"
+            )
+            yield s1.func.ctx.violation(
+                self, s1.node,
+                f"cross-thread race on {key}: {verb1} at "
+                f"{s1.func.ctx.display_path}:{s1.node.lineno} "
+                f"[{s1.root}, {l1}] while "
+                f"{s2.func.ctx.display_path}:{s2.node.lineno} "
+                f"[{s2.root}, {l2}] {verb2} it with no "
+                f"common lock (state touched from {len(roots)} roots: "
+                f"{', '.join(sorted(roots))}); guard it with one lock, or "
+                "declare the lock-free design in LOCKFREE with a "
+                "justification",
+            )
+
+        # verify the declarations themselves
+        seen_keys = set(model.by_display)
+        for key, (qual, node, ctx, module, entry) in sorted(declared.items()):
+            if qual not in (None, "atomic"):
+                yield ctx.violation(
+                    self, node,
+                    f"LOCKFREE entry {entry!r} has unknown qualifier "
+                    f"{qual!r} (use a bare 'Owner._attr' for single-writer "
+                    "or 'Owner._attr: atomic')",
+                )
+                continue
+            if key not in seen_keys:
+                yield ctx.violation(
+                    self, node,
+                    f"LOCKFREE entry {key!r} matches no accessed "
+                    "attribute/global anywhere in the project — stale or "
+                    "misspelled (entries use Owner._attr / module.global "
+                    "naming, like lock keys)",
+                )
+                continue
+            accs = [
+                a
+                for st in model.by_display[key]
+                for a in model.accesses[st]
+            ]
+            wr = [a for a in accs if a.kind in ("store", "aug", "mutate")]
+            if qual is None:
+                wroots = {a.root for a in wr}
+                if len(wroots) >= 2:
+                    w0 = self._pick(wr, prefer_not=concurrency.CALLER)
+                    yield ctx.violation(
+                        self, node,
+                        f"LOCKFREE entry {key!r} declares single-writer "
+                        f"but it is written from {len(wroots)} thread "
+                        f"roots ({', '.join(sorted(wroots))} — e.g. "
+                        f"{w0.func.ctx.display_path}:{w0.node.lineno}); "
+                        "the declaration no longer holds: add a lock or "
+                        "re-justify as ': atomic'",
+                    )
+            else:  # atomic
+                bad = [a for a in wr if a.kind == "aug"]
+                if bad:
+                    yield ctx.violation(
+                        self, node,
+                        f"LOCKFREE entry {key!r} declares atomic "
+                        "single-operation writes but "
+                        f"{bad[0].func.ctx.display_path}:"
+                        f"{bad[0].node.lineno} is a read-modify-write "
+                        "augmented assignment — not atomic under "
+                        "preemption; use a lock or a single-writer design",
+                    )
+
+    def _pick(self, accs, prefer_not: str):
+        for a in accs:
+            if a.root != prefer_not:
+                return a
+        return accs[0]
+
+
+# --------------------------------------------------------------------- RL018
+
+
+@register
+class AtomicityViolation(ProjectRule):
+    id = "RL018"
+    name = "check-then-act"
+    description = (
+        "An attribute is READ under `with <lock>` in one block and "
+        "WRITTEN under a SEPARATE `with <lock>` later in the same "
+        "function, with the write gated by a test on the checked value — "
+        "the lock was RELEASED between the check and the act, so the "
+        "condition can be stale by the time the act runs (the PR 14 "
+        "credit-window / _sent_hdrs review-round bug shape: a double "
+        "decrement driven by a check another thread already consumed). "
+        "Narrow by design: only fires when the gate demonstrably reads a "
+        "local bound inside the check block or the attribute itself. Fix "
+        "by re-checking under the second acquisition (and acting on the "
+        "re-checked value), or by widening one critical section over "
+        "check and act."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import concurrency
+
+        for info in _analyzable_functions(index):
+            for hit in concurrency.check_then_act(index, info):
+                yield info.ctx.violation(
+                    self, hit.act_node,
+                    f"check-then-act on {hit.attr!r}: checked under "
+                    f"{hit.lock} at line {hit.check_node.lineno}, lock "
+                    "released, then acted on at line "
+                    f"{hit.act_node.lineno} under a fresh acquisition "
+                    f"(gate at line {hit.gate_node.lineno}) — the checked "
+                    "condition can be stale; re-check under the second "
+                    "acquisition or widen the critical section",
+                )
+
+
+# --------------------------------------------------------------------- RL019
+
+
+#: send-side buffered-structure attribute names the reconnect axe audits
+_WIRE_BUFFER_RE = re.compile(r"(^|_)(buf|buffer|outbox|unacked)(s)?$")
+
+#: functions that count as a sweep/recovery path for buffered wire state
+_SWEEP_FN_RE = re.compile(r"(fail|reconnect|flush|drain|sweep|retry|requeue)", re.I)
+
+
+@register
+class ProtocolMessageDrift(ProjectRule):
+    id = "RL019"
+    name = "protocol-message-drift"
+    description = (
+        "The wire protocol's send sites and dispatch tables must agree. "
+        "The index records every message kind PRODUCED (a ('kind', ...) "
+        "tuple literal reaching send/send_raw/conn_send/_send, directly "
+        "or through one local/ternary hop) and every kind HANDLED (a "
+        "kind == 'lit' comparison on a recv-rooted value: a local from "
+        "conn.recv()/reader.read_available(), its [0] projection, or a "
+        "parameter a caller fills with one — promoted one call level). "
+        "Fires on: a kind sent that no dispatch handles (the message is "
+        "silently dropped by every recv loop), and a handler for a kind "
+        "nothing sends (dead protocol — RL012's name-drift discipline "
+        "applied to the wire). The reconnect axe: a send-side buffered "
+        "structure (submit outbox, reply batch, un-acked window map — "
+        "*_buf/*_outbox/*_unacked attributes in modules that send) with "
+        "no sweep reachable from any fail/reconnect/flush/drain-named "
+        "function leaks its contents forever when the connection dies. "
+        "Single-file scans are guarded: with no send (or no handler) "
+        "sites in view, the opposite direction is not judged."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        sends: dict = {}
+        handled: dict = {}
+        param_compares: dict = {}  # (func key, param) -> [(kind, node, func)]
+        param_senders: dict = {}   # func key -> set of kind-carrying params
+        for info in index.functions.values():
+            for kind, node in info.msg_sends:
+                sends.setdefault(kind, []).append((node, info))
+            for pname, _node in info.msg_param_sends:
+                param_senders.setdefault(info.key, set()).add(pname)
+            for mc in info.msg_compares:
+                if mc.root == "recv":
+                    handled.setdefault(mc.kind, []).append((mc.node, info))
+                elif isinstance(mc.root, tuple) and mc.root[0] == "msg":
+                    param_compares.setdefault(
+                        (info.key, mc.root[1]), []
+                    ).append((mc.kind, mc.node, info))
+        # one-level promotion, both directions: a parameter a caller
+        # fills with a recv-rooted message counts as recv-rooted in the
+        # callee (handler side); a string literal a caller passes at a
+        # kind-carrying parameter position counts as a send of that kind
+        # (send side — the _broadcast_rendezvous("profile", ...) shape)
+        if param_compares or param_senders:
+            for info in index.functions.values():
+                for cs in info.calls:
+                    callee = index.resolve_call(info, cs.chain)
+                    if callee is None:
+                        continue
+                    args = getattr(callee.node, "args", None)
+                    if args is None:
+                        continue
+                    params = [a.arg for a in args.args]
+                    shift = 1 if callee.self_name is not None else 0
+                    sender_params = param_senders.get(callee.key)
+                    for i, arg in enumerate(cs.node.args):
+                        pi = i + shift
+                        if pi >= len(params):
+                            continue
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in info.recv_names
+                        ):
+                            got = param_compares.get((callee.key, params[pi]))
+                            if got:
+                                for kind, node, owner in got:
+                                    handled.setdefault(kind, []).append(
+                                        (node, owner)
+                                    )
+                        elif (
+                            sender_params
+                            and params[pi] in sender_params
+                            and isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)
+                        ):
+                            sends.setdefault(arg.value, []).append(
+                                (cs.node, info)
+                            )
+        if sends and handled:
+            for kind in sorted(sends):
+                if kind in handled:
+                    continue
+                node, info = sends[kind][0]
+                yield info.ctx.violation(
+                    self, node,
+                    f"message kind {kind!r} is sent here but no recv-loop "
+                    "dispatch anywhere in the project handles it — every "
+                    "receiver silently drops it (or the handler's compare "
+                    "is not recv-rooted and the index cannot see it)",
+                )
+            for kind in sorted(handled):
+                if kind in sends:
+                    continue
+                node, info = handled[kind][0]
+                yield info.ctx.violation(
+                    self, node,
+                    f"dispatch handles message kind {kind!r} but nothing "
+                    "in the project sends it — dead protocol (or the send "
+                    "site builds the tuple too dynamically for the index; "
+                    "route it through a kind-headed literal)",
+                )
+        yield from self._reconnect_sweeps(index, sends)
+
+    def _reconnect_sweeps(self, index, sends: dict) -> Iterator[Violation]:
+        if not sends:
+            return
+        send_modules = {info.module for sites in sends.values() for _n, info in sites}
+        # attribute names referenced anywhere inside sweep-named functions
+        # (their nested defs fold in) and their directly-resolvable callees
+        swept: set = set()
+        sweep_funcs = [
+            f for f in index.functions.values() if _SWEEP_FN_RE.search(f.name)
+        ]
+        seen: set = set()
+        frontier = list(sweep_funcs)
+        depth = 0
+        while frontier and depth < 3:
+            nxt = []
+            for f in frontier:
+                if f.key in seen:
+                    continue
+                seen.add(f.key)
+                for a in f.attr_accesses:
+                    swept.add(a.chain[-1])
+                for call in f.calls:
+                    callee = index.resolve_call(f, call.chain)
+                    if callee is not None and callee.key not in seen:
+                        nxt.append(callee)
+            frontier = nxt
+            depth += 1
+        for (module, cname), ci in sorted(index.classes.items()):
+            if module not in send_modules:
+                continue
+            for attr, assigns in sorted(ci.attr_assigns.items()):
+                if not _WIRE_BUFFER_RE.search(attr):
+                    continue
+                if attr in swept:
+                    continue
+                anchor = next(
+                    (v for _init, _k, v in assigns if v is not None), None
+                )
+                node = anchor if anchor is not None else ci.node
+                yield ci.ctx.violation(
+                    self, node,
+                    f"buffered wire structure {cname}.{attr} has no sweep "
+                    "reachable from any fail/reconnect/flush/drain path — "
+                    "a connection loss strands whatever it buffered "
+                    "(refs never resolve, completions never re-ship); "
+                    "fail or re-ship its contents from the reconnect "
+                    "sweep (_fail_submits/_try_reconnect shape)",
+                )

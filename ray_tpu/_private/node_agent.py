@@ -43,6 +43,13 @@ def shutdown_conn(conn) -> None:
         pass
 
 
+#: raylint RL017 — _procs is appended/pruned-by-rebind ONLY on the agent's
+#: run thread; the stack-dump thread takes a GIL-atomic list snapshot
+#: (iteration over either the old or the rebound list is correct — dumps
+#: are best-effort diagnostics)
+LOCKFREE = ("NodeAgent._procs: atomic",)
+
+
 class NodeAgent:
     def __init__(
         self,

@@ -34,6 +34,21 @@ _ctx_lock = threading.Lock()
 #: (ISSUE 14): window credits left before a submit flush blocks for acks
 METRIC_NAMES = ("core_submit_credits",)
 
+#: Canonical lock order of the client-side submit plane (PR 14), outermost
+#: first — raylint RL010 checks every acquisition edge against it and
+#: RL017 resolves these locks to their owners. ``_flush_submits`` is the
+#: shape that fixes the order: the window is built under ``_submit_send``
+#: (FIFO end to end) with ``_submit_cv`` taken inside it for buffer/credit
+#: state, and the wire write happens under ``_send_lock`` with the cv
+#: RELEASED (the recv thread must be able to process submit_acks while a
+#: send blocks on a full socket — the PR 14 review-round deadlock).
+LOCK_ORDER = (
+    "WorkerContext._submit_send",   # window build+send serialization
+    "WorkerContext._submit_cv",     # submit buffer / credit window state
+    "WorkerContext._send_lock",     # one writer on the conn at a time
+    "WorkerContext._pending_lock",  # blocking-call reply slots
+)
+
 _CREDIT_GAUGE = None
 
 #: gc-queue wake sent by ObjectRef.__del__ on the free buffer's

@@ -77,6 +77,11 @@ class RuntimeEnvPlugin:
 
 _PLUGINS: dict[str, RuntimeEnvPlugin] = {}
 
+#: raylint RL017 — plugin registration is an import-time dict store on the
+#: driver; worker task bodies only READ it (dict get is GIL-atomic), and a
+#: registration racing a running task is a caller error by contract
+LOCKFREE = ("_PLUGINS: atomic",)
+
 
 def register_plugin(key: str, plugin: RuntimeEnvPlugin) -> None:
     if key in _ALLOWED:

@@ -27,6 +27,28 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.runtime import ObjectRef, WorkerContext, set_ctx
 
+#: raylint RL017 — the worker's recv/exec/cancel hand-off state is
+#: deliberately lock-free (':atomic' = every write is one GIL-atomic
+#: operation, verified by the linter):
+#:
+#: - cancel_requested: set.add from the recv thread, membership tests +
+#:   discard from the executing thread — a cancel landing one bytecode
+#:   after the test is simply delivered on the next check point, which is
+#:   the documented best-effort cancel contract.
+#: - task_threads: task_id -> executing-thread ident, dict store/pop by
+#:   the executor, read by the recv thread to target the async interrupt;
+#:   a miss means the task already finished (cancel is then a no-op).
+#: - async_tasks: task_id -> asyncio.Task, stored on the loop thread,
+#:   read by the recv thread for call_soon_threadsafe cancellation.
+#: - group_sems: written ONCE at actor create, before actor_ready ships —
+#:   every method dispatch happens-after by protocol order.
+LOCKFREE = (
+    "WorkerState.cancel_requested: atomic",
+    "WorkerState.task_threads: atomic",
+    "WorkerState.async_tasks: atomic",
+    "WorkerState.group_sems: atomic",
+)
+
 
 class WorkerState:
     def __init__(self, ctx: WorkerContext):

@@ -243,6 +243,12 @@ class _StreamCancelled(BaseException):
     pass
 
 
+#: raylint RL017 — _handles is a per-app handle cache: dict get/store are
+#: GIL-atomic, and two request threads racing the first touch at worst
+#: both build a handle (idempotent — last store wins, both work)
+LOCKFREE = ("ProxyActor._handles: atomic",)
+
+
 class ProxyActor:
     def __init__(self, port: int):
         self.port = port

@@ -94,3 +94,20 @@ def pytest_sessionfinish(session, exitstatus):
         events.flush(reason=f"pytest-exit-{exitstatus}")
     except Exception:
         pass  # never let observability turn a test failure into an error
+
+
+# the BENCH_r06 spin canary, shared by the load-tolerant tests
+# (test_worker_forkserver's spawn wave, test_multihost's CLI roundtrip):
+# integer adds per second — this box idles at ~24-29 Mops (BENCH_r06-r08),
+# a saturated run measures <10
+SPIN_CANARY_FLOOR_MOPS = 12.0
+
+
+def spin_mops(n: int = 2_000_000) -> float:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    x = 0
+    for i in range(n):
+        x += i
+    return n / (_time.perf_counter() - t0) / 1e6

@@ -1,0 +1,153 @@
+"""RL017's runtime twin (raylint v4, ISSUE 15).
+
+Three layers, mirroring the donation twin (`test_llm_donation.py`) shape:
+
+* **The failure mode is real:** a fixture with exactly the bug shape the
+  static rule fires on — a read-modify-write counter touched from many
+  threads with no lock — demonstrably CORRUPTS (loses updates) on this
+  interpreter, and the locked fix is exact under the same hammer. If a
+  future interpreter makes unlocked RMW exact (per-object locks, true
+  GIL removal with atomics), the probe fails loudly and the rule's
+  premise gets re-examined instead of silently rotting.
+* **The static twin agrees:** raylint RL017 fires on the racy fixture's
+  source and stays quiet on the locked fix — the lint rule and the
+  runtime corruption point at the same line.
+* **Declared lock-free designs hold:** the repo's LOCKFREE declarations
+  are verified against the REAL sources through the thread model
+  (`test_obs_hotpath.py` extends the same contract) — and the structures
+  they cover (per-thread rings, counter cells) survive the 8-thread
+  hammers in `test_obs_hotpath.py`.
+"""
+
+import textwrap
+import threading
+import time
+
+N_THREADS = 8
+PER = 4000
+
+
+class RacyWindow:
+    """The RL017 bug shape: unguarded read-modify-write credit counter."""
+
+    def __init__(self):
+        self.credits = 0
+
+    def bump(self):
+        v = self.credits
+        # widen the read->write window the way real code does (a dict
+        # lookup, an allocation) so the loss shows in bounded iterations
+        if v % 64 == 0:
+            time.sleep(0)
+        self.credits = v + 1
+
+
+class LockedWindow:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.credits = 0
+
+    def bump(self):
+        with self._lock:
+            self.credits += 1
+
+
+def _hammer(win) -> int:
+    threads = [
+        threading.Thread(target=lambda: [win.bump() for _ in range(PER)])
+        for _ in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    return win.credits
+
+
+def test_unlocked_rmw_actually_corrupts():
+    """The probe: at least one of a few rounds must LOSE updates without
+    the lock — this is the premise RL017's aug/mutate focus rests on.
+    (A single round is overwhelmingly likely to lose on CPython; the
+    retry keeps a freak all-exact run from flaking the suite.)"""
+    lost = False
+    for _ in range(5):
+        total = _hammer(RacyWindow())
+        assert total <= N_THREADS * PER
+        if total < N_THREADS * PER:
+            lost = True
+            break
+    assert lost, (
+        "unguarded read-modify-write was exact across 5 hammer rounds — "
+        "this interpreter may have atomic attribute RMW; re-examine "
+        "RL017's premise before trusting this probe"
+    )
+
+
+def test_locked_counter_exact_under_hammer():
+    for _ in range(2):
+        assert _hammer(LockedWindow()) == N_THREADS * PER
+
+
+def test_static_twin_fires_on_the_racy_shape(tmp_path):
+    """raylint RL017 and the runtime corruption point at the same code:
+    the racy fixture (spawned threads hammering the unguarded counter)
+    fires; the locked fix lints clean."""
+    from ray_tpu._lint import run_paths
+
+    racy = textwrap.dedent(
+        """
+        import threading
+
+        class RacyWindow:
+            def __init__(self):
+                self.credits = 0
+                self._a = threading.Thread(target=self._bump, daemon=True)
+                self._b = threading.Thread(target=self._bump2, daemon=True)
+
+            def _bump(self):
+                self.credits += 1
+
+            def _bump2(self):
+                self.credits += 1
+        """
+    )
+    f = tmp_path / "racy.py"
+    f.write_text(racy)
+    vs = [v for v in run_paths([str(f)]) if v.rule == "RL017"]
+    assert vs and "RacyWindow.credits" in vs[0].message
+
+    fixed = racy.replace(
+        "self.credits = 0",
+        "self._lock = threading.Lock()\n        self.credits = 0",
+    ).replace(
+        "        self.credits += 1",
+        "        with self._lock:\n            self.credits += 1",
+    )
+    g = tmp_path / "fixed.py"
+    g.write_text(fixed)
+    assert not [v for v in run_paths([str(g)]) if v.rule == "RL017"]
+
+
+def test_gil_atomic_container_ops_exact_under_hammer():
+    """The ': atomic' LOCKFREE qualifier's premise: single-operation dict
+    stores/pops and deque appends from N threads lose nothing — each op
+    is one GIL-atomic bytecode-level operation (what the declared
+    designs — _io_conns, task_threads, _rings — rely on)."""
+    d: dict = {}
+    from collections import deque
+
+    ring: deque = deque()
+
+    def work(k):
+        for i in range(PER):
+            d[(k, i)] = i       # plain store
+            ring.append((k, i))  # deque append
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(d) == N_THREADS * PER
+    assert len(ring) == N_THREADS * PER

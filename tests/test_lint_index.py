@@ -443,3 +443,292 @@ def test_emit_and_registry_extraction(tmp_path):
     # doc names parsed from the markdown at display_root
     assert "llm.*" in idx.doc_names and "submit" in idx.doc_names
     assert "llm_documented_metric" in idx.doc_names
+
+
+# ---------------------------------------------- thread roots & accesses (v4)
+
+
+def test_thread_target_lambda_and_executor_submit(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                import threading
+                from concurrent.futures import ThreadPoolExecutor
+
+                class Beat:
+                    def __init__(self):
+                        self.pool = ThreadPoolExecutor(2)
+                        threading.Thread(target=lambda: self._run(), daemon=True).start()
+
+                    def kick(self, k):
+                        self.pool.submit(self._work, k)
+
+                    def shove(self, loop, k):
+                        loop.run_in_executor(None, self._bg, k)
+
+                    def _run(self):
+                        pass
+
+                    def _work(self, k):
+                        pass
+
+                    def _bg(self, k):
+                        pass
+            """,
+        },
+    )
+    init = idx.functions["mod:Beat.__init__"]
+    # the lambda body's call chain is the recorded target
+    assert [t for t, _d in init.thread_targets] == [("self", "_run")]
+    kick = idx.functions["mod:Beat.kick"]
+    assert kick.exec_submits == [("self", "_work")]
+    shove = idx.functions["mod:Beat.shove"]
+    assert shove.exec_submits == [("self", "_bg")]
+
+
+def test_attr_accesses_record_kind_and_held(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.items = []
+                        self.n = 0
+                        self.flag = False
+
+                    def locked_put(self, x):
+                        with self._lock:
+                            self.items.append(x)
+
+                    def bare_bump(self):
+                        self.n += 1
+
+                    def publish(self):
+                        self.flag = True
+
+                    def bracketed(self):
+                        self._lock.acquire()
+                        try:
+                            self.n += 1
+                        finally:
+                            self._lock.release()
+            """,
+        },
+    )
+    f = idx.functions["mod:Box.locked_put"]
+    mutates = [a for a in f.attr_accesses if a.kind == "mutate"]
+    assert mutates and mutates[0].chain == ("self", "items")
+    assert mutates[0].held == (("self", "_lock"),)
+    g = idx.functions["mod:Box.bare_bump"]
+    augs = [a for a in g.attr_accesses if a.kind == "aug"]
+    assert augs and augs[0].chain == ("self", "n") and augs[0].held == ()
+    p = idx.functions["mod:Box.publish"]
+    stores = [a for a in p.attr_accesses if a.kind == "store"]
+    assert stores and stores[0].const_store  # literal flag publish
+    b = idx.functions["mod:Box.bracketed"]
+    augs_b = [a for a in b.attr_accesses if a.kind == "aug"]
+    # linear .acquire()/.release() bracketing counts as held
+    assert augs_b and augs_b[0].held == (("self", "_lock"),)
+
+
+def test_param_annotation_resolves_class_and_lock(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                import threading
+
+                class State:
+                    def __init__(self):
+                        self.reply_lock = threading.Lock()
+                        self.reply_buf = []
+
+                    def bump(self):
+                        pass
+
+                def flush(state: State):
+                    with state.reply_lock:
+                        state.reply_buf.append(1)
+                    state.bump()
+            """,
+        },
+    )
+    f = idx.functions["mod:flush"]
+    assert f.param_classes["state"] == ("mod", "State")
+    # param-rooted lock chains key to the owning class
+    assert idx.lock_key(("state", "reply_lock"), f) == "State.reply_lock"
+    # and param-rooted calls resolve to methods
+    callee = idx.resolve_call(f, ("state", "bump"))
+    assert callee is not None and callee.key == "mod:State.bump"
+
+
+def test_ctor_typed_lock_with_unlockish_name(tmp_path):
+    # PR 14 named its serializer `_submit_send`: lock-typed by ctor, so
+    # `with self._submit_send:` must still enter the acquisition graph
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                import threading
+
+                class Ctx:
+                    def __init__(self):
+                        self._submit_send = threading.Lock()
+
+                    def flush(self):
+                        with self._submit_send:
+                            pass
+            """,
+        },
+    )
+    f = idx.functions["mod:Ctx.flush"]
+    assert [a.chain for a in f.acquisitions] == [("self", "_submit_send")]
+    assert idx.lock_key(("self", "_submit_send"), f) == "Ctx._submit_send"
+
+
+# ------------------------------------------------- wire-protocol sites (v4)
+
+
+def test_msg_send_extraction_forms(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                from x import ser
+
+                def direct(conn):
+                    conn.send(("ping", 1))
+
+                def via_conn_send(conn, payload):
+                    ser.conn_send(conn, ("submit_batch", payload))
+
+                def via_local(conn, batch):
+                    msg = ("one", batch[0]) if len(batch) == 1 else ("many", batch)
+                    conn.send(msg)
+
+                def parametric(conn, msg_kind, payload):
+                    conn.send((msg_kind, payload))
+            """,
+        },
+    )
+    kinds = lambda key: sorted(k for k, _n in idx.functions[key].msg_sends)
+    assert kinds("mod:direct") == ["ping"]
+    assert kinds("mod:via_conn_send") == ["submit_batch"]
+    assert kinds("mod:via_local") == ["many", "one"]
+    assert kinds("mod:parametric") == []
+    assert [p for p, _n in idx.functions["mod:parametric"].msg_param_sends] == [
+        "msg_kind"
+    ]
+
+
+def test_msg_compare_extraction_recv_rooted_only(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                def serve(conn, reader):
+                    msg = conn.recv()
+                    kind = msg[0]
+                    if kind == "a":
+                        pass
+                    if msg[0] != "b":
+                        pass
+                    for m in reader.read_available():
+                        if m[0] == "c":
+                            pass
+
+                def unpack(conn):
+                    kind, info = conn.recv()
+                    assert kind == "ack"
+
+                def helper(msg):
+                    if msg[0] == "promoted":
+                        pass
+
+                def not_wire(locator, spec):
+                    if locator[0] == "inline":
+                        pass
+                    if spec["kind"] == "task":
+                        pass
+            """,
+        },
+    )
+    serve = idx.functions["mod:serve"]
+    assert sorted(m.kind for m in serve.msg_compares) == ["a", "b", "c"]
+    assert all(m.root == "recv" for m in serve.msg_compares)
+    unpack = idx.functions["mod:unpack"]
+    assert [m.kind for m in unpack.msg_compares] == ["ack"]
+    helper = idx.functions["mod:helper"]
+    assert [(m.kind, m.root) for m in helper.msg_compares] == [
+        ("promoted", ("msg", "msg"))
+    ]
+    # `locator[0] == "inline"` is recorded only as a DORMANT param
+    # compare (promoted solely by a recv-rooted caller — none exists);
+    # the string-key spec compare is not recorded at all
+    nw = idx.functions["mod:not_wire"]
+    assert [(m.kind, m.root) for m in nw.msg_compares] == [
+        ("inline", ("msg", "locator"))
+    ]
+
+
+def test_lockfree_registry_collected(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                LOCKFREE = ("Owner._attr: atomic", "_global",)
+            """,
+        },
+    )
+    decls = idx.lockfree_decls()
+    assert len(decls) == 1
+    module, entries, _node, _ctx = decls[0]
+    assert module == "mod"
+    assert entries == ["Owner._attr: atomic", "_global"]
+
+
+def test_tuple_kind_local_invalidated_on_rebind(tmp_path):
+    # a local rebound to a non-kind value must not keep reporting the
+    # old kind at later sends (phantom RL019 sends)
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                def relay(conn):
+                    msg = ("hello", 1)
+                    conn.send(msg)
+                    msg = conn.recv()
+                    conn.send(msg)
+            """,
+        },
+    )
+    f = idx.functions["mod:relay"]
+    assert [k for k, _n in f.msg_sends] == ["hello"]
+
+
+def test_ctor_typed_lock_seen_from_method_above_init(tmp_path):
+    # __init__ scans first regardless of source position, so the ctor
+    # evidence reaches a lexically-earlier method's with-block
+    idx = make_index(
+        tmp_path,
+        {
+            "mod.py": """
+                import threading
+
+                class Ctx:
+                    def flush(self):
+                        with self._submit_send:
+                            pass
+
+                    def __init__(self):
+                        self._submit_send = threading.Lock()
+            """,
+        },
+    )
+    f = idx.functions["mod:Ctx.flush"]
+    assert [a.chain for a in f.acquisitions] == [("self", "_submit_send")]

@@ -148,7 +148,26 @@ CLI_ENV = dict(os.environ, PYTHONPATH="/root/repo" + os.pathsep + os.environ.get
 
 def test_cli_head_node_driver_roundtrip(tmp_path):
     """The real deployment shape: `ray_tpu start --head` in one process,
-    `ray_tpu start --address` in another, driver + state CLI attach over TCP."""
+    `ray_tpu start --address` in another, driver + state CLI attach over TCP.
+
+    Capability probe (ISSUE 15 deflake, the PR 12 skipif discipline): the
+    test boots THREE cold interpreters back to back under 60s/120s
+    budgets, and on this 1-core box it fails under ambient load while
+    passing 4/4 in isolation (1.2s each — measured in the PR 12 session;
+    the tier-1 memory note pins the same flake). When the spin canary
+    shows the box contended (<12 Mops vs the ~24-29 idle range of
+    BENCH_r06-r08), the interpreter-boot timing would measure the
+    NEIGHBORS, not the control plane — skip with the measurement cited.
+    An unloaded box still gates at full strength."""
+    from conftest import SPIN_CANARY_FLOOR_MOPS, spin_mops
+
+    canary = spin_mops()
+    if canary < SPIN_CANARY_FLOOR_MOPS:
+        pytest.skip(
+            f"box contended (spin canary {canary:.1f} Mops < 12): three "
+            "cold-interpreter boots under 60s/120s budgets measure the "
+            "ambient load, not the CLI control plane"
+        )
     head_proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu", "start", "--head", "--port", "0", "--num-cpus", "0"],
         stdout=subprocess.PIPE,

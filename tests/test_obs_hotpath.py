@@ -109,6 +109,78 @@ def test_collector_thread_visible_to_daemon_analysis():
     )
 
 
+def _full_tree_index():
+    """Whole-package index (cached): the LOCKFREE verification needs the
+    real thread roots, which span head/worker/serve modules."""
+    global _FULL_IDX
+    try:
+        return _FULL_IDX
+    except NameError:
+        pass
+    import pathlib
+
+    from ray_tpu._lint.core import FileContext, iter_python_files
+    from ray_tpu._lint.index import build_index
+
+    # iter_python_files is the SAME collector the lint gate uses (skip
+    # dirs, display paths) — this test must analyze exactly what the
+    # self-lint run analyzes
+    root = pathlib.Path(REPO)
+    ctxs = []
+    for abs_path, display in iter_python_files(
+        [root / "ray_tpu"], display_root=root
+    ):
+        text = abs_path.read_text()
+        ctxs.append(FileContext(abs_path, display, text, ast.parse(text)))
+    _FULL_IDX = build_index(ctxs, display_root=root)
+    return _FULL_IDX
+
+
+def test_lockfree_declarations_verified_against_real_sources():
+    """The RL017 contract, index-backed like the zero-lock test above:
+    every LOCKFREE entry in the tree matches accessed state, every BARE
+    entry really is single-writer (≤1 writing thread root in the whole-
+    program thread model), and every ':atomic' entry has no
+    read-modify-write site. A declaration drifting from the code fails
+    tier-1 here AND in the self-lint gate — by construction, since this
+    re-runs the verifier the lint gate uses."""
+    from ray_tpu._lint import concurrency
+
+    idx = _full_tree_index()
+    model = concurrency.get_model(idx)
+    decls = idx.lockfree_decls()
+    assert decls, "the tree lost its LOCKFREE declarations"
+    entries = [
+        (module, e) for module, es, _n, _c in decls for e in es
+    ]
+    # the PR 11 hot-path declarations specifically must exist
+    assert any(e.startswith("_rings") for _m, e in entries)
+    checked = 0
+    for module, entry in entries:
+        key, qual = concurrency.parse_lockfree(entry)
+        if "." not in key:
+            key = f"{module}.{key}"
+        states = model.by_display.get(key)
+        assert states, f"LOCKFREE entry {entry!r} matches no accessed state"
+        accs = [a for st in states for a in model.accesses[st]]
+        writes = [a for a in accs if a.kind in ("store", "aug", "mutate")]
+        if qual is None:
+            wroots = {a.root for a in writes}
+            assert len(wroots) <= 1, (
+                f"bare LOCKFREE entry {entry!r} is written from "
+                f"{sorted(wroots)} — no longer single-writer"
+            )
+        else:
+            assert qual == "atomic", entry
+            bad = [a for a in writes if a.kind == "aug"]
+            assert not bad, (
+                f"':atomic' LOCKFREE entry {entry!r} has a "
+                "read-modify-write site"
+            )
+        checked += 1
+    assert checked >= 8  # head, events, worker_main, waterfall, ... all in
+
+
 # ---------------------------------------------------------------------------
 # concurrency stress: no lost / duplicated / reordered-within-thread events
 # ---------------------------------------------------------------------------

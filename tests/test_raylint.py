@@ -26,7 +26,7 @@ def rule_ids(violations):
 
 
 def test_rule_registry_complete():
-    assert {f"RL{i:03d}" for i in range(1, 17)} <= ALL_RULE_IDS
+    assert {f"RL{i:03d}" for i in range(1, 20)} <= ALL_RULE_IDS
 
 
 # --------------------------------------------------------------------- RL001
@@ -1825,3 +1825,569 @@ def test_rl014_comprehension_loop_fires(tmp_path):
     """
     vs = lint_snippet(tmp_path, src)
     assert any(v.rule == "RL014" and "'n'" in v.message for v in vs)
+
+
+# --------------------------------------------------------------------- RL017
+
+
+RL017_POS = """
+    import threading
+
+    class Window:
+        def __init__(self):
+            self.credits = 0
+            self._t = threading.Thread(target=self._drain, daemon=True)
+            self._t2 = threading.Thread(target=self._fill, daemon=True)
+
+        def _drain(self):
+            self.credits -= 1
+
+        def _fill(self):
+            self.credits += 1
+"""
+
+
+def test_rl017_unguarded_counter_two_threads_fires(tmp_path):
+    vs = lint_snippet(tmp_path, RL017_POS)
+    hits = [v for v in vs if v.rule == "RL017"]
+    assert hits and "Window.credits" in hits[0].message
+    # both witness roots are named with file:line anchors
+    assert "thread:Window._drain" in hits[0].message
+    assert "thread:Window._fill" in hits[0].message
+
+
+def test_rl017_common_lock_ok(tmp_path):
+    src = """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.credits = 0
+                self._t = threading.Thread(target=self._drain, daemon=True)
+                self._t2 = threading.Thread(target=self._fill, daemon=True)
+
+            def _drain(self):
+                with self._lock:
+                    self.credits -= 1
+
+            def _fill(self):
+                with self._lock:
+                    self.credits += 1
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_lock_via_acquire_release_ok(tmp_path):
+    # the try/finally .acquire()/.release() idiom guards like a with
+    src = """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.credits = 0
+                self._t = threading.Thread(target=self._drain, daemon=True)
+                self._t2 = threading.Thread(target=self._fill, daemon=True)
+
+            def _drain(self):
+                self._lock.acquire()
+                try:
+                    self.credits -= 1
+                finally:
+                    self._lock.release()
+
+            def _fill(self):
+                self._lock.acquire()
+                try:
+                    self.credits += 1
+                finally:
+                    self._lock.release()
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_plain_flag_store_ok(tmp_path):
+    # constant rebinds are GIL-atomic publishes, not corruption
+    src = """
+        import threading
+
+        class Loop:
+            def __init__(self):
+                self.running = True
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while self.running:
+                    pass
+
+            def stop(self):
+                self.running = False
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_sync_primitive_attr_ok(tmp_path):
+    # Queue/Event attrs are internally synchronized
+    src = """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.q = queue.SimpleQueue()
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    self.q.put(1)
+
+            def feed(self, item):
+                self.q.put(item)
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_single_root_ok(tmp_path):
+    # one thread mutating, nothing else touching: no concurrency evidence
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                self.n += 1
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_executor_submit_is_a_thread_root(tmp_path):
+    src = """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Fan:
+            def __init__(self):
+                self.done = {}
+                self.pool = ThreadPoolExecutor(2)
+                self._t = threading.Thread(target=self._watch, daemon=True)
+
+            def kick(self, k):
+                self.pool.submit(self._work, k)
+
+            def _work(self, k):
+                self.done[k] = True
+
+            def _watch(self):
+                self.done.clear()
+    """
+    # pool.submit(self._work) spawns a root: its unguarded dict store
+    # conflicts with the watcher thread's clear — without the executor
+    # root, _watch alone would be a single root and nothing would fire
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL017"]
+    assert vs and "Fan.done" in vs[0].message
+    assert "thread:Fan._work" in vs[0].message
+
+
+def test_rl017_suppressed(tmp_path):
+    src = """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self.credits = 0
+                self._t = threading.Thread(target=self._drain, daemon=True)
+                self._t2 = threading.Thread(target=self._fill, daemon=True)
+
+            def _drain(self):
+                self.credits -= 1  # raylint: disable=RL017
+
+            def _fill(self):
+                self.credits += 1  # raylint: disable=RL017
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_lockfree_declaration_exempts(tmp_path):
+    # single-writer counter, declared: the read-side conflict is waived
+    src = """
+        import threading
+
+        LOCKFREE = ("Killer.kills",)
+
+        class Killer:
+            def __init__(self):
+                self.kills = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                self.kills += 1
+
+        def stats(k):
+            return k.kills
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_lockfree_stale_entry_fires(tmp_path):
+    src = """
+        import threading
+
+        LOCKFREE = ("Killer.no_such_attr",)
+
+        class Killer:
+            def __init__(self):
+                self.kills = 0
+    """
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL017"]
+    assert vs and "matches no accessed" in vs[0].message
+
+
+def test_rl017_lockfree_multiwriter_entry_fires(tmp_path):
+    # a bare entry asserts single-writer; two writing roots break it
+    src = RL017_POS.replace(
+        "import threading",
+        'import threading\n\n    LOCKFREE = ("Window.credits",)',
+    )
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL017"]
+    assert vs and "declares single-writer" in vs[0].message
+
+
+def test_rl017_lockfree_atomic_rejects_augassign(tmp_path):
+    src = RL017_POS.replace(
+        "import threading",
+        'import threading\n\n    LOCKFREE = ("Window.credits: atomic",)',
+    )
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL017"]
+    assert vs and "read-modify-write" in vs[0].message
+
+
+def test_rl017_lockfree_atomic_accepts_dict_store(tmp_path):
+    src = """
+        import threading
+
+        LOCKFREE = ("Registry.rings: atomic",)
+
+        class Registry:
+            def __init__(self):
+                self.rings = {}
+                self._t = threading.Thread(target=self._emit, daemon=True)
+                self._t2 = threading.Thread(target=self._fold, daemon=True)
+
+            def _emit(self):
+                self.rings[1] = object()
+
+            def _fold(self):
+                self.rings.pop(1, None)
+    """
+    assert "RL017" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl017_lambda_thread_target_resolves(tmp_path):
+    src = """
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self.ticks = 0
+                self._t = threading.Thread(target=lambda: self._run(), daemon=True)
+                self._t2 = threading.Thread(target=self._other, daemon=True)
+
+            def _run(self):
+                self.ticks += 1
+
+            def _other(self):
+                self.ticks += 1
+    """
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL017"]
+    assert vs and "thread:Beat._run" in vs[0].message
+
+
+# --------------------------------------------------------------------- RL018
+
+
+RL018_POS = """
+    import threading
+
+    class Credits:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._credits = 0
+
+        def consume(self):
+            with self._lock:
+                free = self._credits > 0
+            if free:
+                with self._lock:
+                    self._credits -= 1
+"""
+
+
+def test_rl018_check_then_act_fires(tmp_path):
+    vs = [v for v in lint_snippet(tmp_path, RL018_POS) if v.rule == "RL018"]
+    assert vs and "'_credits'" in vs[0].message
+    assert "stale" in vs[0].message
+
+
+def test_rl018_recheck_under_lock_ok(tmp_path):
+    src = """
+        import threading
+
+        class Credits:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._credits = 0
+
+            def consume(self):
+                with self._lock:
+                    if self._credits > 0:
+                        self._credits -= 1
+    """
+    assert "RL018" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl018_ungated_relock_ok(tmp_path):
+    # sequential critical sections with no check feeding the act are the
+    # normal re-acquire idiom, not check-then-act
+    src = """
+        import threading
+
+        class Credits:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._credits = 0
+
+            def roll(self, n):
+                with self._lock:
+                    before = self._credits
+                with self._lock:
+                    self._credits = n
+                return before
+    """
+    assert "RL018" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl018_gate_on_attr_itself_fires(tmp_path):
+    src = """
+        import threading
+
+        class Credits:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._credits = 0
+
+            def consume(self):
+                with self._lock:
+                    probe = self._credits
+                if self._credits > 0:
+                    with self._lock:
+                        self._credits -= 1
+    """
+    assert "RL018" in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl018_suppressed(tmp_path):
+    src = RL018_POS.replace(
+        "with self._lock:\n                    self._credits -= 1",
+        "with self._lock:  # raylint: disable=RL018\n"
+        "                    self._credits -= 1",
+    )
+    assert "RL018" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL019
+
+
+def test_rl019_unhandled_kind_fires(tmp_path):
+    src = """
+        def client(conn):
+            conn.send(("ping", 1))
+            conn.send(("bye", 0))
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "ping":
+                return 1
+    """
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL019"]
+    assert len(vs) == 1 and "'bye'" in vs[0].message
+    assert "no recv-loop dispatch" in vs[0].message
+
+
+def test_rl019_unsent_kind_fires(tmp_path):
+    src = """
+        def client(conn):
+            conn.send(("ping", 1))
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "ping":
+                return 1
+            if msg[0] == "pong":
+                return 2
+    """
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL019"]
+    assert len(vs) == 1 and "'pong'" in vs[0].message
+    assert "dead protocol" in vs[0].message
+
+
+def test_rl019_param_promoted_handler_ok(tmp_path):
+    # the dispatcher pattern: recv loop hands the message to a helper
+    src = """
+        def serve(conn):
+            msg = conn.recv()
+            handle(msg)
+
+        def handle(msg):
+            kind = msg[0]
+            if kind == "ping":
+                return 1
+
+        def client(conn):
+            conn.send(("ping", 1))
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_param_send_promoted(tmp_path):
+    # the rendezvous pattern: the kind literal lives at the CALLER of a
+    # parametric send helper (_broadcast_rendezvous shape)
+    src = """
+        def broadcast(conn, msg_kind, payload):
+            conn.send((msg_kind, payload))
+
+        def rpc_profile(conn):
+            broadcast(conn, "profile", {})
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "profile":
+                return 1
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_ternary_and_local_hop_sends(tmp_path):
+    # `msg = (...) if .. else (...)` then send(msg): both kinds count
+    src = """
+        def client(conn, batch):
+            msg = ("one", batch[0]) if len(batch) == 1 else ("many", batch)
+            conn.send(msg)
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "one":
+                return 1
+            if msg[0] == "many":
+                return 2
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_non_recv_compare_not_a_handler(tmp_path):
+    # locator/spec kind compares are not wire dispatch: with no real
+    # handler in view, the send direction is not judged either
+    src = """
+        def client(conn):
+            conn.send(("ping", 1))
+
+        def materialize(locator):
+            if locator[0] == "inline":
+                return locator[1]
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_reconnect_sweep_missing_fires(tmp_path):
+    src = """
+        class Ctx:
+            def __init__(self):
+                self._submit_buf = []
+
+            def enqueue(self, spec):
+                self._submit_buf.append(spec)
+
+            def ship(self, conn):
+                conn.send(("submit_batch", self._submit_buf))
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "submit_batch":
+                return 1
+    """
+    vs = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL019"]
+    assert len(vs) == 1 and "Ctx._submit_buf" in vs[0].message
+    assert "no sweep" in vs[0].message
+
+
+def test_rl019_reconnect_sweep_present_ok(tmp_path):
+    src = """
+        class Ctx:
+            def __init__(self):
+                self._submit_buf = []
+
+            def enqueue(self, spec):
+                self._submit_buf.append(spec)
+
+            def ship(self, conn):
+                conn.send(("submit_batch", self._submit_buf))
+
+            def _fail_submits(self):
+                self._submit_buf = []
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "submit_batch":
+                return 1
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_suppressed(tmp_path):
+    src = """
+        def client(conn):
+            conn.send(("bye", 0))  # raylint: disable=RL019
+            conn.send(("ping", 1))
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "ping":
+                return 1
+    """
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl019_data_plane_err_shape_pinned(tmp_path):
+    """The true positive RL019 found on its first run over the repo: the
+    data-plane client swallowed the server's explicit ("err", reason)
+    reply under a catch-all compare, so the kind existed on the wire
+    with no named handler. The fixed shape (an explicit == "err"
+    branch) lints clean; the pre-fix shape fires."""
+    buggy = """
+        def fetch(conn):
+            conn.send(("fetch", 1))
+            resp = conn.recv()
+            if resp[0] != "ok":
+                raise OSError(resp)
+            return resp[1]
+
+        def serve(conn):
+            msg = conn.recv()
+            if msg[0] == "fetch":
+                try:
+                    conn.send(("ok", 1))
+                except KeyError as e:
+                    conn.send(("err", str(e)))
+    """
+    vs = [v for v in lint_snippet(tmp_path, buggy) if v.rule == "RL019"]
+    assert len(vs) == 1 and "'err'" in vs[0].message
+    fixed = buggy.replace(
+        'if resp[0] != "ok":',
+        'if resp[0] == "err":\n'
+        "                raise OSError(resp[1])\n"
+        '            if resp[0] != "ok":',
+    )
+    assert "RL019" not in rule_ids(lint_snippet(tmp_path, fixed))

@@ -61,14 +61,8 @@ def test_forked_worker_runs_plain_tasks(cluster):
     )
 
 
-def _spin_mops(n: int = 2_000_000) -> float:
-    """The BENCH_r06 spin canary: integer adds per second, the ambient-load
-    probe every bench round records next to its numbers."""
-    t0 = time.perf_counter()
-    x = 0
-    for i in range(n):
-        x += i
-    return n / (time.perf_counter() - t0) / 1e6
+# the spin canary lives in conftest (shared with test_multihost's CLI
+# roundtrip probe) so the contention threshold is tuned in ONE place
 
 
 # tier-1 budget (ISSUE 13): 24.8s measured on the dev box — and the
@@ -103,8 +97,10 @@ def test_spawn_wave_no_registration_respawns(cluster):
     ]
     rate = 100 / dt
     if retried or rate <= 5:
-        canary = _spin_mops()
-        if canary < 12.0:
+        from conftest import SPIN_CANARY_FLOOR_MOPS, spin_mops
+
+        canary = spin_mops()
+        if canary < SPIN_CANARY_FLOOR_MOPS:
             pytest.skip(
                 f"box contended (spin canary {canary:.1f} Mops < 12): wave "
                 f"{rate:.1f}/s with {len(retried)} registration respawns is "
