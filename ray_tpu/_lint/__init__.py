@@ -5,7 +5,13 @@ programmer errors — nested blocking ``get()`` deadlocks, unserializable
 closure captures, blocking calls inside async actors — that runtime
 machinery only surfaces after deployment. raylint catches them ahead of
 time from the AST, with per-rule suppression comments and a baseline file
-so pre-existing violations can be burned down incrementally.
+so pre-existing violations can be burned down incrementally. Beyond the
+per-file rules it is a five-phase whole-program analysis: the project
+index (``index.py``), per-function CFG + dataflow (``dataflow.py``), the
+thread-root/shared-state model (``concurrency.py``) and the mesh/SPMD
+model (``spmd.py``) feed 24 rules spanning actor hygiene, lock order,
+donation/retrace dataflow, cross-thread races, wire-protocol drift and
+mesh/sharding/Pallas contracts.
 
 Run it as ``python -m ray_tpu.lint [paths]``. Library entry points:
 

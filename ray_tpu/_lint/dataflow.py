@@ -6,7 +6,8 @@ between the jitted call and its reassignment, a ``KVBlockPool.allocate``
 whose matching ``free`` does not dominate an exception edge, a file/lock
 acquired before a raising statement that no ``finally`` covers.  This
 module supplies the machinery those rules (RL013-RL016, ``rules.py``)
-share:
+share; the :class:`Acquisition`/:func:`resource_leaks` ownership engine
+is also reused by RL023 (``spmd.py``) for remote-DMA start/wait pairing:
 
 * **CFG** (:func:`build_cfg`) — statement-granular basic flow for one
   ``def``: ``if``/``for``/``while``/``try``/``with`` lowered to nodes with

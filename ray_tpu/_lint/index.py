@@ -48,7 +48,19 @@ This module builds the whole-program index those rules need:
   ``Histogram`` constructors, declared ``METRIC_NAMES``/``EVENT_NAMES``
   registries, ``LOCK_ORDER`` declarations, ``ray_tpu_``-prefixed metric
   references inside string literals (grafana/SLO PromQL), and backticked
-  names from the repo's observability docs (``DOC_FILES``).
+  names from the repo's observability docs (``DOC_FILES``);
+* **mesh/SPMD sites** — mesh constructions and the local names bound to
+  them, ``shard_map``/``pjit``/``pmap`` sites with their ``mesh``/
+  ``in_specs``/``out_specs``/``in_shardings``/``out_shardings``
+  expressions (composition forms like ``jax.jit(shard_map(f, ...))``
+  merge onto the inner target), ``PartitionSpec`` literals, collective
+  calls with their ``axis_name`` operands, ``pl.pallas_call`` contracts
+  (grid rank, BlockSpec block shapes, index_map arity, ``interpret=``
+  gating), directly-bound ``device_put``/``global_put`` placements,
+  ``make_async_remote_copy`` handle bindings, module-level string-tuple
+  globals (axis-name tables like ``AXES``) and ``INTERPRET_ONLY``
+  declarations — the raw material of the RL020-RL024 mesh/sharding
+  phase (``spmd.py``).
 
 Everything here is a *documented heuristic* over the AST — no imports are
 executed, and unresolvable dynamic constructs are skipped
@@ -71,7 +83,7 @@ from ray_tpu._lint.core import FileContext
 # sync with RL005's per-class heuristic)
 LOCK_ATTR_RE = re.compile(r"(?:^|_)(lock|rlock|mutex|cv|cond)s?$", re.I)
 
-_JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pmap"}
 
 #: attribute / parameter names that mean "model state", not config — the
 #: PR 7 bug class is exactly a traced function reading one of these
@@ -123,6 +135,25 @@ _EXECUTOR_RECV_RE = re.compile(r"(pool|executor)s?$", re.I)
 #: wire send functions; the message argument position is 1 for
 #: ``conn_send(conn, msg)`` / ``_enqueue_send(wh, msg)`` and 0 otherwise
 _SEND_FUNCS = {"send": 0, "send_raw": 0, "conn_send": 1, "_send": 0, "_enqueue_send": 1}
+
+#: collective primitives → positional index of their ``axis_name``
+#: operand (the ``jax.lax`` spellings plus the jax_compat shims RL020
+#: must see through)
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+#: receivers a collective chain may hang off (``jax.lax.psum`` /
+#: ``lax.psum`` / the jax_compat shim); a bare imported name is also
+#: accepted. Anything else (``obj.all_gather(...)``) is some project
+#: method, not a collective.
+_COLLECTIVE_BASES = {"jax", "lax", "jax_compat", "compat"}
+
+#: mesh-constructing calls: ``jax.sharding.Mesh`` itself plus the repo's
+#: factory idiom (``make_mesh`` / ``make_multislice_mesh``)
+_MESH_CTOR_RE = re.compile(r"^(Mesh|make_\w*mesh)$")
 
 #: repo docs that count as observability-name documentation for RL012
 DOC_FILES = ("OBSERVABILITY.md", "RESILIENCE.md")
@@ -243,6 +274,28 @@ class JitSite:
     #: repo's bound-method wrappings equals the call-site arg position
     donate_argnums: Tuple[int, ...] = ()
     decorator_of: Optional[str] = None       # FuncInfo key when via decorator
+    # -- mesh/SPMD fields (RL020/RL021/RL024); None/() when not spelled --
+    mesh_expr: Optional[ast.AST] = None      # mesh= kwarg / positional
+    in_specs: Optional[ast.AST] = None
+    out_specs: Optional[ast.AST] = None
+    in_shardings: Optional[ast.AST] = None
+    out_shardings: Optional[ast.AST] = None
+    axis_name: Tuple[str, ...] = ()          # pmap axis binding(s)
+    #: the inner wrapper when this is a composition form
+    #: (``jax.jit(shard_map(f, ...))`` → wrapper='jit',
+    #: composed_with='shard_map', target/specs merged onto f)
+    composed_with: Optional[str] = None
+    #: positional / keyword args pre-bound by a functools.partial target
+    #: (shift the traced function's visible parameter space)
+    partial_pos: int = 0
+    partial_kw: Tuple[str, ...] = ()
+
+    def wrappers(self) -> set:
+        """Both wrapper levels of a composition form."""
+        out = {self.wrapper}
+        if self.composed_with is not None:
+            out.add(self.composed_with)
+        return out
 
 
 @dataclasses.dataclass
@@ -293,6 +346,93 @@ class MsgCompare:
     root: object
 
 
+@dataclasses.dataclass
+class CollectiveSite:
+    """One collective call (RL020): op name plus its axis operand —
+    literal axis names, or the enclosing def's parameter it came from.
+    Sites whose axis operand is neither are not recorded (a rule can
+    miss, it must not invent)."""
+
+    op: str
+    axes: Tuple[str, ...]             # literal axis names; () when a param
+    axis_param: Optional[str]         # parameter name carrying the axis
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class MeshBind:
+    """``mesh = Mesh(...)`` / ``mesh = make_mesh(...)`` — names bound to
+    a mesh construction in this scope (RL021's axis-universe anchor)."""
+
+    names: Tuple[str, ...]
+    ctor_chain: Tuple[str, ...]
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class SpecSite:
+    """One ``P(...)`` / ``PartitionSpec(...)`` literal. ``entries`` holds
+    a str per literal axis, a tuple of strs per multi-axis dim, None per
+    replicated dim, ``"?"`` for dynamic entries and ``"*"`` for starred
+    splats (rank unknowable)."""
+
+    entries: Tuple[object, ...]
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class NamedShardingSite:
+    """``NamedSharding(mesh, P(...))`` — and the repo's ``constrain(x,
+    mesh, P(...))`` helper, which carries the same mesh/spec pairing."""
+
+    mesh_chain: Optional[Tuple[str, ...]]
+    spec: Optional[ast.Call]          # the P(...) literal, when spelled
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class BlockSpecInfo:
+    """One ``pl.BlockSpec``: block shape (ints where literal, None for
+    squeezed dims, ``"?"`` where dynamic) and the index_map lambda's
+    arity when spelled inline."""
+
+    block_shape: Optional[Tuple[object, ...]]
+    index_map_arity: Optional[int]
+    node: ast.AST
+    role: str = "in"       # 'in' | 'out' — which spec list it came from
+
+
+@dataclasses.dataclass
+class PallasSite:
+    """One ``pl.pallas_call`` with everything RL022 checks statically."""
+
+    kernel_chain: Optional[Tuple[str, ...]]   # partial-unwrapped kernel fn
+    grid_rank: Optional[int]
+    num_scalar_prefetch: int
+    scalar_grid: bool                 # grid came via PrefetchScalarGridSpec
+    block_specs: Tuple[BlockSpecInfo, ...]
+    interpret: str                    # 'absent' | 'true' | 'false' | 'dynamic'
+    interpret_chain: Optional[Tuple[str, ...]]  # gate-call chain when dynamic
+    out_shape_dims: Optional[Tuple[int, ...]]   # literal ShapeDtypeStruct dims
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class PlacementSite:
+    """One directly-bound ``device_put`` / ``global_put`` (RL021's rank
+    check + RL024's drift source). ``sharding`` classifies the second
+    operand: 'absent' (committed to the default single device), 'named',
+    'single' (explicit SingleDeviceSharding) or 'other'."""
+
+    fn: str
+    sharding: str
+    sharding_node: Optional[ast.AST]
+    spec_rank: Optional[int]          # P(...) rank inside a NamedSharding arg
+    operand_rank: Optional[int]       # literal array-ctor rank of operand 0
+    bound_names: Tuple[str, ...]
+    node: ast.Call
+
+
 class FuncInfo:
     """Everything the cross-module rules need to know about one def (or
     the module top-level scope, ``qualname == '<module>'``). The scan
@@ -340,6 +480,16 @@ class FuncInfo:
         self.msg_compares: List[MsgCompare] = []
         self.recv_names: set = set()          # locals holding a recv'd message
         self.kindvar_names: set = set()       # locals holding msg[0]
+        # mesh/SPMD raw material (RL020-RL024 — consumed by spmd.py)
+        self.collectives: List[CollectiveSite] = []
+        self.mesh_binds: List[MeshBind] = []
+        self.spec_sites: List[SpecSite] = []
+        self.spec_locals: dict[str, ast.Call] = {}  # name -> P(...) literal
+        self.named_shardings: List[NamedShardingSite] = []
+        self.named_sharding_locals: set = set()     # names bound to NamedSharding
+        self.pallas_sites: List[PallasSite] = []
+        self.placements: List[PlacementSite] = []
+        self.dma_binds: List[Tuple[str, ast.Call]] = []  # async-remote-copy handles
 
     @property
     def key(self) -> str:
@@ -414,6 +564,11 @@ class ModuleInfo:
         self.registries: dict[str, Tuple[list, ast.AST]] = {}
         self.lock_orders: List[Tuple[list, ast.AST]] = []
         self.lockfree: List[Tuple[list, ast.AST]] = []   # RL017 declarations
+        self.interpret_only: List[Tuple[list, ast.AST]] = []  # RL022 declarations
+        #: every module-level all-string tuple/list global — the axis-name
+        #: tables (parallel/mesh.py's AXES) RL021 resolves ``axis_names=``
+        #: defaults through, import-following included
+        self.str_tuples: dict[str, Tuple[str, ...]] = {}
         self.string_prom_refs: List[Tuple[str, ast.AST]] = []
         self.scope: Optional[FuncInfo] = None  # module top-level pseudo-func
 
@@ -452,6 +607,12 @@ class _FunctionScanner(ast.NodeVisitor):
         # `msg = ("task_done", p) if one else ("tasks_done_batch", b)` —
         # locals holding kind-headed wire tuples (RL019 send extraction)
         self.tuple_kind_locals: dict[str, Tuple[str, ...]] = {}
+        # `grid = (bh, seq // bq, seq // bk)` — locals bound to tuple
+        # literals, by RANK only (RL022 resolves `grid=grid` through it)
+        self.tuple_rank_locals: dict[str, int] = {}
+        # `grid_spec = pltpu.PrefetchScalarGridSpec(...)` — locals bound
+        # to *GridSpec ctors (RL022 resolves `grid_spec=grid_spec`)
+        self.gridspec_locals: dict[str, ast.Call] = {}
         self.nested_defs: list[str] = []  # names of enclosing nested defs
         self.root = info.node
         self.module_scope = isinstance(info.node, ast.Module)
@@ -617,6 +778,11 @@ class _FunctionScanner(ast.NodeVisitor):
                     # rebinding to a non-kind value invalidates the local:
                     # a later send of it must not report a phantom kind
                     self.tuple_kind_locals.pop(tgt.id, None)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    self.tuple_rank_locals[tgt.id] = len(v.elts)
+                else:
+                    self.tuple_rank_locals.pop(tgt.id, None)
+        self._scan_spmd_assign(node)
         for tgt in node.targets:
             if isinstance(tgt, (ast.Tuple, ast.List)):
                 for elt in tgt.elts:
@@ -634,6 +800,44 @@ class _FunctionScanner(ast.NodeVisitor):
                         NameAccess(tgt.id, node, "store", self._held_rt())
                     )
         self.generic_visit(node)
+        # placements are recorded by visit_Call during the generic visit;
+        # a directly-bound one gets its target names here (RL024 tracks
+        # the bound value into later jitted calls)
+        if isinstance(v, ast.Call):
+            names = tuple(t.id for t in node.targets if isinstance(t, ast.Name))
+            if names:
+                for p in self.info.placements:
+                    if p.node is v:
+                        p.bound_names = names
+
+    def _scan_spmd_assign(self, node: ast.Assign) -> None:
+        """Mesh/SPMD bindings: mesh ctors, P literals, NamedSharding
+        handles and make_async_remote_copy DMA handles bound to names."""
+        v = node.value
+        if not isinstance(v, ast.Call):
+            return
+        names = tuple(t.id for t in node.targets if isinstance(t, ast.Name))
+        if not names:
+            return
+        chain = dotted_parts(v.func)
+        if not chain:
+            return
+        last = chain[-1]
+        if _MESH_CTOR_RE.match(last):
+            self.info.mesh_binds.append(
+                MeshBind(names=names, ctor_chain=chain, node=v)
+            )
+        elif last == "make_async_remote_copy":
+            for n in names:
+                self.info.dma_binds.append((n, v))
+        elif last in ("P", "PartitionSpec"):
+            for n in names:
+                self.info.spec_locals[n] = v
+        elif last == "NamedSharding":
+            self.info.named_sharding_locals.update(names)
+        elif last.endswith("GridSpec"):
+            for n in names:
+                self.gridspec_locals[n] = v
 
     def _param_kindvars(self) -> dict:
         got = getattr(self.info, "_param_kindvars", None)
@@ -909,6 +1113,7 @@ class _FunctionScanner(ast.NodeVisitor):
             emit = self.index._emit_from_call(chain, node, self.info)
             if emit is not None:
                 self.index.emits.append((emit, self.info))
+            self._scan_spmd_call(chain, node)
             self.info.calls.append(
                 CallSite(
                     chain=chain, node=node, held=tuple(self.held),
@@ -916,6 +1121,76 @@ class _FunctionScanner(ast.NodeVisitor):
                 )
             )
         self.generic_visit(node)
+
+    def _scan_spmd_call(self, chain: Tuple[str, ...], node: ast.Call) -> None:
+        """Mesh/SPMD call sites: collectives, pallas_call, P literals,
+        NamedSharding/constrain pairings, device_put/global_put
+        placements (RL020-RL024 raw material)."""
+        info = self.info
+        last = chain[-1]
+        if last in _COLLECTIVE_AXIS_POS and (
+            len(chain) == 1 or chain[-2] in _COLLECTIVE_BASES
+        ):
+            pos = _COLLECTIVE_AXIS_POS[last]
+            axis = None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis = kw.value
+            if axis is None and len(node.args) > pos:
+                axis = node.args[pos]
+            if axis is not None:
+                axes: Tuple[str, ...] = ()
+                param = None
+                if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+                    axes = (axis.value,)
+                elif isinstance(axis, (ast.Tuple, ast.List)) and axis.elts and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in axis.elts
+                ):
+                    axes = tuple(e.value for e in axis.elts)
+                elif isinstance(axis, ast.Name) and axis.id in info.param_names:
+                    param = axis.id
+                if axes or param is not None:
+                    info.collectives.append(
+                        CollectiveSite(op=last, axes=axes, axis_param=param, node=node)
+                    )
+        elif last == "pallas_call":
+            info.pallas_sites.append(
+                _pallas_site(node, self.tuple_rank_locals, self.gridspec_locals)
+            )
+        elif last in ("P", "PartitionSpec"):
+            info.spec_sites.append(
+                SpecSite(entries=_spec_entries(node), node=node)
+            )
+        elif last == "NamedSharding" and node.args:
+            spec = node.args[1] if len(node.args) >= 2 else None
+            info.named_shardings.append(
+                NamedShardingSite(
+                    mesh_chain=dotted_parts(node.args[0]),
+                    spec=spec if _is_spec_call(spec) else None,
+                    node=node,
+                )
+            )
+        elif last == "constrain" and len(node.args) >= 3:
+            # the repo's `constrain(x, mesh, spec)` sharding-constraint
+            # helper carries the same mesh/spec pairing as NamedSharding
+            spec = node.args[2]
+            info.named_shardings.append(
+                NamedShardingSite(
+                    mesh_chain=dotted_parts(node.args[1]),
+                    spec=spec if _is_spec_call(spec) else None,
+                    node=node,
+                )
+            )
+        elif last in ("device_put", "global_put"):
+            site = _placement_site(node, last)
+            if (
+                site.sharding == "other"
+                and isinstance(site.sharding_node, ast.Name)
+                and site.sharding_node.id in info.named_sharding_locals
+            ):
+                site.sharding = "named"
+            info.placements.append(site)
 
     def visit_Attribute(self, node):
         if isinstance(node.ctx, ast.Load):
@@ -1050,6 +1325,20 @@ class ProjectIndex:
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)
                 ]
                 mi.lockfree.append((vals, stmt))
+            if name == "INTERPRET_ONLY" and isinstance(v, (ast.Tuple, ast.List)):
+                vals = [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                mi.interpret_only.append((vals, stmt))
+        if isinstance(v, (ast.Tuple, ast.List)) and v.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in v.elts
+        ):
+            vals_t = tuple(e.value for e in v.elts)
+            for name in names:
+                mi.str_tuples[name] = vals_t
 
     def _scan_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
         ci = ClassInfo(node, mi.ctx, mi.module)
@@ -1208,30 +1497,68 @@ class ProjectIndex:
 
     def _jit_site_from_call(self, node: ast.AST) -> Optional[JitSite]:
         """``jax.jit(fn, ...)`` / ``shard_map(fn, mesh=...)``, unwrapping a
-        ``functools.partial(fn, ...)`` first argument."""
+        ``functools.partial(fn, ...)`` first argument and seeing through
+        ONE composition level — ``jax.jit(shard_map(f, ...))`` and
+        ``shard_map(jax.jit(f), ...)`` — so donation/static/spec facts
+        from both wrapper levels merge onto the inner target (the form
+        the multi-chip engine will lean on; RL013/RL014 must not go
+        silent there)."""
         if not isinstance(node, ast.Call):
             return None
         chain = dotted_parts(node.func)
         if not chain or chain[-1] not in _JIT_WRAPPERS or not node.args:
             return None
         target = node.args[0]
+        partial_pos = 0
+        partial_kw: Tuple[str, ...] = ()
+        inner_site = None
         if isinstance(target, ast.Call):
             inner = dotted_parts(target.func)
             if inner and inner[-1] == "partial" and target.args:
+                partial_pos = len(target.args) - 1
+                partial_kw = tuple(kw.arg for kw in target.keywords if kw.arg)
                 target = target.args[0]
-        return JitSite(
+            elif inner and inner[-1] in _JIT_WRAPPERS:
+                inner_site = self._jit_site_from_call(target)
+        site = JitSite(
             target_chain=dotted_parts(target),
             node=node,
             wrapper=chain[-1],
             static_argnums=_kw_int_tuple(node, "static_argnums"),
             static_argnames=_kw_str_tuple(node, "static_argnames"),
             donate_argnums=_kw_int_tuple(node, "donate_argnums"),
+            partial_pos=partial_pos,
+            partial_kw=partial_kw,
         )
+        _fill_spec_fields(site, node, positional=True)
+        if inner_site is not None:
+            site.target_chain = inner_site.target_chain
+            site.composed_with = inner_site.wrapper
+            site.static_argnums = tuple(
+                sorted(set(site.static_argnums) | set(inner_site.static_argnums))
+            )
+            site.static_argnames = tuple(
+                sorted(set(site.static_argnames) | set(inner_site.static_argnames))
+            )
+            site.donate_argnums = tuple(
+                sorted(set(site.donate_argnums) | set(inner_site.donate_argnums))
+            )
+            site.partial_pos = inner_site.partial_pos
+            site.partial_kw = inner_site.partial_kw
+            for field in (
+                "mesh_expr", "in_specs", "out_specs",
+                "in_shardings", "out_shardings",
+            ):
+                if getattr(site, field) is None:
+                    setattr(site, field, getattr(inner_site, field))
+            if not site.axis_name:
+                site.axis_name = inner_site.axis_name
+        return site
 
     def _jit_decorator(self, dec: ast.AST, info: FuncInfo) -> Optional[JitSite]:
         chain = dotted_parts(dec.func if isinstance(dec, ast.Call) else dec)
         if chain and chain[-1] in _JIT_WRAPPERS:
-            return JitSite(
+            site = JitSite(
                 target_chain=None,
                 node=dec,
                 wrapper=chain[-1],
@@ -1249,11 +1576,14 @@ class ProjectIndex:
                 ),
                 decorator_of=info.key,
             )
+            if isinstance(dec, ast.Call):
+                _fill_spec_fields(site, dec, positional=False)
+            return site
         # @partial(jax.jit, static_argnums=...)
         if isinstance(dec, ast.Call) and chain and chain[-1] == "partial" and dec.args:
             inner = dotted_parts(dec.args[0])
             if inner and inner[-1] in _JIT_WRAPPERS:
-                return JitSite(
+                site = JitSite(
                     target_chain=None,
                     node=dec,
                     wrapper=inner[-1],
@@ -1262,6 +1592,8 @@ class ProjectIndex:
                     donate_argnums=_kw_int_tuple(dec, "donate_argnums"),
                     decorator_of=info.key,
                 )
+                _fill_spec_fields(site, dec, positional=False)
+                return site
         return None
 
     def _blocking_label(self, chain, node: ast.Call):
@@ -1618,6 +1950,17 @@ class ProjectIndex:
                 out.append((mi.module, vals, node, mi.ctx))
         return out
 
+    def interpret_only_decls(self):
+        """Declared RL022 interpret-mode registries: (module, entries,
+        anchor, ctx). An entry is ``"<kernel-wrapper name>: reason"`` —
+        the named module function wraps a pallas_call whose production
+        (compiled) path is currently unexercised off-TPU."""
+        out = []
+        for mi in self.modules.values():
+            for vals, node in mi.interpret_only:
+                out.append((mi.module, vals, node, mi.ctx))
+        return out
+
     def prom_refs(self):
         out = []
         for mi in self.modules.values():
@@ -1654,6 +1997,210 @@ def _kw_str_tuple(node: ast.Call, name: str) -> Tuple[str, ...]:
                     if isinstance(e, ast.Constant) and isinstance(e.value, str)
                 )
     return ()
+
+
+def _kw_expr(node: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _fill_spec_fields(site: JitSite, node: ast.Call, positional: bool) -> None:
+    """Spec/mesh kwargs onto a JitSite; ``positional`` additionally maps
+    ``shard_map(f, mesh, in_specs, out_specs)`` positional operands (only
+    safe at direct call sites — a ``@partial(shard_map, ...)`` decorator's
+    positionals bind BEFORE the traced function)."""
+    site.mesh_expr = _kw_expr(node, "mesh")
+    site.in_specs = _kw_expr(node, "in_specs")
+    site.out_specs = _kw_expr(node, "out_specs")
+    site.in_shardings = _kw_expr(node, "in_shardings")
+    site.out_shardings = _kw_expr(node, "out_shardings")
+    if positional and site.wrapper == "shard_map":
+        pos = list(node.args[1:4]) + [None, None, None]
+        if site.mesh_expr is None:
+            site.mesh_expr = pos[0]
+        if site.in_specs is None:
+            site.in_specs = pos[1]
+        if site.out_specs is None:
+            site.out_specs = pos[2]
+    if site.wrapper == "pmap":
+        ax = _kw_expr(node, "axis_name")
+        if ax is None and positional and len(node.args) >= 2:
+            ax = node.args[1]
+        if isinstance(ax, ast.Constant) and isinstance(ax.value, str):
+            site.axis_name = (ax.value,)
+
+
+def _is_spec_call(expr: Optional[ast.AST]) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    d = dotted_parts(expr.func)
+    return bool(d) and d[-1] in ("P", "PartitionSpec")
+
+
+def _spec_entries(call: ast.Call) -> Tuple[object, ...]:
+    """P(...) positional entries: str / tuple-of-str / None / '?' (dynamic)
+    / '*' (starred splat — rank unknowable)."""
+    out: list = []
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            out.append("*")
+        elif isinstance(a, ast.Constant) and (
+            a.value is None or isinstance(a.value, str)
+        ):
+            out.append(a.value)
+        elif isinstance(a, (ast.Tuple, ast.List)) and a.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in a.elts
+        ):
+            out.append(tuple(e.value for e in a.elts))
+        else:
+            out.append("?")
+    return tuple(out)
+
+
+def _literal_array_rank(expr: ast.AST) -> Optional[int]:
+    """Rank of ``np.zeros((4, 8))``-style literal array constructions."""
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted_parts(expr.func)
+    if not d or d[-1] not in ("zeros", "ones", "empty", "full"):
+        return None
+    if not expr.args:
+        return None
+    shp = expr.args[0]
+    if isinstance(shp, (ast.Tuple, ast.List)):
+        return len(shp.elts)
+    if isinstance(shp, ast.Constant) and isinstance(shp.value, int):
+        return 1
+    return None
+
+
+def _placement_site(node: ast.Call, fn: str) -> PlacementSite:
+    sh = node.args[1] if len(node.args) >= 2 else None
+    for kw in node.keywords:
+        if kw.arg in ("device", "sharding"):
+            sh = kw.value
+    kind = "absent" if sh is None else "other"
+    spec_rank = None
+    if sh is not None:
+        shc = dotted_parts(sh.func) if isinstance(sh, ast.Call) else None
+        if shc and shc[-1] == "NamedSharding":
+            kind = "named"
+            if len(sh.args) >= 2 and _is_spec_call(sh.args[1]):
+                entries = _spec_entries(sh.args[1])
+                if "*" not in entries:
+                    spec_rank = len(entries)
+        elif shc and shc[-1] == "SingleDeviceSharding":
+            kind = "single"
+    return PlacementSite(
+        fn=fn, sharding=kind, sharding_node=sh, spec_rank=spec_rank,
+        operand_rank=_literal_array_rank(node.args[0]) if node.args else None,
+        bound_names=(), node=node,
+    )
+
+
+def _block_spec(call: ast.Call) -> BlockSpecInfo:
+    """``pl.BlockSpec((1, bq, d), lambda b, i, j: ...)`` — block shape
+    first, index_map second (keyword spellings accepted)."""
+    kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    shp = call.args[0] if call.args else kws.get("block_shape")
+    shape = None
+    if isinstance(shp, (ast.Tuple, ast.List)):
+        entries: list = []
+        for e in shp.elts:
+            if isinstance(e, ast.Constant) and (
+                e.value is None or isinstance(e.value, int)
+            ):
+                entries.append(e.value)
+            else:
+                entries.append("?")
+        shape = tuple(entries)
+    im = call.args[1] if len(call.args) >= 2 else kws.get("index_map")
+    arity = None
+    if isinstance(im, ast.Lambda) and not (im.args.vararg or im.args.kwarg):
+        arity = len(im.args.args)
+    return BlockSpecInfo(block_shape=shape, index_map_arity=arity, node=call)
+
+
+def _pallas_site(call: ast.Call, tuple_ranks: dict, gridspecs: dict) -> PallasSite:
+    """Everything RL022 reads off one ``pl.pallas_call``; ``tuple_ranks``
+    resolves a ``grid=grid`` local bound to a tuple literal earlier in
+    the scope, ``gridspecs`` a ``grid_spec=grid_spec`` local bound to a
+    ``*GridSpec(...)`` ctor."""
+    kernel = call.args[0] if call.args else None
+    if isinstance(kernel, ast.Call):
+        kd = dotted_parts(kernel.func)
+        if kd and kd[-1] == "partial" and kernel.args:
+            kernel = kernel.args[0]
+    kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    grid_rank = None
+    prefetch = 0
+    scalar_grid = False
+    spec_srcs = [kws]
+    gs = kws.get("grid_spec")
+    if isinstance(gs, ast.Name):
+        gs = gridspecs.get(gs.id)
+    if isinstance(gs, ast.Call):
+        gd = dotted_parts(gs.func)
+        gkws = {kw.arg: kw.value for kw in gs.keywords if kw.arg}
+        spec_srcs.append(gkws)
+        if gd and "Prefetch" in gd[-1]:
+            scalar_grid = True
+            npf = gkws.get("num_scalar_prefetch")
+            if isinstance(npf, ast.Constant) and isinstance(npf.value, int):
+                prefetch = npf.value
+    for src in spec_srcs:
+        g = src.get("grid")
+        if isinstance(g, (ast.Tuple, ast.List)):
+            grid_rank = len(g.elts)
+        elif isinstance(g, ast.Constant) and isinstance(g.value, int):
+            grid_rank = 1
+        elif isinstance(g, ast.Name) and g.id in tuple_ranks:
+            grid_rank = tuple_ranks[g.id]
+    blocks: list = []
+    for src in spec_srcs:
+        for key in ("in_specs", "out_specs"):
+            v = src.get(key)
+            if v is None:
+                continue
+            elems = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elems:
+                if isinstance(e, ast.Call):
+                    d = dotted_parts(e.func)
+                    if d and d[-1] == "BlockSpec":
+                        bs = _block_spec(e)
+                        bs.role = "out" if key == "out_specs" else "in"
+                        blocks.append(bs)
+    interp = "absent"
+    ichain = None
+    iv = kws.get("interpret")
+    if iv is not None:
+        if isinstance(iv, ast.Constant):
+            interp = "true" if iv.value else "false"
+        else:
+            interp = "dynamic"
+            if isinstance(iv, ast.Call):
+                ichain = dotted_parts(iv.func)
+    dims = None
+    osv = kws.get("out_shape")
+    if isinstance(osv, ast.Call):
+        od = dotted_parts(osv.func)
+        if od and od[-1] == "ShapeDtypeStruct" and osv.args:
+            shp = osv.args[0]
+            if isinstance(shp, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in shp.elts
+            ):
+                dims = tuple(e.value for e in shp.elts)
+    return PallasSite(
+        kernel_chain=dotted_parts(kernel) if kernel is not None else None,
+        grid_rank=grid_rank, num_scalar_prefetch=prefetch,
+        scalar_grid=scalar_grid, block_specs=tuple(blocks),
+        interpret=interp, interpret_chain=ichain,
+        out_shape_dims=dims, node=call,
+    )
 
 
 def build_index(

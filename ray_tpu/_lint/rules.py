@@ -1768,3 +1768,223 @@ class ProtocolMessageDrift(ProjectRule):
                     "fail or re-ship its contents from the reconnect "
                     "sweep (_fail_submits/_try_reconnect shape)",
                 )
+
+
+# ---------------------------------------------------------------------------
+# RL020-RL024: mesh / sharding / Pallas contract rules (phase 2.1,
+# ray_tpu._lint.spmd)
+# ---------------------------------------------------------------------------
+
+
+# --------------------------------------------------------------------- RL020
+
+
+@register
+class UnboundCollectiveAxis(ProjectRule):
+    id = "RL020"
+    name = "unbound-collective-axis"
+    description = (
+        "A collective (psum/pmean/ppermute/all_gather/psum_scatter/"
+        "all_to_all/axis_index/axis_size) names a LITERAL axis that no "
+        "enclosing shard_map/pmap can bind: the call raises NameError-"
+        "style trace errors ('unbound axis name') the first time the "
+        "function is actually traced under a mesh — typically in the "
+        "multi-chip path that unit tests never reach. Binding "
+        "environments come from the jit registry: every shard_map/pmap "
+        "site contributes its resolved mesh axes to the traced target "
+        "AND the site's owner scope (nested-def bodies fold into the "
+        "owner); a function's allowed set is its own env unioned with "
+        "its direct callers' envs, one level deep. A site whose mesh is "
+        "opaque (parameter meshes) contributes ANY, which suppresses "
+        "the rule — it can miss, it must not invent. Collectives whose "
+        "axis is a parameter are promoted to callers passing a literal "
+        "axis (or relying on a literal default) when neither side can "
+        "bind it."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import spmd
+
+        model = spmd.get_model(index)
+        for hit in model.collective_violations():
+            where = (
+                f" (reached through {hit.via} from this call)"
+                if hit.via
+                else ""
+            )
+            yield hit.info.ctx.violation(
+                self, hit.node,
+                f"collective {hit.op} names axis {hit.axis!r} but no "
+                f"enclosing shard_map/pmap binds it{where} — tracing "
+                "under a mesh raises 'unbound axis name'; wrap the call "
+                "in a shard_map over a mesh with that axis or thread the "
+                "axis name from the binding site",
+            )
+
+
+# --------------------------------------------------------------------- RL021
+
+
+@register
+class SpecMeshDrift(ProjectRule):
+    id = "RL021"
+    name = "spec-mesh-drift"
+    description = (
+        "A PartitionSpec disagrees with the mesh or operand it runs "
+        "against: a P(...) literal reachable from a shard_map site's "
+        "in_specs/out_specs (or paired inside NamedSharding(mesh, "
+        "P(...))) names an axis the resolved mesh does not have — a "
+        "KeyError at trace time, or silent replication when the axis "
+        "exists on a different mesh; an in_specs tuple whose arity "
+        "cannot match the traced target's visible parameter span "
+        "(functools.partial pre-bound positions/keywords shrink it, "
+        "defaults widen the lower bound) — a pytree structure error on "
+        "first call; or a placement whose P names more dims than its "
+        "literal-rank operand has. Parameter meshes and dynamic spec "
+        "entries are skipped (documented under-approximations)."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import spmd
+
+        model = spmd.get_model(index)
+        for hit in model.spec_violations():
+            yield hit.info.ctx.violation(self, hit.node, hit.detail)
+
+
+# --------------------------------------------------------------------- RL022
+
+
+@register
+class PallasContractDrift(ProjectRule):
+    id = "RL022"
+    name = "pallas-contract-drift"
+    description = (
+        "A pl.pallas_call whose static contract is internally "
+        "inconsistent, or whose compiled path has silently lost "
+        "coverage. Shape checks: a BlockSpec index_map whose arity "
+        "differs from the grid rank (plus num_scalar_prefetch under a "
+        "PrefetchScalarGridSpec — scalar-prefetch operands are "
+        "prepended to every index_map) fails inside Mosaic with an "
+        "arity error naming neither site; an out-block dim that "
+        "provably does not divide a literal out_shape dim, with no "
+        "masking evidence (pl.when / mask identifiers) in the resolved "
+        "kernel, reads/writes out of bounds in the tail block. "
+        "Coverage: an interpret-GATED kernel wrapper (interpret=True "
+        "hardcoded, or a same-module dispatcher that calls it and "
+        "branches on its gate call as an un-negated disjunct — 'if "
+        "_interpret() or ...: return xla_path' routes AWAY from the "
+        "compiled path exactly where CI runs) must be declared in a "
+        "module-level INTERPRET_ONLY registry with a reason, so the "
+        "ROADMAP's 'kernels still gated to interpret mode' debt is "
+        "machine-tracked. The registry is verified bidirectionally: "
+        "undeclared gated wrappers fire, and stale entries naming no "
+        "gated wrapper fire, so un-gating a kernel forces the entry to "
+        "be retired with the debt."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import spmd
+
+        model = spmd.get_model(index)
+        for hit in model.pallas_violations():
+            yield hit.ctx.violation(self, hit.node, hit.detail)
+
+
+# --------------------------------------------------------------------- RL023
+
+
+@register
+class UnpairedRemoteDma(ProjectRule):
+    id = "RL023"
+    name = "unpaired-remote-dma"
+    description = (
+        "A make_async_remote_copy handle whose .start() has a path to "
+        "function exit — exception edges included — that skips the "
+        "matching .wait(): the send/recv semaphore stays permanently "
+        "unsignaled on the peer chip, and the NEXT DMA on that "
+        "semaphore deadlocks the whole mesh, arbitrarily far from the "
+        "cause (the failure mode the Pallas async-copy docs warn "
+        "about). RL015's ownership machinery applied to DMA handles: "
+        ".wait()/.wait_send()/.wait_recv() release; handing the handle "
+        "to a call, returning it, or entering it as a context manager "
+        "transfers ownership to the receiver."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow, spmd
+
+        cache = dataflow.get_cache(index)
+        model = spmd.get_model(index)
+        for info in _analyzable_functions(index):
+            if not info.dma_binds:
+                continue
+            acqs = model.dma_acquisitions(info)
+            if not acqs:
+                continue
+            for leak in dataflow.resource_leaks(
+                cache, info, acqs, report_normal_exit=True
+            ):
+                if leak.kind == "raise":
+                    yield info.ctx.violation(
+                        self, leak.escape_node,
+                        f"remote DMA {leak.acq.label} (line "
+                        f"{leak.acq.call.lineno}) can escape here without "
+                        "its .wait() — the semaphore stays unsignaled on "
+                        "the peer and the next DMA on it deadlocks the "
+                        "mesh; wait (or wait_send/wait_recv) on every "
+                        "path, including exception edges",
+                    )
+                else:
+                    yield info.ctx.violation(
+                        self, leak.acq.call,
+                        f"remote DMA {leak.acq.label} is started but no "
+                        "path waits on it before exit — the transfer is "
+                        "never synchronized and the semaphore leaks; pair "
+                        "every start() with wait()",
+                    )
+
+
+# --------------------------------------------------------------------- RL024
+
+
+@register
+class ShardingDrift(ProjectRule):
+    id = "RL024"
+    name = "sharding-drift"
+    description = (
+        "A value placed on the DEFAULT device (device_put with no "
+        "sharding operand) or with an explicit SingleDeviceSharding "
+        "flows into a registry-resolved jitted call whose matching "
+        "positional in_shardings entry is a NamedSharding: every call "
+        "re-lays-out the operand across the mesh and, when the "
+        "committed sharding differs, retraces — the exact bug PR 13 "
+        "fixed in shard_train_state (step counter placed single-device "
+        "against a mesh-jitted step fn, silently recompiling fwd+bwd "
+        "every train step; 2x step time, no exception). Flagged at the "
+        "PLACEMENT site, where the fix goes. Requires the placed value "
+        "bound to a name and passed as that bare name in the same "
+        "function (placement before call in source order); a later re-"
+        "placement with a NamedSharding clears it."
+    )
+
+    def check_project(self, index) -> Iterator[Violation]:
+        from ray_tpu._lint import dataflow, spmd
+
+        cache = dataflow.get_cache(index)
+        model = spmd.get_model(index)
+        for hit in model.drift_violations(cache):
+            name = hit.placement.bound_names[0]
+            how = (
+                "an explicit SingleDeviceSharding"
+                if hit.placement.sharding == "single"
+                else "no sharding operand (committed to the default device)"
+            )
+            yield hit.info.ctx.violation(
+                self, hit.placement.node,
+                f"{name} is placed with {how} but flows into {hit.jit_label} "
+                f"(line {hit.call_node.lineno}) whose in_shardings[{hit.pos}] "
+                "is a NamedSharding — every call re-lays-out the operand "
+                "and retraces on sharding mismatch; place it with the "
+                "matching NamedSharding up front",
+            )

@@ -1,0 +1,821 @@
+"""raylint phase 2.1: the mesh / sharding / Pallas-contract model
+(RL020-RL024).
+
+The ROADMAP's next subsystem — the KV pool and paged attention sharded
+over a ``tp`` mesh with shard_map + Pallas remote DMA — multiplies the
+SPMD surface the way PR 14 multiplied the concurrency surface. The
+costliest bugs on that surface are silent: PR 13's true positive
+(``shard_train_state`` placing ``step`` with ``SingleDeviceSharding``
+against the mesh, recompiling fwd+bwd every train step) produced no
+exception, only a 2x step time. This module mechanizes that review over
+the sites the index recorded:
+
+* **Axis-binding environments (RL020)** — every ``shard_map``/``pmap``
+  jit site contributes its mesh's axis names to the functions that can
+  execute under it: the resolved traced target AND the site's owner
+  (nested-def bodies fold their collectives into the owner scope). A
+  site whose mesh cannot be statically resolved contributes the ANY
+  marker, which suppresses the rule for that function — a rule can
+  miss, it must not invent. A collective's literal axis fires when the
+  function's allowed set (own env ∪ direct callers' envs, one level)
+  is ANY-free and lacks the axis. Collectives whose axis is a
+  parameter are promoted to the CALLER: a caller passing a literal
+  axis (or relying on a literal default) fires at its call site when
+  both the callee's and the caller's allowed sets are ANY-free and
+  lack the axis.
+* **Spec/mesh drift (RL021)** — ``P(...)`` literals reachable from a
+  shard_map site's in_specs/out_specs (through local ``name = P(...)``
+  binds) and ``NamedSharding(mesh, P(...))`` pairings are checked
+  against the mesh's resolved axis universe; ``in_specs`` tuple arity
+  is checked against the traced target's visible parameter span
+  (functools.partial pre-bound positions/keywords shrink it, defaults
+  widen the lower bound); a placement whose ``P(...)`` names more dims
+  than its literal-rank operand has fires at the placement.
+* **Pallas contracts (RL022)** — index_map arity must equal grid rank
+  (+ num_scalar_prefetch when the grid came from a
+  PrefetchScalarGridSpec — scalar-prefetch operands are prepended to
+  every index_map); an out-block shape that provably does not divide a
+  literal out_shape dim with no masking evidence (``pl.when`` / a
+  mask-named identifier in the resolved kernel) fires; and
+  interpret-GATED kernel wrappers must be declared in a module-level
+  ``INTERPRET_ONLY`` registry. A wrapper is gated when its pallas site
+  hardcodes ``interpret=True``, or when a dispatcher in the module
+  both calls it and branches on the site's interpret gate call as an
+  un-negated disjunct (``if _interpret() or ...: return xla_path``) —
+  i.e. the module routes AWAY from the compiled path exactly where CI
+  runs, so the kernel's production path has zero validation coverage.
+  The registry is verified bidirectionally: a gated wrapper missing
+  from it fires, and a stale entry naming no gated wrapper fires, so
+  un-gating a kernel forces the declared debt to be retired with it.
+* **Remote-DMA pairing (RL023)** — a ``make_async_remote_copy`` handle
+  whose ``.start()`` has a path to exit (exception edges included)
+  skipping ``.wait()`` leaves a semaphore permanently unsignaled on
+  the peer chip — the next DMA on that semaphore deadlocks the mesh,
+  far from the cause. RL015's Acquisition machinery applied to DMA
+  handles: ``.wait()``/``.wait_send()``/``.wait_recv()`` release,
+  hand-off/return/``with`` transfer ownership.
+* **Sharding drift (RL024)** — a value bound from a ``device_put``
+  with no sharding operand (committed to the default device) or an
+  explicit ``SingleDeviceSharding``, flowing into a registry-resolved
+  jitted call whose matching positional ``in_shardings`` entry is a
+  ``NamedSharding``, fires at the placement site: every such call
+  re-lays-out the operand and retraces — the PR 13 bug class, static.
+
+Precision choices (documented under-approximations — each can miss,
+none can invent):
+
+* A shard_map whose mesh expression does not resolve to literal axis
+  names (parameter meshes — ``pipeline.py``, ``train_step.py``,
+  ``sharding.py``) yields the ANY environment, suppressing RL020/RL021
+  axis checks for everything under it.
+* Nested-def shard_map bodies credit the OWNER scope's whole env, so
+  owner-scope collectives outside the body also get credit (over-
+  approximation in the safe direction).
+* Param-axis promotion only reads keyword arguments and literal
+  defaults at caller sites; positional axis operands are not promoted.
+* ``in_specs`` arity is only checked when the spec is a literal
+  tuple/list and the traced target resolves with no vararg/kwarg.
+* RL022 treats ``wait_send`` alone as a full release (miss direction);
+  divisibility only fires on literal out_shape dims vs literal
+  out-block dims with no masking evidence in the resolved kernel.
+* RL024 requires the placed value to be BOUND to a name and passed as
+  that bare name, in the same function, placement before call in
+  source order; a later re-placement of the same name with a
+  NamedSharding clears it. Comprehension-internal placements
+  (learner.py's fetch loop) have no bound name and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._lint.dataflow import (
+    Acquisition,
+    calls_in,
+    resource_leaks,
+    scope_stmts,
+)
+from ray_tpu._lint.index import (
+    FuncInfo,
+    JitSite,
+    PallasSite,
+    PlacementSite,
+    ProjectIndex,
+    _kw_expr,
+    _spec_entries,
+    dotted_parts,
+)
+
+
+class _Any:
+    """Unresolvable binding environment — suppresses, never fires."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "ANY"
+
+
+ANY = _Any()
+
+#: DMA handle release methods; ``wait_send`` alone is treated as a full
+#: release (documented miss-direction under-approximation — splitting
+#: send/recv waits across paths is a deliberate overlap idiom)
+DMA_RELEASES = ("wait", "wait_send", "wait_recv")
+
+
+# --------------------------------------------------------------- mesh axes
+
+
+def _module_scope(index: ProjectIndex, module: str) -> Optional[FuncInfo]:
+    mi = index.modules.get(module)
+    return mi.scope if mi is not None else None
+
+
+def _axes_of_names_expr(
+    expr: Optional[ast.AST], module: str, index: ProjectIndex
+) -> Optional[Tuple[str, ...]]:
+    """An ``axis_names`` operand -> literal axis tuple: string/tuple
+    literals, ``tuple(NAME)`` unwrapping, module string-tuple globals
+    (``AXES``) with one import-following hop."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Call):
+        d = dotted_parts(expr.func)
+        if d and d[-1] == "tuple" and len(expr.args) == 1:
+            expr = expr.args[0]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        if expr.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in expr.elts
+        ):
+            return tuple(e.value for e in expr.elts)
+        return None
+    if isinstance(expr, ast.Name):
+        mi = index.modules.get(module)
+        if mi is None:
+            return None
+        got = mi.str_tuples.get(expr.id)
+        if got:
+            return got
+        tgt = mi.imports.get(expr.id)
+        if tgt and "." in tgt:
+            mod, _, name = tgt.rpartition(".")
+            tmi = index.modules.get(mod)
+            if tmi is not None:
+                return tmi.str_tuples.get(name)
+    return None
+
+
+def _axes_of_ctor(
+    call: ast.Call, info: FuncInfo, index: ProjectIndex
+) -> Optional[Tuple[str, ...]]:
+    """``Mesh(arr, axis_names)`` / ``make_*mesh(...)`` -> axis names.
+    Factories resolve through the call graph to their ``axis_names``
+    keyword-only default when the call site doesn't override it."""
+    d = dotted_parts(call.func)
+    if not d:
+        return None
+    last = d[-1]
+    if last == "Mesh":
+        ax = _kw_expr(call, "axis_names")
+        if ax is None and len(call.args) >= 2:
+            ax = call.args[1]
+        return _axes_of_names_expr(ax, info.module, index)
+    if last.startswith("make_") and last.endswith("mesh"):
+        ax = _kw_expr(call, "axis_names")
+        if ax is not None:
+            return _axes_of_names_expr(ax, info.module, index)
+        callee = index.resolve_call(info, d)
+        if callee is None:
+            return None
+        args = getattr(callee.node, "args", None)
+        if args is None:
+            return None
+        for kwonly, default in zip(args.kwonlyargs, args.kw_defaults):
+            if kwonly.arg == "axis_names" and default is not None:
+                return _axes_of_names_expr(default, callee.module, index)
+    return None
+
+
+def mesh_axes(
+    index: ProjectIndex, info: FuncInfo, expr: Optional[ast.AST]
+) -> Optional[Tuple[str, ...]]:
+    """A mesh expression -> its axis-name tuple, or None (unresolvable:
+    parameter meshes, attribute chains the index can't anchor)."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Call):
+        return _axes_of_ctor(expr, info, index)
+    chain = dotted_parts(expr)
+    if not chain:
+        return None
+    return _axes_of_chain(index, info, chain)
+
+
+def _axes_of_chain(
+    index: ProjectIndex, info: FuncInfo, chain: Tuple[str, ...]
+) -> Optional[Tuple[str, ...]]:
+    if len(chain) == 1:
+        if chain[0] in info.param_names:
+            return None
+        for scope in (info, _module_scope(index, info.module)):
+            if scope is None:
+                continue
+            for mb in scope.mesh_binds:
+                if chain[0] in mb.names:
+                    got = _axes_of_ctor(mb.node, scope, index)
+                    if got is not None:
+                        return got
+        return None
+    if (
+        info.self_name
+        and chain[0] == info.self_name
+        and info.cls is not None
+        and len(chain) == 2
+    ):
+        for _in_init, _kind, value in info.cls.attr_assigns.get(chain[1], []):
+            if isinstance(value, ast.Call):
+                got = _axes_of_ctor(value, info, index)
+                if got is not None:
+                    return got
+    return None
+
+
+# --------------------------------------------------------------- the model
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveHit:
+    """RL020: a literal collective axis no enclosing mesh binds."""
+
+    op: str
+    axis: str
+    node: ast.AST
+    info: FuncInfo
+    via: Optional[str] = None      # callee desc when promoted to a caller
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecHit:
+    """RL021: one spec/mesh drift finding."""
+
+    kind: str                      # 'axis' | 'arity' | 'rank'
+    node: ast.AST
+    info: FuncInfo
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasHit:
+    """RL022: one Pallas contract finding."""
+
+    kind: str                      # 'arity' | 'divide' | 'undeclared' | 'stale' | 'reasonless'
+    node: ast.AST
+    info: Optional[FuncInfo]       # None for registry-anchored findings
+    ctx: object                    # FileContext for the anchor
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementHit:
+    """RL024: a single-device placement feeding a NamedSharding slot."""
+
+    placement: PlacementSite
+    call_node: ast.Call
+    jit_label: str
+    pos: int
+    info: FuncInfo
+
+
+class SpmdModel:
+    """Whole-program mesh/sharding model, built once per lint run."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        #: FuncInfo.key -> set of axis names bound by some enclosing
+        #: shard_map/pmap, or ANY when a binding site's mesh is opaque
+        self.envs: Dict[str, object] = {}
+        #: FuncInfo.key -> [(caller FuncInfo, CallSite), ...]
+        self.callers: Dict[str, List[Tuple[FuncInfo, object]]] = {}
+        self._allowed_cache: Dict[str, object] = {}
+        self._build_envs()
+        self._build_callers()
+
+    # -- environments ------------------------------------------------------
+
+    def _build_envs(self) -> None:
+        for site, owner in self.index.jit_sites:
+            wrappers = site.wrappers()
+            if not ({"shard_map", "pmap"} & wrappers):
+                continue
+            axes: set = set()
+            opaque = False
+            if "shard_map" in wrappers:
+                got = mesh_axes(self.index, owner, site.mesh_expr)
+                if got is None:
+                    opaque = True
+                else:
+                    axes |= set(got)
+            if "pmap" in wrappers:
+                if site.axis_name:
+                    axes |= set(site.axis_name)
+                else:
+                    opaque = True
+            keys = {owner.key}
+            tgt = self.index.resolve_jit_target(site, owner)
+            if tgt is not None:
+                keys.add(tgt.key)
+            for key in keys:
+                if opaque or self.envs.get(key) is ANY:
+                    self.envs[key] = ANY
+                else:
+                    cur = self.envs.setdefault(key, set())
+                    cur |= axes
+
+    def _build_callers(self) -> None:
+        for info in self.index.functions.values():
+            for cs in info.calls:
+                callee = self.index.resolve_call(info, cs.chain)
+                if callee is not None and callee.key != info.key:
+                    self.callers.setdefault(callee.key, []).append((info, cs))
+
+    def allowed(self, key: str) -> object:
+        """Axis names possibly bound when ``key`` runs: own env ∪ every
+        direct caller's env (one level). ANY anywhere poisons the set."""
+        got = self._allowed_cache.get(key)
+        if got is not None:
+            return got
+        base = self.envs.get(key)
+        if base is ANY:
+            self._allowed_cache[key] = ANY
+            return ANY
+        out = set(base or ())
+        for caller, _cs in self.callers.get(key, ()):
+            env = self.envs.get(caller.key)
+            if env is ANY:
+                self._allowed_cache[key] = ANY
+                return ANY
+            out |= env or set()
+        self._allowed_cache[key] = out
+        return out
+
+    # -- RL020 -------------------------------------------------------------
+
+    def collective_violations(self) -> List[CollectiveHit]:
+        hits: List[CollectiveHit] = []
+        for info in self.index.functions.values():
+            if not info.collectives:
+                continue
+            al = self.allowed(info.key)
+            for c in info.collectives:
+                if c.axes:
+                    if al is ANY:
+                        continue
+                    for ax in c.axes:
+                        if ax not in al:
+                            hits.append(CollectiveHit(c.op, ax, c.node, info))
+                elif c.axis_param:
+                    hits.extend(self._promote_param_axis(info, c, al))
+        return hits
+
+    def _promote_param_axis(
+        self, info: FuncInfo, c, al: object
+    ) -> List[CollectiveHit]:
+        """A collective whose axis is ``info``'s parameter: fire at a
+        caller passing a literal axis (or relying on a literal default)
+        when neither side's allowed set can bind it."""
+        if al is ANY:
+            return []
+        default = _param_default_axis(info, c.axis_param)
+        hits: List[CollectiveHit] = []
+        for caller, cs in self.callers.get(info.key, ()):
+            ag = self.allowed(caller.key)
+            if ag is ANY:
+                continue
+            passed = _kw_expr(cs.node, c.axis_param)
+            if passed is None:
+                axes = default
+            elif isinstance(passed, ast.Constant) and isinstance(
+                passed.value, str
+            ):
+                axes = (passed.value,)
+            else:
+                continue               # dynamic / positional: not promoted
+            if axes is None:
+                continue
+            for ax in axes:
+                if ax not in al and ax not in ag:
+                    hits.append(
+                        CollectiveHit(
+                            c.op, ax, cs.node, caller,
+                            via=f"{info.qualname}({c.axis_param}=...)",
+                        )
+                    )
+        return hits
+
+    # -- RL021 -------------------------------------------------------------
+
+    def spec_violations(self) -> List[SpecHit]:
+        hits: List[SpecHit] = []
+        for site, owner in self.index.jit_sites:
+            if "shard_map" not in site.wrappers():
+                continue
+            axes = mesh_axes(self.index, owner, site.mesh_expr)
+            if axes is not None:
+                universe = set(axes)
+                for spec_expr in (site.in_specs, site.out_specs):
+                    for p_call in _spec_calls(spec_expr, owner):
+                        hits.extend(
+                            _axis_drift(p_call, universe, axes, owner)
+                        )
+            hits.extend(self._arity_drift(site, owner))
+        for info in self.index.functions.values():
+            for ns in info.named_shardings:
+                if ns.spec is None or ns.mesh_chain is None:
+                    continue
+                axes = _axes_of_chain(self.index, info, ns.mesh_chain)
+                if axes is None:
+                    continue
+                hits.extend(_axis_drift(ns.spec, set(axes), axes, info))
+            for p in info.placements:
+                if (
+                    p.spec_rank is not None
+                    and p.operand_rank is not None
+                    and p.spec_rank > p.operand_rank
+                ):
+                    hits.append(
+                        SpecHit(
+                            "rank", p.node, info,
+                            f"PartitionSpec names {p.spec_rank} dims but the "
+                            f"placed operand has rank {p.operand_rank}",
+                        )
+                    )
+        return hits
+
+    def _arity_drift(self, site: JitSite, owner: FuncInfo) -> List[SpecHit]:
+        """len(in_specs) vs the traced target's visible parameter span."""
+        spec = site.in_specs
+        if not isinstance(spec, (ast.Tuple, ast.List)):
+            return []
+        target = self.index.resolve_jit_target(site, owner)
+        if target is None:
+            return []
+        args = getattr(target.node, "args", None)
+        if args is None or args.vararg or args.kwarg:
+            return []
+        params = [a.arg for a in args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        defaulted = set(params[len(params) - len(args.defaults):])
+        bound_kw = set(site.partial_kw) & set(params)
+        visible = [
+            p
+            for i, p in enumerate(params)
+            if i >= site.partial_pos and p not in bound_kw
+        ]
+        hi = len(visible)
+        lo = hi - len([p for p in visible if p in defaulted])
+        n = len(spec.elts)
+        if lo <= n <= hi:
+            return []
+        want = str(hi) if lo == hi else f"{lo}..{hi}"
+        return [
+            SpecHit(
+                "arity", spec, owner,
+                f"in_specs has {n} entries but {target.qualname} takes "
+                f"{want} argument(s) after partial binding",
+            )
+        ]
+
+    # -- RL022 -------------------------------------------------------------
+
+    def pallas_violations(self) -> List[PallasHit]:
+        hits: List[PallasHit] = []
+        by_module: Dict[str, Dict[str, FuncInfo]] = {}
+        for info in self.index.functions.values():
+            for ps in info.pallas_sites:
+                hits.extend(_pallas_shape_checks(self.index, info, ps))
+                if _site_gated(self.index, info, ps):
+                    by_module.setdefault(info.module, {})[
+                        info.qualname.rsplit(".", 1)[-1]
+                    ] = info
+        declared: Dict[str, list] = {}
+        for module, entries, anchor, ctx in self.index.interpret_only_decls():
+            declared.setdefault(module, []).append((entries, anchor, ctx))
+        for module in set(by_module) | set(declared):
+            gated = by_module.get(module, {})
+            names_declared: set = set()
+            for entries, anchor, ctx in declared.get(module, ()):
+                for entry in entries:
+                    name, _, reason = entry.partition(":")
+                    name = name.strip()
+                    if not reason.strip():
+                        hits.append(
+                            PallasHit(
+                                "reasonless", anchor, None, ctx,
+                                f"INTERPRET_ONLY entry {entry!r} has no "
+                                "justification — spell it "
+                                "'<wrapper>: <why the compiled path is "
+                                "unexercised>'",
+                            )
+                        )
+                    names_declared.add(name)
+                    if name not in gated:
+                        hits.append(
+                            PallasHit(
+                                "stale", anchor, None, ctx,
+                                f"INTERPRET_ONLY entry {entry!r} matches no "
+                                "interpret-gated pallas wrapper in this "
+                                "module — the kernel was un-gated (or "
+                                "renamed); retire the entry with the debt",
+                            )
+                        )
+            for name, info in gated.items():
+                if name not in names_declared:
+                    hits.append(
+                        PallasHit(
+                            "undeclared", info.node, info, info.ctx,
+                            f"{name} is an interpret-gated pallas wrapper "
+                            "(its compiled path is routed around wherever "
+                            "the gate is on) but is not declared in this "
+                            "module's INTERPRET_ONLY registry",
+                        )
+                    )
+        return hits
+
+    # -- RL023 -------------------------------------------------------------
+
+    def dma_acquisitions(self, info: FuncInfo) -> List[Acquisition]:
+        """``h = make_async_remote_copy(...)`` handles -> Acquisitions
+        anchored at their ``h.start()`` calls, for resource_leaks."""
+        acqs: List[Acquisition] = []
+        for name, _bind in info.dma_binds:
+            for stmt in scope_stmts(info.node):
+                for call in calls_in(stmt):
+                    d = dotted_parts(call.func)
+                    if d == (name, "start"):
+                        acqs.append(
+                            Acquisition(
+                                call=call,
+                                label=f"{name}.start",
+                                release_methods=DMA_RELEASES,
+                                receiver=(name,),
+                                tracked_roots=(name,),
+                            )
+                        )
+        return acqs
+
+    # -- RL024 -------------------------------------------------------------
+
+    def drift_violations(self, cache) -> List[PlacementHit]:
+        hits: List[PlacementHit] = []
+        for info in self.index.functions.values():
+            if not info.placements or not info.calls:
+                continue
+            sources = [
+                p
+                for p in info.placements
+                if p.sharding in ("absent", "single") and p.bound_names
+            ]
+            if not sources:
+                continue
+            local_jits = cache._local_jit_names(info)
+            for cs in info.calls:
+                got = cache._direct_site(info, cs.node, local_jits)
+                if got is None:
+                    continue
+                site, label = got
+                named_pos = _named_sharding_positions(site, info)
+                if not named_pos:
+                    continue
+                for i, arg in enumerate(cs.node.args):
+                    if i not in named_pos or not isinstance(arg, ast.Name):
+                        continue
+                    for p in sources:
+                        if (
+                            arg.id in p.bound_names
+                            and p.node.lineno < cs.node.lineno
+                            and not _replaced_named(
+                                info, arg.id, p.node.lineno, cs.node.lineno
+                            )
+                        ):
+                            hits.append(
+                                PlacementHit(p, cs.node, label, i, info)
+                            )
+        return hits
+
+
+# ------------------------------------------------------------ rule helpers
+
+
+def _param_default_axis(
+    info: FuncInfo, pname: str
+) -> Optional[Tuple[str, ...]]:
+    args = getattr(info.node, "args", None)
+    if args is None:
+        return None
+    pos = [a.arg for a in args.args]
+    if pname in pos:
+        i = pos.index(pname) - (len(pos) - len(args.defaults))
+        dflt = args.defaults[i] if i >= 0 else None
+    else:
+        dflt = None
+        for kwonly, d in zip(args.kwonlyargs, args.kw_defaults):
+            if kwonly.arg == pname:
+                dflt = d
+    if isinstance(dflt, ast.Constant) and isinstance(dflt.value, str):
+        return (dflt.value,)
+    return None
+
+
+def _spec_calls(expr: Optional[ast.AST], info: FuncInfo) -> List[ast.Call]:
+    """P(...) literals reachable from an in_specs/out_specs expression:
+    the expression itself, tuple/list elements, and local names bound to
+    a P(...) literal earlier in the scope."""
+    if expr is None:
+        return []
+    elems = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) else [expr]
+    out: List[ast.Call] = []
+    for e in elems:
+        if isinstance(e, ast.Name):
+            bound = info.spec_locals.get(e.id)
+            if bound is not None:
+                out.append(bound)
+        elif isinstance(e, ast.Call):
+            d = dotted_parts(e.func)
+            if d and d[-1] in ("P", "PartitionSpec"):
+                out.append(e)
+    return out
+
+
+def _axis_drift(
+    p_call: ast.Call, universe: set, axes: Tuple[str, ...], info: FuncInfo
+) -> List[SpecHit]:
+    hits: List[SpecHit] = []
+    for entry in _spec_entries(p_call):
+        named = entry if isinstance(entry, tuple) else (entry,)
+        for ax in named:
+            if isinstance(ax, str) and ax not in ("?", "*") and ax not in universe:
+                hits.append(
+                    SpecHit(
+                        "axis", p_call, info,
+                        f"PartitionSpec names axis {ax!r} but its mesh "
+                        f"only has axes {tuple(axes)!r}",
+                    )
+                )
+    return hits
+
+
+def _pallas_shape_checks(
+    index: ProjectIndex, info: FuncInfo, ps: PallasSite
+) -> List[PallasHit]:
+    hits: List[PallasHit] = []
+    if ps.grid_rank is not None:
+        expected = ps.grid_rank + (
+            ps.num_scalar_prefetch if ps.scalar_grid else 0
+        )
+        for bs in ps.block_specs:
+            if bs.index_map_arity is not None and bs.index_map_arity != expected:
+                hits.append(
+                    PallasHit(
+                        "arity", bs.node, info, info.ctx,
+                        f"BlockSpec index_map takes {bs.index_map_arity} "
+                        f"args but the grid has rank {ps.grid_rank}"
+                        + (
+                            f" plus {ps.num_scalar_prefetch} scalar-prefetch "
+                            "operand(s)"
+                            if ps.scalar_grid and ps.num_scalar_prefetch
+                            else ""
+                        )
+                        + f" — index_map must take {expected}",
+                    )
+                )
+    if ps.out_shape_dims is not None:
+        for bs in ps.block_specs:
+            if bs.role != "out" or bs.block_shape is None:
+                continue
+            if len(bs.block_shape) != len(ps.out_shape_dims):
+                continue
+            for blk, dim in zip(bs.block_shape, ps.out_shape_dims):
+                if (
+                    isinstance(blk, int)
+                    and isinstance(dim, int)
+                    and blk > 0
+                    and dim % blk
+                    and not _kernel_masks(index, info, ps)
+                ):
+                    hits.append(
+                        PallasHit(
+                            "divide", bs.node, info, info.ctx,
+                            f"out BlockSpec dim {blk} does not divide the "
+                            f"out_shape dim {dim} and the kernel shows no "
+                            "masking (pl.when / mask) — the tail block "
+                            "reads/writes out of bounds",
+                        )
+                    )
+    return hits
+
+
+def _kernel_masks(index: ProjectIndex, info: FuncInfo, ps: PallasSite) -> bool:
+    """Masking evidence in the resolved kernel body: a ``pl.when`` call
+    or any mask-named identifier."""
+    if ps.kernel_chain is None:
+        return False
+    kernel = index.resolve_call(info, ps.kernel_chain)
+    if kernel is None:
+        return False
+    for node in ast.walk(kernel.node):
+        if isinstance(node, ast.Call):
+            d = dotted_parts(node.func)
+            if d and d[-1] == "when":
+                return True
+        if isinstance(node, ast.Name) and "mask" in node.id.lower():
+            return True
+    return False
+
+
+def _site_gated(index: ProjectIndex, info: FuncInfo, ps: PallasSite) -> bool:
+    """True when this pallas site's compiled path is routed around:
+    interpret=True hardcoded, or a same-module dispatcher calls this
+    wrapper AND branches on the site's gate call as an un-negated
+    disjunct (``if _interpret() or ...: return xla_path``)."""
+    if ps.interpret == "true":
+        return True
+    if ps.interpret != "dynamic" or ps.interpret_chain is None:
+        return False
+    mi = index.modules.get(info.module)
+    if mi is None:
+        return False
+    wrapper = info.qualname.rsplit(".", 1)[-1]
+    for fn in mi.functions.values():
+        if fn.key == info.key:
+            continue
+        if not any(cs.chain and cs.chain[-1] == wrapper for cs in fn.calls):
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.If) and _gate_disjunct(
+                node.test, ps.interpret_chain
+            ):
+                return True
+    return False
+
+
+def _gate_disjunct(test: ast.AST, gate: Tuple[str, ...]) -> bool:
+    """The gate call appears un-negated as the test or an Or-disjunct
+    (``not gate() and ...`` does NOT match — that routes TOWARD the
+    compiled path off-gate, i.e. the kernel keeps interpret coverage)."""
+    stack = [test]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.BoolOp) and isinstance(n.op, ast.Or):
+            stack.extend(n.values)
+        elif isinstance(n, ast.Call) and dotted_parts(n.func) == gate:
+            return True
+    return False
+
+
+def _named_sharding_positions(site: JitSite, info: FuncInfo) -> set:
+    """Positional indices of ``in_shardings`` entries that are
+    NamedSharding constructions (or local names bound to one)."""
+    shard = site.in_shardings
+    if shard is None:
+        return set()
+    entries = (
+        list(shard.elts) if isinstance(shard, (ast.Tuple, ast.List)) else [shard]
+    )
+    out = set()
+    for i, e in enumerate(entries):
+        if isinstance(e, ast.Call):
+            d = dotted_parts(e.func)
+            if d and d[-1] == "NamedSharding":
+                out.add(i)
+        elif isinstance(e, ast.Name) and e.id in info.named_sharding_locals:
+            out.add(i)
+    return out
+
+
+def _replaced_named(
+    info: FuncInfo, name: str, after_line: int, before_line: int
+) -> bool:
+    """A later placement rebinding ``name`` WITH a NamedSharding between
+    the flagged placement and the call clears the drift (linear source-
+    order approximation)."""
+    for p in info.placements:
+        if (
+            p.sharding == "named"
+            and name in p.bound_names
+            and after_line < p.node.lineno < before_line
+        ):
+            return True
+    return False
+
+
+def get_model(index: ProjectIndex) -> SpmdModel:
+    model = getattr(index, "_spmd_model", None)
+    if model is None:
+        model = SpmdModel(index)
+        index._spmd_model = model
+    return model

@@ -367,6 +367,10 @@ def flash_attention(
     )
     bq, bk = _pick_blocks(s, block_q, block_k)
     bqb, bkb = _pick_blocks(s, block_q_bwd, block_k_bwd)
+    # gate polarity matters to raylint RL022: `not _interpret() and ...`
+    # only skips the pallas path ON TPU with bad tiling — off-TPU CI still
+    # exercises the kernel interpreted, so no INTERPRET_ONLY entry is due
+    # here (contrast ops/paged_attention.py, which routes AWAY off-TPU)
     if not _interpret() and (bq % 128 or bk % 128 or bqb % 128 or bkb % 128):
         from ray_tpu.ops.attention import _xla_attention
 

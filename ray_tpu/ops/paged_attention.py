@@ -64,6 +64,23 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+#: RL022-verified registry of pallas wrappers whose COMPILED path is
+#: currently unexercised: the auto dispatcher routes to the XLA path
+#: wherever ``_interpret()`` is on — i.e. exactly where CI runs — so the
+#: kernels below have zero compiled-TPU validation coverage. Each entry
+#: is acknowledged validation debt (the ROADMAP's real-TPU tiling
+#: validation item); un-gating a kernel makes its entry stale and the
+#: lint forces it to be retired with the debt.
+INTERPRET_ONLY = (
+    "_paged_pallas: decode kernel's MXU tiling (block_size % 8, d % 128)"
+    " is unvalidated on real TPUs — auto dispatch falls back to XLA"
+    " off-TPU (ROADMAP real-TPU validation item)",
+    "_paged_verify_pallas: verify kernel rides the same gating; the"
+    " small window dim's tiling is unvalidated on real TPUs (ROADMAP"
+    " real-TPU validation item)",
+)
+
+
 # ---------------------------------------------------------------------------
 # XLA reference path
 # ---------------------------------------------------------------------------

@@ -732,3 +732,298 @@ def test_ctor_typed_lock_seen_from_method_above_init(tmp_path):
     )
     f = idx.functions["mod:Ctx.flush"]
     assert [a.chain for a in f.acquisitions] == [("self", "_submit_send")]
+
+
+# ------------------------------------------------------- mesh/SPMD extraction
+
+
+def test_mesh_axes_resolution_chain(tmp_path):
+    """The RL020/RL021 axis universe: Mesh positional/kwarg literals,
+    tuple(NAME) unwrapping, module string-tuple globals with one
+    import-following hop, make_*mesh factory kwonly defaults resolved
+    cross-module, and parameter meshes staying opaque (ANY)."""
+    from ray_tpu._lint import spmd
+
+    idx = make_index(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/meshlib.py": """
+                AXES = ("dp", "tp")
+
+                def make_mesh(config, *, devices=None, axis_names=AXES):
+                    from jax.sharding import Mesh
+                    return Mesh(devices, axis_names=tuple(axis_names))
+            """,
+            "pkg/use.py": """
+                import jax
+                import numpy as np
+                from jax.sharding import Mesh
+                from jax.experimental.shard_map import shard_map
+                from pkg.meshlib import make_mesh, AXES
+
+                def body_a(x):
+                    return x
+
+                def body_b(x):
+                    return x
+
+                def body_c(x):
+                    return x
+
+                def body_d(x):
+                    return x
+
+                def use_positional(x):
+                    mesh = Mesh(np.array(jax.devices()), ("data",))
+                    return shard_map(body_a, mesh=mesh, in_specs=None, out_specs=None)(x)
+
+                def use_import_table(x):
+                    mesh = Mesh(np.array(jax.devices()), AXES)
+                    return shard_map(body_b, mesh=mesh, in_specs=None, out_specs=None)(x)
+
+                def use_factory(cfg, x):
+                    mesh = make_mesh(cfg)
+                    return shard_map(body_c, mesh=mesh, in_specs=None, out_specs=None)(x)
+
+                def use_param(mesh, x):
+                    return shard_map(body_d, mesh=mesh, in_specs=None, out_specs=None)(x)
+            """,
+        },
+    )
+    model = spmd.get_model(idx)
+    assert model.envs["pkg.use:body_a"] == {"data"}
+    assert model.envs["pkg.use:body_b"] == {"dp", "tp"}
+    # factory call resolves to the kwonly default, itself a module global
+    assert model.envs["pkg.use:body_c"] == {"dp", "tp"}
+    # parameter mesh: opaque — suppresses, never fires
+    assert model.envs["pkg.use:body_d"] is spmd.ANY
+    # the owner scopes got the same envs (nested-body folding support)
+    assert model.envs["pkg.use:use_positional"] == {"data"}
+    assert model.envs["pkg.use:use_param"] is spmd.ANY
+
+
+def test_collective_extraction_forms(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                import jax
+                from ray_tpu.jax_compat import axis_size
+
+                def f(x, axis_name="sp"):
+                    a = jax.lax.psum(x, "dp")
+                    b = jax.lax.pmean(x, ("dp", "fsdp"))
+                    c = jax.lax.ppermute(x, axis_name, [(0, 1)])
+                    d = axis_size(axis_name)
+                    e = jax.lax.psum(x, pick_axis())   # dynamic: not recorded
+                    return a + b + c + d + e
+            """,
+        },
+    )
+    cs = idx.functions["m:f"].collectives
+    got = {(c.op, c.axes, c.axis_param) for c in cs}
+    assert ("psum", ("dp",), None) in got
+    assert ("pmean", ("dp", "fsdp"), None) in got
+    assert ("ppermute", (), "axis_name") in got
+    assert ("axis_size", (), "axis_name") in got
+    assert len(cs) == 4  # the dynamic-axis psum was not invented
+
+
+def test_spec_literal_extraction(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                from jax.sharding import PartitionSpec as P
+
+                def f(batch_axes):
+                    spec = P(("dp", "fsdp"), "tp", None)
+                    splat = P(*batch_axes)
+                    dyn = P(batch_axes[0])
+                    return spec, splat, dyn
+            """,
+        },
+    )
+    info = idx.functions["m:f"]
+    entries = {s.entries for s in info.spec_sites}
+    assert (("dp", "fsdp"), "tp", None) in entries
+    assert ("*",) in entries
+    assert ("?",) in entries
+    assert "spec" in info.spec_locals  # name -> P(...) bind for in_specs use
+
+
+def test_pallas_site_extraction_inline_and_gridspec_local(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                import functools
+                import jax
+                from jax.experimental import pallas as pl
+                from jax.experimental.pallas import tpu as pltpu
+
+                def _interp():
+                    return True
+
+                def _kernel(x_ref, o_ref):
+                    o_ref[...] = x_ref[...]
+
+                def inline(x, bq):
+                    grid = (4, 8)
+                    return pl.pallas_call(
+                        functools.partial(_kernel, bq),
+                        grid=grid,
+                        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+                        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+                        out_shape=jax.ShapeDtypeStruct((32, 1024), "float32"),
+                        interpret=_interp(),
+                    )(x)
+
+                def prefetched(x):
+                    grid_spec = pltpu.PrefetchScalarGridSpec(
+                        num_scalar_prefetch=2,
+                        grid=(4,),
+                        in_specs=[pl.BlockSpec((1, 8), lambda s, t, i: (i, 0))],
+                        out_specs=pl.BlockSpec((1, 8), lambda s, t, i: (i, 0)),
+                    )
+                    return pl.pallas_call(_kernel, grid_spec=grid_spec)(x)
+            """,
+        },
+    )
+    (site,) = idx.functions["m:inline"].pallas_sites
+    assert site.kernel_chain == ("_kernel",)        # partial-unwrapped
+    assert site.grid_rank == 2                      # grid=grid local tuple
+    assert site.interpret == "dynamic"
+    assert site.interpret_chain == ("_interp",)
+    assert site.out_shape_dims == (32, 1024)
+    assert {(b.role, b.block_shape, b.index_map_arity) for b in site.block_specs} == {
+        ("in", (8, 128), 2),
+        ("out", (8, 128), 2),
+    }
+    (psite,) = idx.functions["m:prefetched"].pallas_sites
+    assert psite.scalar_grid and psite.num_scalar_prefetch == 2
+    assert psite.grid_rank == 1                     # via the grid_spec local
+    assert psite.interpret == "absent"
+    assert {b.index_map_arity for b in psite.block_specs} == {3}
+
+
+def test_dma_handle_binds_recorded(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                from jax.experimental.pallas import tpu as pltpu
+
+                def kernel(src, dst, send, recv):
+                    rdma = pltpu.make_async_remote_copy(
+                        src_ref=src, dst_ref=dst, send_sem=send,
+                        recv_sem=recv, device_id=1,
+                    )
+                    rdma.start()
+                    rdma.wait()
+            """,
+        },
+    )
+    binds = idx.functions["m:kernel"].dma_binds
+    assert [name for name, _ in binds] == ["rdma"]
+
+
+def test_jit_shard_map_composition_forms(tmp_path):
+    """Satellite: the jit registry sees THROUGH composition so RL013/RL014
+    keep working on multi-chip code — jit(shard_map(f, ...)) and
+    shard_map(jax.jit(f), ...) both resolve to f with merged fields."""
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                import jax
+                from jax.experimental.shard_map import shard_map
+
+                def step(p, b):
+                    return p
+
+                def outer_jit(p, b, mesh):
+                    f = jax.jit(
+                        shard_map(step, mesh=mesh, in_specs=None, out_specs=None),
+                        donate_argnums=(0,),
+                    )
+                    return f(p, b)
+
+                def inner_jit(p, b, mesh):
+                    g = shard_map(
+                        jax.jit(step, static_argnames=("b",)),
+                        mesh=mesh, in_specs=None, out_specs=None,
+                    )
+                    return g(p, b)
+            """,
+        },
+    )
+    sites = {
+        (s.wrapper, s.composed_with): s
+        for s, owner in idx.jit_sites
+        if s.composed_with is not None
+    }
+    outer = sites[("jit", "shard_map")]
+    assert outer.target_chain == ("step",)
+    assert outer.donate_argnums == (0,)
+    assert outer.mesh_expr is not None          # specs lifted from the inner
+    assert outer.wrappers() == {"jit", "shard_map"}
+    inner = sites[("shard_map", "jit")]
+    assert inner.target_chain == ("step",)
+    assert inner.static_argnames == ("b",)      # statics lifted from the inner
+    assert inner.mesh_expr is not None
+
+
+def test_placement_extraction_kinds(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                import jax
+                import numpy as np
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+                def f(x, mesh, dev):
+                    a = jax.device_put(np.zeros((4, 2)))
+                    s = NamedSharding(mesh, P("dp", None))
+                    b = jax.device_put(x, s)
+                    c = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                    d = jax.device_put(np.zeros((4,)), jax.sharding.SingleDeviceSharding(dev))
+                    return a, b, c, d
+            """,
+        },
+    )
+    by_name = {
+        p.bound_names[0]: p for p in idx.functions["m:f"].placements
+    }
+    assert by_name["a"].sharding == "absent"
+    assert by_name["a"].operand_rank == 2
+    assert by_name["b"].sharding == "named"     # via the NamedSharding local
+    assert by_name["c"].sharding == "named"
+    assert by_name["c"].spec_rank == 1
+    assert by_name["d"].sharding == "single"
+    assert by_name["d"].operand_rank == 1
+
+
+def test_str_tuples_and_interpret_only_registry(tmp_path):
+    idx = make_index(
+        tmp_path,
+        {
+            "m.py": """
+                AXES = ("dp", "fsdp", "tp")
+                NOT_STRS = (1, 2)
+
+                INTERPRET_ONLY = (
+                    "_decode_pallas: compiled path unvalidated off-TPU",
+                )
+            """,
+        },
+    )
+    mi = idx.modules["m"]
+    assert mi.str_tuples["AXES"] == ("dp", "fsdp", "tp")
+    assert "NOT_STRS" not in mi.str_tuples
+    decls = idx.interpret_only_decls()
+    assert len(decls) == 1
+    module, entries, _anchor, _ctx = decls[0]
+    assert module == "m" and entries[0].startswith("_decode_pallas:")

@@ -67,18 +67,20 @@ def test_daemon_loop_fixes_stay_fixed():
 
 
 def test_full_run_stays_inside_profile_budget():
-    """The standing contract (ROADMAP lint gate): the full 19-rule run —
+    """The standing contract (ROADMAP lint gate): the full 24-rule run —
     parse + whole-program index + dataflow rules + the thread/protocol
-    phase (RL017-RL019) — finishes inside the 30s budget. ``--profile``
-    exposes the same numbers on the CLI and CI uploads them
-    (lint-profile artifact), so a creeping rule shows up both here and
-    in the trend."""
+    phase (RL017-RL019) + the mesh/SPMD phase (RL020-RL024) — finishes
+    inside the 30s budget (measured ~8.3s wall at v5 on this container;
+    v4 was ~7.5s, so the fifth phase costs well under a second —
+    RL020-RL024 together profile at ~45ms). ``--profile`` exposes the
+    same numbers on the CLI and CI uploads them (lint-profile artifact),
+    so a creeping rule shows up both here and in the trend."""
     _all_violations()  # populates _PROFILE via the shared cached run
     assert _PROFILE, "profile not collected"
     assert _PROFILE["total_s"] < 30.0, _PROFILE
     # every registered rule was actually timed (a rule silently skipped
     # by an import error would otherwise pass the budget trivially)
-    assert set(_PROFILE["rules_s"]) >= {f"RL{i:03d}" for i in range(1, 20)}
+    assert set(_PROFILE["rules_s"]) >= {f"RL{i:03d}" for i in range(1, 25)}
 
 
 def test_no_import_cycles():

@@ -26,7 +26,7 @@ def rule_ids(violations):
 
 
 def test_rule_registry_complete():
-    assert {f"RL{i:03d}" for i in range(1, 20)} <= ALL_RULE_IDS
+    assert {f"RL{i:03d}" for i in range(1, 25)} <= ALL_RULE_IDS
 
 
 # --------------------------------------------------------------------- RL001
@@ -2391,3 +2391,603 @@ def test_rl019_data_plane_err_shape_pinned(tmp_path):
         '            if resp[0] != "ok":',
     )
     assert "RL019" not in rule_ids(lint_snippet(tmp_path, fixed))
+
+
+# --------------------------------------------------------------------- RL020
+
+
+RL020_POS = """
+    import jax
+
+    def reduce_grads(g):
+        return jax.lax.psum(g, "dp")
+"""
+
+
+def test_rl020_unbound_literal_axis_fires(tmp_path):
+    assert "RL020" in rule_ids(lint_snippet(tmp_path, RL020_POS))
+
+
+def test_rl020_bound_by_shard_map_ok(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return jax.lax.psum(x, "dp")
+
+        def outer(x):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            return shard_map(body, mesh=mesh, in_specs=None, out_specs=None)(x)
+    """
+    assert "RL020" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl020_opaque_mesh_suppresses(tmp_path):
+    # a parameter mesh is unresolvable: the env is ANY and the rule must
+    # not invent a finding
+    src = """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return jax.lax.psum(x, "dp")
+
+        def outer(x, mesh):
+            return shard_map(body, mesh=mesh, in_specs=None, out_specs=None)(x)
+    """
+    assert "RL020" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl020_param_axis_promoted_to_caller(tmp_path):
+    src = """
+        import jax
+
+        def ring(x, axis_name="sp"):
+            return jax.lax.ppermute(x, axis_name, [(0, 1)])
+
+        def caller(x):
+            return ring(x, axis_name="tp")
+    """
+    hits = [
+        v for v in lint_snippet(tmp_path, src) if v.rule == "RL020"
+    ]
+    # fires at the CALLER (both for the literal kwarg and the literal
+    # default the bare call relies on), naming the threading path
+    assert hits and all(v.symbol == "caller" for v in hits)
+
+
+def test_rl020_param_axis_dynamic_caller_ok(tmp_path):
+    src = """
+        import jax
+
+        def ring(x, axis_name="sp"):
+            return jax.lax.ppermute(x, axis_name, [(0, 1)])
+
+        def caller(x, ax):
+            return ring(x, axis_name=ax)
+    """
+    # a dynamic axis operand is not promoted — only the default-literal
+    # finding for the OMITTED kwarg path may exist, and here the kwarg is
+    # always passed, so nothing fires
+    assert "RL020" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl020_suppressed(tmp_path):
+    src = """
+        import jax
+
+        def reduce_grads(g):
+            return jax.lax.psum(g, "dp")  # raylint: disable=RL020
+    """
+    assert "RL020" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL021
+
+
+RL021_AXIS_POS = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    def body(x):
+        return x
+
+    def outer(x):
+        mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+        f = shard_map(body, mesh=mesh, in_specs=(P("fsdp"),), out_specs=P("dp"))
+        return f(x)
+"""
+
+
+def test_rl021_spec_axis_not_on_mesh_fires(tmp_path):
+    hits = [v for v in lint_snippet(tmp_path, RL021_AXIS_POS) if v.rule == "RL021"]
+    assert len(hits) == 1 and "'fsdp'" in hits[0].message
+
+
+def test_rl021_spec_via_local_name_ok(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return x
+
+        def outer(x):
+            mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+            spec = P(("dp",), "tp")
+            f = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+            return f(x)
+    """
+    assert "RL021" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl021_in_specs_arity_fires(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return x
+
+        def outer(x):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            f = shard_map(body, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"))
+            return f(x, x)
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL021"]
+    assert len(hits) == 1 and "in_specs has 2" in hits[0].message
+
+
+def test_rl021_arity_respects_partial_and_defaults(tmp_path):
+    # ring_attention_sharded's real shape: partial binds axis_name, the
+    # remaining 3 required params match 3 specs — must lint clean
+    src = """
+        import functools
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        def ring(q, k, v, axis_name="sp"):
+            return q
+
+        def sharded(q, k, v):
+            mesh = Mesh(np.array(jax.devices()), ("dp", "tp", "sp"))
+            spec = P("dp", "tp", "sp")
+            f = shard_map(
+                functools.partial(ring, axis_name="sp"),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )
+            return f(q, k, v)
+    """
+    assert "RL021" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl021_named_sharding_axis_fires(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def place(x):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            return jax.device_put(x, NamedSharding(mesh, P("tp")))
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL021"]
+    assert len(hits) == 1 and "'tp'" in hits[0].message
+
+
+def test_rl021_placement_rank_fires(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def place(mesh):
+            return jax.device_put(np.zeros((4,)), NamedSharding(mesh, P("dp", None)))
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL021"]
+    assert len(hits) == 1 and "rank 1" in hits[0].message
+
+
+# --------------------------------------------------------------------- RL022
+
+
+RL022_ARITY_POS = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def wrapper(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(4, 4),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((32, 512), "float32"),
+        )(x)
+"""
+
+
+def test_rl022_index_map_arity_fires(tmp_path):
+    hits = [v for v in lint_snippet(tmp_path, RL022_ARITY_POS) if v.rule == "RL022"]
+    assert len(hits) == 1 and "takes 1" in hits[0].message
+
+
+def test_rl022_scalar_prefetch_widens_arity(tmp_path):
+    # PrefetchScalarGridSpec prepends its operands to every index_map:
+    # grid rank 1 + 2 prefetch = 3-arg lambdas are CORRECT
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(s, t, x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def wrapper(x):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1, 8), lambda s, t, i: (i, 0))],
+                out_specs=pl.BlockSpec((1, 8), lambda s, t, i: (i, 0)),
+            )
+            return pl.pallas_call(kernel, grid_spec=grid_spec)(x)
+    """
+    assert "RL022" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl022_nondividing_out_block_fires(tmp_path):
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def wrapper(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((20, 128), "float32"),
+            )(x)
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL022"]
+    assert len(hits) == 1 and "does not divide" in hits[0].message
+
+
+def test_rl022_masked_kernel_tail_ok(tmp_path):
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            @pl.when(pl.program_id(0) < 2)
+            def _():
+                o_ref[...] = x_ref[...]
+
+        def wrapper(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((20, 128), "float32"),
+            )(x)
+    """
+    assert "RL022" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+RL022_GATED_SRC = """
+    import jax
+    from jax.experimental import pallas as pl
+    %(registry)s
+
+    def _interp():
+        return jax.default_backend() != "tpu"
+
+    def _kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def _decode_pallas(x):
+        return pl.pallas_call(
+            _kernel, grid=(4,),
+            interpret=_interp(),
+        )(x)
+
+    def decode(x):
+        if _interp() or x.shape[-1] %% 128:
+            return x * 2.0
+        return _decode_pallas(x)
+"""
+
+
+def test_rl022_gated_wrapper_undeclared_fires(tmp_path):
+    src = RL022_GATED_SRC % {"registry": ""}
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL022"]
+    assert len(hits) == 1 and "INTERPRET_ONLY" in hits[0].message
+
+
+def test_rl022_gated_wrapper_declared_ok(tmp_path):
+    src = RL022_GATED_SRC % {
+        "registry": 'INTERPRET_ONLY = ("_decode_pallas: tiling unvalidated on real TPUs",)'
+    }
+    assert "RL022" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl022_negated_gate_is_not_gated(tmp_path):
+    # `if not _interp() and ...: return xla` keeps the pallas path covered
+    # wherever the gate is ON (the flash_attention dispatcher shape) — no
+    # registry entry required
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _interp():
+            return jax.default_backend() != "tpu"
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def _core_pallas(x):
+            return pl.pallas_call(
+                _kernel, grid=(4,),
+                interpret=_interp(),
+            )(x)
+
+        def attention(x):
+            if not _interp() and x.shape[-1] % 128:
+                return x * 2.0
+            return _core_pallas(x)
+    """
+    assert "RL022" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl022_stale_registry_entry_fires(tmp_path):
+    src = """
+        INTERPRET_ONLY = ("_old_kernel: long since un-gated",)
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL022"]
+    assert len(hits) == 1 and "matches no interpret-gated" in hits[0].message
+
+
+def test_rl022_reasonless_entry_fires(tmp_path):
+    src = RL022_GATED_SRC % {"registry": 'INTERPRET_ONLY = ("_decode_pallas",)'}
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL022"]
+    assert len(hits) == 1 and "no justification" in hits[0].message
+
+
+# --------------------------------------------------------------------- RL023
+
+
+RL023_POS = """
+    from jax.experimental.pallas import tpu as pltpu
+
+    def transfer(src, dst, send, recv, n):
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+            device_id=n,
+        )
+        rdma.start()
+        check_credit(n)
+        rdma.wait()
+"""
+
+
+def test_rl023_raise_path_skips_wait_fires(tmp_path):
+    hits = [v for v in lint_snippet(tmp_path, RL023_POS) if v.rule == "RL023"]
+    assert len(hits) == 1 and "rdma.start" in hits[0].message
+
+
+def test_rl023_wait_in_finally_ok(tmp_path):
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def transfer(src, dst, send, recv, n):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+                device_id=n,
+            )
+            rdma.start()
+            try:
+                check_credit(n)
+            finally:
+                rdma.wait()
+    """
+    assert "RL023" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl023_never_waited_fires_at_start(tmp_path):
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def fire_and_forget(src, dst, send, recv):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+                device_id=1,
+            )
+            rdma.start()
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL023"]
+    assert len(hits) == 1 and "no path waits" in hits[0].message
+
+
+def test_rl023_returned_handle_transfers_ownership(tmp_path):
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def start_copy(src, dst, send, recv):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+                device_id=1,
+            )
+            rdma.start()
+            return rdma
+    """
+    assert "RL023" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl023_split_waits_release(tmp_path):
+    # wait_send/wait_recv are the overlap idiom — each counts as release
+    src = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def transfer(src, dst, send, recv):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst, send_sem=send, recv_sem=recv,
+                device_id=1,
+            )
+            rdma.start()
+            rdma.wait_send()
+            rdma.wait_recv()
+    """
+    assert "RL023" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+# --------------------------------------------------------------------- RL024
+
+
+RL024_POS = """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def step(p, b):
+        return p
+
+    def train(p):
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        step_fn = jax.jit(
+            step, in_shardings=(None, NamedSharding(mesh, P("dp"))),
+        )
+        batch = jax.device_put(np.zeros((8, 4)))
+        return step_fn(p, batch)
+"""
+
+
+def test_rl024_default_placement_into_named_slot_fires(tmp_path):
+    hits = [v for v in lint_snippet(tmp_path, RL024_POS) if v.rule == "RL024"]
+    assert len(hits) == 1
+    assert "batch" in hits[0].message and "in_shardings[1]" in hits[0].message
+
+
+def test_rl024_single_device_sharding_fires(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def step(p, b):
+            return p
+
+        def train(p, dev):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            step_fn = jax.jit(
+                step, in_shardings=(None, NamedSharding(mesh, P("dp"))),
+            )
+            batch = jax.device_put(np.zeros((8, 4)), jax.sharding.SingleDeviceSharding(dev))
+            return step_fn(p, batch)
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL024"]
+    assert len(hits) == 1 and "SingleDeviceSharding" in hits[0].message
+
+
+def test_rl024_matching_placement_ok(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def step(p, b):
+            return p
+
+        def train(p):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            sharding = NamedSharding(mesh, P("dp"))
+            step_fn = jax.jit(step, in_shardings=(None, sharding))
+            batch = jax.device_put(np.zeros((8, 4)), sharding)
+            return step_fn(p, batch)
+    """
+    assert "RL024" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl024_replacement_clears_drift(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def step(p, b):
+            return p
+
+        def train(p):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            step_fn = jax.jit(
+                step, in_shardings=(None, NamedSharding(mesh, P("dp"))),
+            )
+            batch = jax.device_put(np.zeros((8, 4)))
+            batch = jax.device_put(batch, NamedSharding(mesh, P("dp")))
+            return step_fn(p, batch)
+    """
+    assert "RL024" not in rule_ids(lint_snippet(tmp_path, src))
+
+
+def test_rl024_through_factory_jit(tmp_path):
+    # make_step_fn's real shape: the jit site resolves through a factory
+    # whose return is directly a jit call
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def make_step_fn(mesh):
+            def step(p, b):
+                return p
+            return jax.jit(
+                step, in_shardings=(None, NamedSharding(mesh, P("dp"))),
+            )
+
+        def train(p):
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+            step_fn = make_step_fn(mesh)
+            batch = jax.device_put(np.zeros((8, 4)))
+            return step_fn(p, batch)
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL024"]
+    assert len(hits) == 1 and "batch" in hits[0].message
+
+
+# ------------------------------------------------- composition see-through
+
+
+def test_rl013_sees_through_jit_shard_map_composition(tmp_path):
+    # the satellite's point: donation summaries must not go silent on
+    # jit(shard_map(f, ...)) — the form the multi-chip engine will use
+    src = """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def step(p, b):
+            return p
+
+        def train(p, b, mesh):
+            f = jax.jit(
+                shard_map(step, mesh=mesh, in_specs=None, out_specs=None),
+                donate_argnums=(0,),
+            )
+            out = f(p, b)
+            return p
+    """
+    hits = [v for v in lint_snippet(tmp_path, src) if v.rule == "RL013"]
+    assert len(hits) == 1 and "donated" in hits[0].message

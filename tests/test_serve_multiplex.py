@@ -69,15 +69,22 @@ def test_multiplexed_routing_is_sticky_per_model(serve_instance):
             return (mid, self.pid)
 
     h = serve.run(Who.bind(), name="sticky")
+    # Rendezvous hashing keys on replica ACTOR IDS (random per run), so the
+    # model->replica assignment is an independent coin flip per model id:
+    # with M models over 2 replicas, P(all land on one replica) = 2^(1-M).
+    # The original M=4 flaked at that 12.5% rate in a full-suite run;
+    # M=12 (~0.05%) keeps the both-replicas-used assertion meaningful
+    # without betting the suite on hash luck.
+    mids = tuple(f"m{i}" for i in range(12))
     seen = {}
-    for _ in range(4):
-        for mid in ("m1", "m2", "m3", "m4"):
+    for _ in range(3):
+        for mid in mids:
             got_mid, pid = h.options(multiplexed_model_id=mid).remote(0).result(timeout=30)
             assert got_mid == mid
             seen.setdefault(mid, set()).add(pid)
     # every model id consistently routed to ONE replica
     assert all(len(pids) == 1 for pids in seen.values()), seen
-    # and with 4 models over 2 replicas, both replicas serve something
+    # and with 12 models over 2 replicas, both replicas serve something
     assert len({next(iter(p)) for p in seen.values()}) == 2
 
 
