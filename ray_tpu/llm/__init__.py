@@ -37,6 +37,10 @@ LOCK_ORDER = (
 from ray_tpu.llm.cache import CacheConfig, KVBlockPool  # noqa: F401
 from ray_tpu.llm.drafter import NGramDrafter, SmallModelDrafter  # noqa: F401
 from ray_tpu.llm.engine import EngineConfig, LLMEngine  # noqa: F401
+from ray_tpu.llm.multichip import (  # noqa: F401
+    ShardedKVBlockPool,
+    TensorParallelPagedModelRunner,
+)
 from ray_tpu.llm.prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from ray_tpu.llm.scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from ray_tpu.llm.watchdog import EngineStalledError, EngineWatchdog  # noqa: F401

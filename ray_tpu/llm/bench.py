@@ -21,6 +21,15 @@
   off: prefill-tokens-computed and warm-request TTFT are the headline
   numbers (the production chat regime the cache targets); outputs must
   be token-identical across the two arms — asserted.
+* ``llm_multichip_tp_tokens_per_sec`` (``--only multichip``) — the
+  tensor-parallel engine (``llm.multichip``, ``EngineConfig(tp=N)``)
+  against the single-chip engine on the same workload: tokens/s, mean
+  TTFT and per-device KV-pool bytes per mesh size, token identity
+  asserted between every arm.  On the CPU host-device substrate the
+  ratio measures shard_map/psum OVERHEAD (there is no real parallel
+  hardware underneath — expect < 1x); on real TPUs the same pairing
+  measures the multi-chip speedup.  The ``MULTICHIP_r0x`` CI artifact
+  records these numbers.
 
 Sized to run on CPU in seconds (the same comparison holds on TPU with
 the real model; the ratio is what travels).  ``--smoke`` shrinks the
@@ -352,8 +361,106 @@ def run_prefix_bench(smoke: bool = False) -> dict:
     }
 
 
+MULTICHIP_N = 6
+MULTICHIP_MAX_TOKENS = 24
+
+
+def run_multichip_bench(smoke: bool = False) -> dict:
+    """Paired single-chip vs tensor-parallel engines on one workload:
+    every arm must emit identical greedy tokens (asserted — otherwise
+    the throughput comparison compares different work).  Reported per
+    mesh size: aggregate tokens/s, mean TTFT, per-device KV-pool bytes
+    (the ledger's per-device attribution — the pool splits 1/tp)."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+
+    n_dev = len(jax.devices())
+    tps = [t for t in (2, 4) if t <= n_dev]
+    if not tps:
+        # single-device host (e.g. env without XLA_FLAGS): record the
+        # skip rather than fake a ratio
+        return {
+            "metric": "llm_multichip_tp_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "detail": {"skipped": f"needs >=2 devices, have {n_dev}"},
+        }
+
+    cfg, params = _model()
+    n_req = 3 if smoke else MULTICHIP_N
+    mt = 12 if smoke else MULTICHIP_MAX_TOKENS
+    rng = np.random.RandomState(11)
+    prompts = [
+        list(rng.randint(0, cfg.vocab_size, PROMPT_LEN)) for _ in range(n_req)
+    ]
+
+    def run(tp):
+        eng = LLMEngine(
+            cfg, params,
+            EngineConfig(
+                max_slots=4, num_blocks=64, block_size=8,
+                max_blocks_per_seq=16, prefill_chunk=16, tp=tp,
+            ),
+        )
+        eng.warmup()  # jit outside the measured window
+        reqs = [eng.submit(p, SamplingParams(max_tokens=mt)) for p in prompts]
+        t0 = time.perf_counter()
+        while not all(r.finished for r in reqs):
+            eng.step()
+        dt = time.perf_counter() - t0
+        ttft = sum(r.first_token_t - r.arrival_t for r in reqs) / len(reqs)
+        led = eng.hbm_ledger()
+        kv_per_dev = {
+            dev: row["pool_bytes"]
+            for dev, row in led.get("per_device", {}).items()
+        } or {"0": led["pool_bytes"]}
+        return (
+            [list(r.out) for r in reqs],
+            (n_req * mt) / dt,
+            ttft,
+            kv_per_dev,
+        )
+
+    base_out, base_tps, base_ttft, base_kv = run(1)
+    arms = {
+        "tp1": {
+            "tokens_per_sec": round(base_tps, 2),
+            "ttft_s": round(base_ttft, 4),
+            "kv_pool_bytes_per_device": base_kv,
+        }
+    }
+    best = base_tps
+    for tp in tps:
+        out, toks, ttft, kv = run(tp)
+        assert out == base_out, f"tp={tp} token mismatch vs single-chip"
+        arms[f"tp{tp}"] = {
+            "tokens_per_sec": round(toks, 2),
+            "ttft_s": round(ttft, 4),
+            "kv_pool_bytes_per_device": kv,
+        }
+        best = toks
+    return {
+        "metric": "llm_multichip_tp_tokens_per_sec",
+        "value": round(best, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(best / max(base_tps, 1e-9), 3),
+        "detail": {
+            "requests": n_req,
+            "max_tokens": mt,
+            "mesh_sizes": tps,
+            "arms": arms,
+            "substrate": jax.default_backend(),
+            "smoke": smoke,
+        },
+    }
+
+
 def main(argv=None) -> list:
     import argparse
+    import os
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -361,23 +468,35 @@ def main(argv=None) -> list:
         help="shrunken workloads for CI (seconds, looser signal)",
     )
     ap.add_argument(
-        "--only", choices=("all", "serving", "continuous", "spec", "prefix"),
+        "--only",
+        choices=("all", "serving", "continuous", "spec", "prefix", "multichip"),
         default="all",
         help="run a subset instead of the full set (bench.py's llm_serving "
-        "section uses --only serving and its llm_prefix section --only "
-        "prefix, so neither pays for the other's workload)",
+        "section uses --only serving, its llm_prefix section --only prefix "
+        "and its multichip section --only multichip, so none pays for the "
+        "others' workloads)",
     )
     args = ap.parse_args(argv)
     benches = {
         "continuous": run_bench,
         "spec": lambda: run_spec_bench(smoke=args.smoke),
         "prefix": lambda: run_prefix_bench(smoke=args.smoke),
+        "multichip": lambda: run_multichip_bench(smoke=args.smoke),
     }
     groups = {
         "all": list(benches),
         "serving": ["continuous", "spec"],
     }
     names = groups.get(args.only, [args.only])
+    if "multichip" in names \
+            and "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # the tp arms need a host-device mesh; XLA reads this flag at
+        # first backend init (lazy, none of the benches has run yet), so
+        # bootstrap it here rather than ask every caller to export it
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
     records = []
     for name in names:
         rec = benches[name]()

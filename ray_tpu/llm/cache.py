@@ -80,6 +80,7 @@ class KVBlockPool:
         n_heads: int,
         head_dim: int,
         dtype="float32",
+        sharding=None,
     ):
         import jax.numpy as jnp
 
@@ -87,6 +88,15 @@ class KVBlockPool:
         shape = (n_layers, cfg.num_blocks, n_heads, cfg.block_size, head_dim)
         self.k = jnp.zeros(shape, jnp.dtype(dtype))
         self.v = jnp.zeros(shape, jnp.dtype(dtype))
+        if sharding is not None:
+            # multichip: place the pool arrays head-sharded over the tp
+            # mesh at creation so the engine's jitted steps never move
+            # them; the host ledger below is unchanged — block ids are
+            # global, every device holds the same blocks' local heads
+            import jax
+
+            self.k = jax.device_put(self.k, sharding)
+            self.v = jax.device_put(self.v, sharding)
         self._lock = threading.Lock()
         # LIFO free list of physical block ids; 0 reserved (trash)
         self._free = list(range(cfg.num_blocks - 1, 0, -1))

@@ -155,28 +155,37 @@ def _metrics() -> dict:
             # usable blocks (seq-owned + cache-only + free), so the
             # tiered-KV spill decision can read exactly how much HBM a
             # host-RAM tier would reclaim (cache-only bytes).
+            # tag_keys: under tp>1 (llm.multichip) every family is
+            # ADDITIONALLY published per mesh device as {device=<id>};
+            # the untagged series stays the pool-wide truth either way
             "hbm_params": Gauge(
-                "llm_hbm_params_bytes", "device bytes held by model params"
+                "llm_hbm_params_bytes", "device bytes held by model params",
+                tag_keys=("device",),
             ),
             "hbm_pool": Gauge(
                 "llm_hbm_kv_pool_bytes",
                 "total device bytes of the KV pool arrays (fixed at start)",
+                tag_keys=("device",),
             ),
             "hbm_seq": Gauge(
                 "llm_hbm_kv_seq_bytes",
                 "bytes of KV blocks owned by at least one live sequence",
+                tag_keys=("device",),
             ),
             "hbm_cache": Gauge(
                 "llm_hbm_kv_cache_bytes",
                 "bytes of KV blocks resident ONLY in the prefix cache "
                 "(reclaimable without preempting anyone)",
+                tag_keys=("device",),
             ),
             "hbm_free": Gauge(
-                "llm_hbm_kv_free_bytes", "bytes of free-list KV blocks"
+                "llm_hbm_kv_free_bytes", "bytes of free-list KV blocks",
+                tag_keys=("device",),
             ),
             "hbm_drafter": Gauge(
                 "llm_hbm_drafter_bytes",
                 "device bytes held by the speculative drafter's params",
+                tag_keys=("device",),
             ),
         }
     return _METRICS
@@ -237,6 +246,12 @@ class EngineConfig:
     max_blocks_per_seq: int = 32
     prefill_chunk: int = 32
     attn_impl: str = "auto"
+    #: tensor parallelism (llm.multichip): tp > 1 shards the KV pool's
+    #: head axis, attention heads and MLP weights over the first ``tp``
+    #: devices (``parallel.mesh.make_tp_mesh``) — same engine semantics,
+    #: same token stream (greedy/seeded), per-device HBM attribution on
+    #: the ledger gauges. Requires n_heads % tp == 0 and d_ff % tp == 0.
+    tp: int = 1
     spec_k: int = 0
     spec_drafter: str = "ngram"
     spec_ngram_max: int = 3
@@ -278,16 +293,38 @@ class LLMEngine:
             block_size=self.cfg.block_size,
             max_blocks_per_seq=self.cfg.max_blocks_per_seq,
         )
-        self.runner = PagedModelRunner(
-            model_cfg, params, self.cfg.block_size, attn_impl=self.cfg.attn_impl
-        )
-        self.pool = KVBlockPool(
-            cache_cfg,
-            n_layers=model_cfg.n_layers,
-            n_heads=model_cfg.n_heads,
-            head_dim=model_cfg.head_dim,
-            dtype=model_cfg.dtype,
-        )
+        if self.cfg.tp > 1:
+            # tensor-parallel substrate (llm.multichip): sharded runner +
+            # head-sharded pool over the same tp mesh; everything below
+            # (scheduler, prefix cache, drafter, watchdog) is mesh-blind
+            from ray_tpu.llm.multichip import (
+                ShardedKVBlockPool,
+                TensorParallelPagedModelRunner,
+            )
+
+            self.runner = TensorParallelPagedModelRunner(
+                model_cfg, params, self.cfg.block_size,
+                attn_impl=self.cfg.attn_impl, tp=self.cfg.tp,
+            )
+            self.pool = ShardedKVBlockPool(
+                cache_cfg,
+                n_layers=model_cfg.n_layers,
+                n_heads=model_cfg.n_heads,
+                head_dim=model_cfg.head_dim,
+                dtype=model_cfg.dtype,
+                tp=self.cfg.tp,
+            )
+        else:
+            self.runner = PagedModelRunner(
+                model_cfg, params, self.cfg.block_size, attn_impl=self.cfg.attn_impl
+            )
+            self.pool = KVBlockPool(
+                cache_cfg,
+                n_layers=model_cfg.n_layers,
+                n_heads=model_cfg.n_heads,
+                head_dim=model_cfg.head_dim,
+                dtype=model_cfg.dtype,
+            )
         self.prefix_cache = None
         if self.cfg.prefix_cache:
             from ray_tpu.llm.prefix_cache import PrefixCache
@@ -550,7 +587,10 @@ class LLMEngine:
         """
         import jax
 
-        new = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        # prepare_params owns placement: plain device conversion single-
+        # chip, sharded device_put (+ fused-qkv permutation) under tp>1 —
+        # either way the swap lands with the compiled steps' exact layout
+        new = self.runner.prepare_params(params)
         t0 = time.perf_counter()
         with self._lock:
             old_struct = jax.tree_util.tree_structure(self.runner.params)
@@ -1092,10 +1132,19 @@ class LLMEngine:
     def hbm_ledger(self) -> dict:
         """Live HBM byte accounting (the gauges' source of truth, also
         handy for tests/stats): params, pool total, and the seq-owned /
-        cache-only / free partition of usable blocks × block bytes."""
+        cache-only / free partition of usable blocks × block bytes.
+
+        Under ``tp > 1`` a ``per_device`` section attributes the same
+        families per device: pool/params from the arrays actually
+        resident (head shards + replicated copies — params per device
+        EXCEEDS ``params_bytes / tp`` because replicated leaves are a
+        full copy each), the block partition scaled by each device's
+        local block bytes, the drafter (single-chip) on device 0.  The
+        top-level numbers stay pool-wide — the ledger is host-global,
+        block ids are not per-shard."""
         bb = self.pool.block_bytes
         counts = self.pool.ledger_counts()
-        return {
+        led = {
             "params_bytes": self._params_bytes,
             "pool_bytes": self.pool.device_bytes,
             "block_bytes": bb,
@@ -1112,6 +1161,23 @@ class LLMEngine:
             "utilization": counts["seq_owned"]
             / max(self.pool.cfg.num_blocks - 1, 1),
         }
+        if self.cfg.tp > 1:
+            pool_dev = self.pool.per_device_bytes()
+            par_dev = self.runner.per_device_param_bytes()
+            nb = self.pool.cfg.num_blocks
+            first = next(iter(pool_dev), None)
+            led["per_device"] = {
+                dev: {
+                    "params_bytes": par_dev.get(dev, 0),
+                    "pool_bytes": pool_b,
+                    "seq_bytes": counts["seq_owned"] * (pool_b // nb),
+                    "cache_bytes": counts["cache_only"] * (pool_b // nb),
+                    "free_bytes": counts["free"] * (pool_b // nb),
+                    "drafter_bytes": self._drafter_bytes if dev == first else 0,
+                }
+                for dev, pool_b in pool_dev.items()
+            }
+        return led
 
     def _publish_gauges(self) -> None:
         m = _metrics()
@@ -1125,6 +1191,17 @@ class LLMEngine:
         m["hbm_cache"].set(led["cache_bytes"])
         m["hbm_free"].set(led["free_bytes"])
         m["hbm_drafter"].set(led["drafter_bytes"])
+        # tp>1: the same gauge NAMES split by a device tag (RL012 keeps
+        # the name registry honest — tags are free); the untagged series
+        # above stays pool-wide for every existing consumer
+        for dev, row in led.get("per_device", {}).items():
+            tags = {"device": dev}
+            m["hbm_params"].set(row["params_bytes"], tags=tags)
+            m["hbm_pool"].set(row["pool_bytes"], tags=tags)
+            m["hbm_seq"].set(row["seq_bytes"], tags=tags)
+            m["hbm_cache"].set(row["cache_bytes"], tags=tags)
+            m["hbm_free"].set(row["free_bytes"], tags=tags)
+            m["hbm_drafter"].set(row["drafter_bytes"], tags=tags)
         done = self.scheduler.finish_count
         if done > self._finished_published:
             m["finished"].inc(done - self._finished_published)

@@ -147,6 +147,10 @@ class PagedModelRunner:
         self.params = params
         self.block_size = block_size
         self.attn_impl = attn_impl
+        # heads THIS runner's traced bodies see: all of them single-chip;
+        # the tensor-parallel subclass (llm.multichip) narrows this to its
+        # per-device head group and reuses _qkv_rows unchanged
+        self.n_local_heads = cfg.n_heads
         # donate the pool buffers: the scatter of each step's k/v updates
         # in place instead of copying the whole pool every call (the pool
         # is the biggest array in inference — a per-step copy would cost
@@ -179,6 +183,15 @@ class PagedModelRunner:
             first_call_s=round(time.perf_counter() - t0, 3),
         )
 
+    def prepare_params(self, params: dict) -> dict:
+        """Normalize a (new) weight tree to the placement the compiled
+        steps expect.  Single-chip that is just host->device conversion;
+        the tensor-parallel runner overrides this with its sharded
+        ``device_put`` (plus the fused-qkv column permutation), and
+        ``LLMEngine.update_weights`` routes every hot-swap through here
+        so swapped weights land exactly like the originals."""
+        return jax.tree_util.tree_map(jnp.asarray, params)
+
     # -- shared layer math -------------------------------------------------
 
     def _qkv_rows(self, layer, h, positions):
@@ -187,7 +200,7 @@ class PagedModelRunner:
         cfg = self.cfg
         dt = h.dtype
         n = h.shape[0]
-        nh, hd = cfg.n_heads, cfg.head_dim
+        nh, hd = self.n_local_heads, cfg.head_dim
         if self.arch == "gptj":
             q = (h @ layer["q"]["kernel"].astype(dt)).reshape(n, nh, hd)
             k = (h @ layer["k"]["kernel"].astype(dt)).reshape(n, nh, hd)

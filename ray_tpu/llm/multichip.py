@@ -1,0 +1,477 @@
+"""Tensor-parallel paged inference — the multi-chip LLM engine substrate.
+
+The single-chip serving stack (``llm.cache`` pool, ``llm.model_runner``
+jitted steps, ``ops.paged_attention``) caps the servable model at one
+chip's HBM.  This module lifts exactly that stack onto a 1-axis
+``("tp",)`` mesh (``parallel.mesh.make_tp_mesh``) with the classic
+Megatron column/row split, chosen so the PAGED layout shards for free:
+
+* **KV block pool** — head axis sharded, ``P(None, None, "tp", None,
+  None)`` over ``(layers, num_blocks, heads, block_size, head_dim)``.
+  Block ids are GLOBAL (every device holds the same blocks' local
+  heads), so the host-side ledger, block tables, prefix-cache radix
+  tree, watchdog ``audit()`` and CoW fork bookkeeping are untouched —
+  the only sharded thing is the payload.
+* **Attention** — per-head math never crosses heads: q/k/v projections
+  are column-parallel (each device computes its own heads), the paged
+  gather/scatter and softmax run on the local head group, and only the
+  output projection is row-parallel (one ``psum`` per layer).
+* **MLP** — ``mlp_in`` column-parallel, ``mlp_out`` row-parallel,
+  second ``psum``.  GPT-J's parallel residual lets attention and MLP
+  share a single fused reduction per layer.
+* **Everything else** (embedding, layernorms, lm_head, sampling) is
+  replicated: post-``psum`` activations are identical on all devices,
+  so every device samples the same token and the engine reads one
+  replicated result.
+
+The three jitted entry points (decode / prefill / verify) and the CoW
+``fork_blocks`` keep their single-chip signatures — ``LLMEngine``,
+speculative decoding, preemption-recompute, failover ``resume_tokens``
+and the prefix cache run UNCHANGED on top; ``EngineConfig(tp=N)`` is
+the only switch.  Off-TPU this runs on jax host-platform device-count
+meshes (``XLA_FLAGS=--xla_force_host_platform_device_count``), which is
+how tier-1 exercises tp=2/4 on CPU; Pallas kernels stay interpret-gated
+per ``ops.paged_attention.INTERPRET_ONLY``.
+
+Numerics: splitting the two row-parallel contractions across devices
+changes the floating-point reduction order, so activations drift from
+the single-chip engine by ~1 ulp per layer.  Greedy argmax and
+fixed-seed sampling are robust to that (pinned by
+``tests/test_llm_multichip.py``'s tp=1 vs tp=2/4 identity matrix); the
+per-head attention path itself is bitwise identical per head.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
+from ray_tpu.llm.cache import CacheConfig, KVBlockPool
+from ray_tpu.llm.model_runner import (
+    PagedModelRunner,
+    _fork_impl,
+    _layernorm,
+    _sample_rows,
+    _scatter_kv,
+    _verify_rows,
+)
+from ray_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_prefill_attention_xla,
+    paged_verify_attention,
+)
+from ray_tpu.parallel.mesh import make_tp_mesh
+
+
+def _per_device_bytes(mesh, leaves) -> dict:
+    """device-id label -> local bytes actually resident on that device
+    (replicated leaves count once PER device — that copy is real HBM).
+    The HBM ledger's per-device attribution reads this."""
+    out = {str(d.id): 0 for d in mesh.devices.flat}
+    for leaf in leaves:
+        for sh in getattr(leaf, "addressable_shards", ()):
+            key = str(sh.device.id)
+            if key in out:
+                out[key] += int(sh.data.nbytes)
+    return out
+
+
+class ShardedKVBlockPool(KVBlockPool):
+    """KV block pool whose device arrays are head-sharded over the tp
+    mesh.  The host ledger (free list, refcounts, audit) is inherited
+    verbatim — block ids are global, so every ledger invariant and the
+    watchdog's leak audit hold independent of the mesh size."""
+
+    def __init__(
+        self,
+        cfg: CacheConfig,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        dtype="float32",
+        *,
+        tp: int = 1,
+    ):
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if n_heads % tp:
+            raise ValueError(
+                f"n_heads={n_heads} not divisible by tp={tp} — the pool "
+                "shards the head axis"
+            )
+        self.tp = tp
+        self._mesh = make_tp_mesh(tp)
+        super().__init__(
+            cfg, n_layers, n_heads, head_dim, dtype,
+            sharding=NamedSharding(self._mesh, P(None, None, "tp", None, None)),
+        )
+
+    def per_device_bytes(self) -> dict:
+        """Local pool bytes per device — ``device_bytes / tp`` each, the
+        whole point of sharding the pool."""
+        return _per_device_bytes(self._mesh, (self.k, self.v))
+
+
+class TensorParallelPagedModelRunner(PagedModelRunner):
+    """``PagedModelRunner`` with the jitted steps shard_map'd over the
+    tp mesh.  Wrapper methods (``decode_step``/``verify_step``/
+    ``fork_blocks``) and the engine-facing contract are inherited; only
+    the traced bodies and parameter placement change."""
+
+    def __init__(
+        self,
+        cfg: Any,
+        params: dict,
+        block_size: int,
+        attn_impl: str = "auto",
+        *,
+        tp: int,
+    ):
+        super().__init__(cfg, params, block_size, attn_impl)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if cfg.n_heads % tp:
+            raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+        if cfg.d_ff % tp:
+            raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
+        self.tp = tp
+        self._mesh = make_tp_mesh(tp)
+        # inherited _qkv_rows reshapes to this many heads — the ones
+        # whose kernels' column shards live on this device
+        self.n_local_heads = cfg.n_heads // tp
+        self.params = self.prepare_params(params)
+        pspecs = self._param_spec_tree()
+        # re-jit the step functions over the mesh (the base jits were
+        # never traced); donation contract is the base class's — the
+        # pool shards update in place
+        self._decode = jax.jit(
+            shard_map(
+                self._decode_shard,
+                mesh=self._mesh,
+                in_specs=(
+                    pspecs,
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(), P(), P(), P(), P(), P(), P(),
+                ),
+                out_specs=(
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(),
+                ),
+                check_vma=False,
+            ),
+            donate_argnums=(1, 2),
+        )
+        self._verify = jax.jit(
+            shard_map(
+                self._verify_shard,
+                mesh=self._mesh,
+                in_specs=(
+                    pspecs,
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(), P(), P(), P(), P(), P(), P(),
+                ),
+                out_specs=(
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(), P(),
+                ),
+                check_vma=False,
+            ),
+            donate_argnums=(1, 2),
+        )
+        self._prefill = jax.jit(
+            shard_map(
+                self._prefill_shard,
+                mesh=self._mesh,
+                in_specs=(
+                    pspecs,
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(), P(), P(),
+                ),
+                out_specs=(
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(),
+                ),
+                check_vma=False,
+            ),
+            donate_argnums=(1, 2),
+        )
+        # CoW fork copies whole blocks along axis 1 — head-agnostic, so
+        # the single-chip impl runs per-shard unchanged
+        self._fork = jax.jit(
+            shard_map(
+                _fork_impl,
+                mesh=self._mesh,
+                in_specs=(
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                    P(), P(),
+                ),
+                out_specs=(
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                ),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    # -- parameter placement ----------------------------------------------
+
+    def _spec_for(self, path) -> P:
+        """Megatron split by param path: q/k/v + mlp_in column-parallel
+        (output dim sharded, biases ride along), attn_out + mlp_out
+        row-parallel (input dim sharded, replicated biases added after
+        the psum), everything else replicated."""
+        names = [getattr(p, "key", None) for p in path]
+        if names and names[0] == "blocks" and len(names) >= 3:
+            mod, slot = names[1], names[-1]
+            if mod in ("q", "k", "v", "attn_qkv", "mlp_in"):
+                return P(None, None, "tp") if slot == "kernel" else P(None, "tp")
+            if mod in ("attn_out", "mlp_out") and slot == "kernel":
+                return P(None, "tp", None)
+        return P()
+
+    def _param_spec_tree(self):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _leaf: self._spec_for(path), self.params
+        )
+
+    def _shuffle_qkv(self, x: jax.Array) -> jax.Array:
+        """GPT's fused qkv projection lays its last axis out ``[Q|K|V]``;
+        plain column sharding would hand device i a slice of Q spilling
+        into K.  Permute host-side to the concat over devices of
+        ``[Q_i|K_i|V_i]`` so each device's contiguous shard splits
+        locally into its own head group's q/k/v (shape preserved, so
+        ``update_weights`` leaf validation is unaffected)."""
+        d = x.shape[-1] // 3
+        dl = d // self.tp
+        q, k, v = jnp.split(x, 3, axis=-1)
+        parts = []
+        for i in range(self.tp):
+            sl = slice(i * dl, (i + 1) * dl)
+            parts.extend([q[..., sl], k[..., sl], v[..., sl]])
+        return jnp.concatenate(parts, axis=-1)
+
+    def prepare_params(self, params: dict) -> dict:
+        """Sharded ``device_put`` of a (new) weight tree — the
+        ``update_weights`` hot-swap path and __init__ share it, so a
+        swap lands with the exact placement the compiled steps expect
+        (no silent retrace; RL024's runtime twin watches this)."""
+        new = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.arch == "gpt":
+            new = dict(new)
+            blocks = dict(new["blocks"])
+            qkv = dict(blocks["attn_qkv"])
+            qkv["kernel"] = self._shuffle_qkv(qkv["kernel"])
+            qkv["bias"] = self._shuffle_qkv(qkv["bias"])
+            blocks["attn_qkv"] = qkv
+            new["blocks"] = blocks
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: jax.device_put(
+                leaf, NamedSharding(self._mesh, self._spec_for(path))
+            ),
+            new,
+        )
+
+    def per_device_param_bytes(self) -> dict:
+        """device-id label -> param bytes resident there (column/row
+        shards + this device's copy of every replicated leaf)."""
+        return _per_device_bytes(
+            self._mesh, jax.tree_util.tree_leaves(self.params)
+        )
+
+    # -- per-device layer math --------------------------------------------
+
+    def _tp_layer(self, x, layer, k_l, v_l, positions, phys, off, attend):
+        """One transformer layer on THIS device's head/ff shard.
+        ``attend(q, k_l, v_l) -> (rows, local_d)`` supplies the step
+        shape's paged attention over the local head group; the two
+        row-parallel projections produce partial sums reduced with
+        ``psum`` over "tp" (replicated biases added once, after)."""
+        dt = x.dtype
+        if self.arch == "gptj":
+            h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+            q, k, v = self._qkv_rows(layer, h, positions)
+            k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+            v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+            att_p = attend(q, k_l, v_l) @ layer["attn_out"]["kernel"].astype(dt)
+            mid = jax.nn.gelu(
+                h @ layer["mlp_in"]["kernel"].astype(dt)
+                + layer["mlp_in"]["bias"].astype(dt)
+            )
+            mlp_p = mid @ layer["mlp_out"]["kernel"].astype(dt)
+            # parallel residual: attention + MLP partials share ONE
+            # fused reduction per layer (half the collectives of the
+            # sequential-residual arch below)
+            out = (
+                x
+                + jax.lax.psum(att_p + mlp_p, "tp")
+                + layer["mlp_out"]["bias"].astype(dt)
+            )
+        else:
+            ln1 = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+            q, k, v = self._qkv_rows(layer, ln1, positions)
+            k_l = _scatter_kv(k_l, k.astype(k_l.dtype), phys, off)
+            v_l = _scatter_kv(v_l, v.astype(v_l.dtype), phys, off)
+            att_p = attend(q, k_l, v_l) @ layer["attn_out"]["kernel"].astype(dt)
+            h = (
+                x
+                + jax.lax.psum(att_p, "tp")
+                + layer["attn_out"]["bias"].astype(dt)
+            )
+            ln2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+            mid = jax.nn.gelu(
+                ln2 @ layer["mlp_in"]["kernel"].astype(dt)
+                + layer["mlp_in"]["bias"].astype(dt)
+            )
+            out = (
+                h
+                + jax.lax.psum(mid @ layer["mlp_out"]["kernel"].astype(dt), "tp")
+                + layer["mlp_out"]["bias"].astype(dt)
+            )
+        return out, k_l, v_l
+
+    # -- shard bodies ------------------------------------------------------
+    # Same control flow as the PagedModelRunner._*_impl bodies, with the
+    # pool/head math local and the reductions explicit.  Post-psum
+    # activations are replicated, so lm_head + sampling run identically
+    # on every device and the P() out_specs read one copy.
+
+    def _decode_shard(
+        self, params, k_pool, v_pool, tokens, positions, tables,
+        temp, top_k, top_p, seeds, counters,
+    ):
+        bs = self.block_size
+        S = tokens.shape[0]
+        x = self._embed(params, tokens, positions)
+        phys = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)[:, 0]
+        off = positions % bs
+        lengths = positions + 1
+        runner = self
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+
+            def attend(q, k_loc, v_loc):
+                return paged_attention(
+                    q, k_loc, v_loc, tables, lengths, impl=runner.attn_impl
+                ).astype(x.dtype).reshape(S, -1)
+
+            out, k_l, v_l = runner._tp_layer(
+                x, layer, k_l, v_l, positions, phys, off, attend
+            )
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        logits = self._lm_head(params, x)
+        nxt, logp = _sample_rows(logits, seeds, counters, temp, top_k, top_p)
+        return k_pool, v_pool, nxt, logp
+
+    def _verify_shard(
+        self, params, k_pool, v_pool, tokens, base_pos, tables,
+        temp, top_k, top_p, seeds, counters,
+    ):
+        cfg = self.cfg
+        bs = self.block_size
+        S, W = tokens.shape
+        tmax = tables.shape[1]
+        positions = base_pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        pos_flat = positions.reshape(-1)
+        x = self._embed(params, tokens.reshape(-1), pos_flat)
+        # overflow positions clamp to trash exactly like the base impl
+        valid = pos_flat < tmax * bs
+        logical = jnp.minimum(pos_flat // bs, tmax - 1)
+        tables_rep = jnp.repeat(tables, W, axis=0)
+        phys = jnp.where(
+            valid,
+            jnp.take_along_axis(tables_rep, logical[:, None], axis=1)[:, 0],
+            0,
+        )
+        off = pos_flat % bs
+        runner = self
+        nh, hd = self.n_local_heads, cfg.head_dim
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+
+            def attend(q, k_loc, v_loc):
+                return paged_verify_attention(
+                    q.reshape(S, W, nh, hd), k_loc, v_loc, tables, positions,
+                    impl=runner.attn_impl,
+                ).astype(x.dtype).reshape(S * W, -1)
+
+            out, k_l, v_l = runner._tp_layer(
+                x, layer, k_l, v_l, pos_flat, phys, off, attend
+            )
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        logits = self._lm_head(params, x).reshape(S, W, -1)
+        n_acc, out, logp = _verify_rows(
+            logits, tokens[:, 1:], seeds, counters, temp, top_k, top_p
+        )
+        return k_pool, v_pool, n_acc, out, logp
+
+    def _prefill_shard(
+        self, params, k_pool, v_pool, tokens, start, n_valid, table,
+    ):
+        # chunk is tokens.shape[0] — static under jit, but NOT a static
+        # kwarg: shard_map takes positional specs only, and the engine
+        # always pads to cfg.prefill_chunk so this still traces once
+        bs = self.block_size
+        chunk = tokens.shape[0]
+        positions = start + jnp.arange(chunk, dtype=jnp.int32)
+        valid = jnp.arange(chunk) < n_valid
+        x = self._embed(params, tokens, positions)
+        phys = jnp.where(valid, table[positions // bs], 0)
+        off = positions % bs
+        runner = self
+
+        def one_layer(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+
+            def attend(q, k_loc, v_loc):
+                return paged_prefill_attention_xla(
+                    q, k_loc, v_loc, table, positions
+                ).astype(x.dtype).reshape(chunk, -1)
+
+            out, k_l, v_l = runner._tp_layer(
+                x, layer, k_l, v_l, positions, phys, off, attend
+            )
+            return out, (k_l, v_l)
+
+        x, (k_pool, v_pool) = jax.lax.scan(
+            one_layer, x, (params["blocks"], k_pool, v_pool)
+        )
+        last = x[jnp.maximum(n_valid - 1, 0)]
+        logits = self._lm_head(params, last[None, :])[0]
+        return k_pool, v_pool, logits
+
+    def prefill_chunk(self, k_pool, v_pool, tokens, start, n_valid, table):
+        # base passes chunk= as a static kwarg; the shard body derives it
+        t0 = time.perf_counter()
+        out = self._prefill(
+            self.params, k_pool, v_pool, tokens,
+            jnp.int32(start), jnp.int32(n_valid), table,
+        )
+        self._note_compile("prefill", len(tokens), t0)
+        self.prof.note("prefill", self._prefill, time.perf_counter() - t0)
+        return out

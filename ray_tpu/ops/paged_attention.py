@@ -80,6 +80,27 @@ INTERPRET_ONLY = (
     " real-TPU validation item)",
 )
 
+# Tensor-parallel (llm.multichip) tiling notes for the real-TPU
+# follow-up.  Under ``EngineConfig(tp=N)`` these kernels run INSIDE a
+# shard_map body: the pool and query tensors they see carry
+# ``n_heads // tp`` LOCAL heads (the head axis is sharded
+# ``P(None, None, "tp", None, None)``), everything else — block_size,
+# head_dim, the block tables — is unchanged.  Consequences for the
+# compiled path when the gates above are retired:
+#   * the MXU constraints are per-head (block_size % 8, head_dim % 128),
+#     so head-sharding does not change any tile shape — a kernel that
+#     tiles at tp=1 tiles at any tp;
+#   * the head axis is the kernel grid's embarrassingly-parallel dim;
+#     shrinking it tp-fold shrinks the grid, so per-device occupancy
+#     drops for configs with few heads (e.g. 8 heads at tp=4 leaves a
+#     2-wide grid) — prefer fusing heads into the batch grid dim before
+#     validating small-head configs;
+#   * no collective runs inside the kernel: the tp psum happens in the
+#     caller (multichip._tp_layer) AFTER the attention output
+#     projection, so the Pallas body needs no REMOTE dma / barrier
+#     semantics and interpret-mode parity on host devices remains a
+#     faithful oracle for the sharded path.
+
 
 # ---------------------------------------------------------------------------
 # XLA reference path

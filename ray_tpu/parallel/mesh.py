@@ -76,3 +76,29 @@ def make_mesh(
 
     arr = np.asarray(devices).reshape([sizes[a] for a in axis_names])
     return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def make_tp_mesh(
+    tp: int,
+    *,
+    devices: Optional[Sequence] = None,
+    axis_names: Sequence[str] = ("tp",),
+):
+    """Build the 1-axis ``("tp",)`` mesh the multi-chip LLM engine runs on.
+
+    A dedicated factory (rather than ``make_mesh(MeshConfig(tp=...))``)
+    for two reasons: the serving engine wants the first ``tp`` devices in
+    topology order — tensor-parallel collectives every decode step must
+    ride adjacent ICI links — and the keyword-only ``axis_names`` default
+    keeps the axis tuple statically resolvable for raylint's mesh phase
+    (RL020/RL021 resolve ``make_*mesh`` factory defaults; see LINTING.md).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()[:tp]
+    if len(devices) != tp:
+        raise ValueError(f"tp={tp} needs {tp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices), axis_names=tuple(axis_names))

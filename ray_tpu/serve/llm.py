@@ -231,7 +231,10 @@ class LLMDeployment:
         affinity, a ROADMAP item) shows up directly as a higher hit rate
         here.  Note ``kv_utilization`` counts only blocks live requests
         hold: cache-only residents are evictable on demand and never
-        create upscale pressure."""
+        create upscale pressure.  Under tensor parallelism (``tp > 1``)
+        it stays POOL-WIDE, not per-shard: block ids are global across
+        the mesh (llm.multichip), so the pool-wide fraction IS each
+        device's fraction and the honest saturation signal."""
         s = self._engine.stats()
         m = {
             "queue_depth": s["queue_depth"],
@@ -267,8 +270,19 @@ def build_llm_app(
     autoscaling_config=None,
     name: str = "LLMDeployment",
     warmup: bool = True,
+    tp: Optional[int] = None,
 ):
     """Bind an ``LLMDeployment`` application (deploy with ``serve.run``).
+
+    ``tp`` — tensor parallelism per replica (``llm.multichip``): a
+    convenience overlay on ``engine_config.tp`` so app builders can
+    shard replicas over the tp mesh without constructing an
+    ``EngineConfig``.  Each replica builds its own mesh over the first
+    ``tp`` visible devices.  ``autoscaling_metrics`` keeps reporting the
+    POOL-WIDE ``kv_utilization`` — the block ledger is host-global under
+    tp (every device holds the same blocks' local heads), so a per-shard
+    number would just repeat it ``tp`` times and a partial one would
+    under-report saturation to the controller.
 
     ``max_ongoing_requests`` should comfortably exceed the engine's
     ``max_slots`` — the whole point of continuous batching is holding
@@ -286,6 +300,12 @@ def build_llm_app(
     """
     from ray_tpu.serve.api import deployment
 
+    if tp is not None:
+        import dataclasses
+
+        engine_config = dataclasses.replace(
+            engine_config or EngineConfig(), tp=tp
+        )
     dep = deployment(
         LLMDeployment,
         name=name,

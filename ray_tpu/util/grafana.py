@@ -143,11 +143,17 @@ def _profiling_panels() -> list:
          'rate(ray_tpu_device_retraces[5m])', "short",
          "Sites recompiling AFTER warmup (RL014's runtime twin) — any "
          "sustained rate fires the retrace-storm SLO rule."),
-        # one panel per ledger gauge — all five series are untagged, so a
-        # PromQL `a or b` would collapse to `a` (same pitfall the
-        # running/waiting panels document above)
+        # one panel per ledger gauge. The pool-wide series is untagged, so
+        # a PromQL `a or b` would collapse to `a` (same pitfall the
+        # running/waiting panels document above); under a tensor-parallel
+        # engine (EngineConfig(tp>1)) every gauge ALSO publishes one
+        # series per mesh device tagged `device="<id>"` — a plain
+        # metric-name expr renders them all as separate legend entries,
+        # so these panels need no per-tp variant
         ("HBM params bytes", "ray_tpu_llm_hbm_params_bytes", "bytes",
-         "Device bytes held by model params."),
+         "Device bytes held by model params (per-device series under "
+         "tp>1 exceed the even split: replicated leaves are a full copy "
+         "each)."),
         ("HBM seq-owned KV bytes", "ray_tpu_llm_hbm_kv_seq_bytes", "bytes",
          "KV blocks owned by ≥1 live sequence × block bytes."),
         ("HBM cache-resident KV bytes", "ray_tpu_llm_hbm_kv_cache_bytes",
@@ -160,7 +166,8 @@ def _profiling_panels() -> list:
          "Speculative drafter params (0 for the n-gram drafter)."),
         ("KV pool footprint", "ray_tpu_llm_hbm_kv_pool_bytes", "bytes",
          "Total device bytes of the paged-KV pool arrays (fixed at "
-         "engine start)."),
+         "engine start; per-device series under tp>1 are exactly 1/tp — "
+         "the head axis is sharded)."),
     ]
 
 
