@@ -366,6 +366,165 @@ def batched_main() -> dict:
     return rec
 
 
+def data_plane_main() -> dict:
+    """Data-plane probe (``--data-plane``): put/get MB/s at 1KB/64KB/1MB
+    across a LOCAL arm (driver + same-machine workers: the ISSUE 18
+    shm-locator path) and a REMOTE arm (loopback NodeAgents with
+    RAY_TPU_FORCE_DATA_PLANE=1: the peer-to-peer TCP fetch path), plus
+    the locality-scheduler placement fraction and a tasks_async canary.
+    One JSON record as the last stdout line (the data-plane.json CI
+    artifact). ``local_worker_put_*`` is the arm ISSUE 18 targets: puts
+    originate in a WORKER process, so before the shm plane every value
+    in the (8KB, 100KB] band rode the control socket inline — twice.
+    Set RAY_TPU_CORE_SHM_INLINE_THRESHOLD=102400 and
+    RAY_TPU_CORE_PUT_PIPELINE=0 to restore that path on the same box
+    (the BENCH_r09 paired "before" arm)."""
+    import tempfile
+
+    import ray_tpu
+
+    env = bench_environment()
+    env["core_shm_inline_threshold"] = int(
+        os.environ.get("RAY_TPU_CORE_SHM_INLINE_THRESHOLD", 8 * 1024)
+    )
+    env["core_put_pipeline"] = os.environ.get(
+        "RAY_TPU_CORE_PUT_PIPELINE", "1"
+    ).lower() not in ("0", "false", "no")
+    sizes = {"1kb": 1024, "64kb": 64 * 1024, "1mb": 1024 * 1024}
+    results = []
+
+    # ---- local arm: single-machine cluster, same-node shm path -----------
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def wput(n, nb):
+        b = np.ones(nb, np.uint8)
+        for _ in range(n):
+            ray_tpu.put(b)
+        return n
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    for name, nb in sizes.items():
+        blob = np.ones(nb, np.uint8)
+        # 32MB per window: sub-20ms windows on a contended 1-core box swing
+        # 2x with scheduler noise and drown the arm-vs-arm signal
+        reps = max(8, min(512, (32 << 20) // nb))
+
+        def put_burst(n=reps, b=blob):
+            for _ in range(n):
+                ray_tpu.put(b)
+            return n * b.nbytes / 1e6
+
+        results.append(timeit(f"local_driver_put_{name}", put_burst, unit="MB_per_s"))
+
+        pool = [ray_tpu.put(blob) for _ in range(8)]
+
+        def get_burst(n=reps, pool=pool, nb=nb):
+            t = 0
+            for i in range(n):
+                t += int(ray_tpu.get(pool[i % len(pool)])[::4096].sum())
+            assert t
+            return n * nb / 1e6
+
+        results.append(timeit(f"local_driver_get_{name}", get_burst, unit="MB_per_s"))
+
+        def worker_put(n=reps, nb=nb):
+            ray_tpu.get(wput.remote(n, nb), timeout=120)
+            return n * nb / 1e6
+
+        results.append(timeit(f"local_worker_put_{name}", worker_put, unit="MB_per_s"))
+
+    # regression canary: the locality pass must not tax argless dispatch
+    def tasks_async(n=2000):
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit("tasks_async_canary", tasks_async))
+    ray_tpu.shutdown()
+
+    # ---- remote arm: loopback agents, forced peer-to-peer TCP fetch ------
+    from ray_tpu._private.config import resolve_authkey
+    from ray_tpu._private.head import Head
+    from ray_tpu._private.node_agent import NodeAgent
+
+    prev_force = os.environ.get("RAY_TPU_FORCE_DATA_PLANE")
+    os.environ["RAY_TPU_FORCE_DATA_PLANE"] = "1"
+    authkey = resolve_authkey()
+    session = tempfile.mkdtemp(prefix="ray_tpu_bench_dp_")
+    head = Head(os.path.join(session, "head.sock"), authkey=authkey)
+    head.start()
+    host, port = head.listen_tcp("127.0.0.1", 0)
+    head.add_node({"CPU": 0.0})
+    addr = f"{host}:{port}"
+    a = NodeAgent(addr, authkey, resources={"CPU": 2.0, "nodeA": 10.0}).start()
+    b = NodeAgent(addr, authkey, resources={"CPU": 2.0, "nodeB": 10.0}).start()
+    locality = None
+    loc_hits = loc_total = 0
+    try:
+        ray_tpu.init(address=addr)
+
+        @ray_tpu.remote(resources={"nodeA": 0.01})
+        def produce(nb):
+            return np.ones(nb, np.uint8)
+
+        @ray_tpu.remote(num_cpus=1)
+        def where(x):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        for name, nb in sizes.items():
+            reps = max(8, min(64, (16 << 20) // nb))
+            pool = [produce.remote(nb) for _ in range(4)]
+            ray_tpu.wait(pool, num_returns=len(pool), timeout=60)
+
+            # forced-dp fetches are NOT reader-cached: every get below is a
+            # full TCP fetch from nodeA's data server, so pool reuse is fair
+            def remote_get(n=reps, pool=pool, nb=nb):
+                t = 0
+                for i in range(n):
+                    t += int(ray_tpu.get(pool[i % len(pool)], timeout=60)[::4096].sum())
+                assert t
+                return n * nb / 1e6
+
+            results.append(timeit(f"remote_get_{name}", remote_get, unit="MB_per_s"))
+
+        # locality fraction: unconstrained single-arg consumers should land
+        # on the node already holding the bytes (acceptance bar: >= 0.9)
+        data = produce.remote(64 * 1024)
+        ray_tpu.wait([data], timeout=60)
+        placed = [ray_tpu.get(where.remote(data), timeout=60) for _ in range(20)]
+        locality = placed.count(a.node_id_bin.hex()) / len(placed)
+        with head.lock:
+            loc_hits, loc_total = head._loc_hits, head._loc_total
+    finally:
+        if prev_force is None:
+            os.environ.pop("RAY_TPU_FORCE_DATA_PLANE", None)
+        else:
+            os.environ["RAY_TPU_FORCE_DATA_PLANE"] = prev_force
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        a.shutdown()
+        b.shutdown()
+        head.shutdown()
+
+    env["spin_canary_mops_after"] = bench_environment()["spin_canary_mops"]
+    rec = {
+        "metric": "core_data_plane",
+        "value": len(results),
+        "unit": "metrics",
+        "env": env,
+        "detail": {r["metric"]: r["value"] for r in results},
+        "locality_fraction": locality,
+        "locality_sched": {"hits": loc_hits, "total": loc_total},
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 if __name__ == "__main__":
     import sys
 
@@ -373,5 +532,7 @@ if __name__ == "__main__":
         obs_ab_main()
     elif "--batched" in sys.argv:
         batched_main()
+    elif "--data-plane" in sys.argv:
+        data_plane_main()
     else:
         main()

@@ -22,6 +22,24 @@ class Config:
     #: store; larger ones go to a shared-memory segment (reference: core
     #: worker memory store promotes to plasma above ~100KB).
     max_direct_call_object_size: int = 100 * 1024
+    #: Zero-copy data plane (ISSUE 18): a producer whose host has the native
+    #: arena attached writes any value ABOVE this many serialized bytes into
+    #: shared memory and ships only the locator over the control socket —
+    #: payload bytes never transit the head. Below it, inlining wins (the
+    #: locator + directory entry costs more than the bytes). Only applies
+    #: when an arena is actually attached; without one the fallback cutoff
+    #: is ``max_direct_call_object_size`` (a dedicated POSIX segment per
+    #: mid-size object would pay shm_open+mmap+fault per put — a regression,
+    #: not an optimisation). Set >= max_direct_call_object_size to restore
+    #: the pre-ISSUE-18 inline behavior.
+    core_shm_inline_threshold: int = 8 * 1024
+    #: Pipelined worker puts (ISSUE 18): ``ray.put`` from a worker ships
+    #: fire-and-forget (seq-0, in-order on the conn) instead of blocking a
+    #: round trip per object, so put bursts are bounded by head processing
+    #: rather than N RTTs. ``False`` restores the blocking put (the
+    #: BENCH_r09 "before" arm; ``ray://`` drivers always block — their
+    #: reconnect window cannot detect a lost un-acked put).
+    core_put_pipeline: bool = True
     #: Logical "memory" resource advertised by a node when ``ray.init`` is not
     #: given ``object_store_memory`` (reference: plasma store capacity).
     object_store_memory: int = 0  # 0 = auto (30% of system RAM)

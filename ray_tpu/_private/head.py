@@ -52,8 +52,14 @@ from ray_tpu.util import waterfall as _waterfall
 
 #: raylint RL012 registry — batch-plane telemetry the head folds (ISSUE 14):
 #: one observation per submit window / reply batch, documented in
-#: OBSERVABILITY.md beside the waterfall legs they shrink
-METRIC_NAMES = ("core_submit_batch_size", "core_reply_batch_size")
+#: OBSERVABILITY.md beside the waterfall legs they shrink; plus the
+#: locality-aware scheduler (ISSUE 18): fraction of ref-arg task placements
+#: that landed on a node already holding the args' bytes
+METRIC_NAMES = (
+    "core_submit_batch_size",
+    "core_reply_batch_size",
+    "core_sched_locality_hit_rate",
+)
 
 #: raylint RL017 registry — DELIBERATE lock-free shared state, verified by
 #: the linter (':atomic' = every write is one GIL-atomic operation; see
@@ -121,6 +127,23 @@ def _batch_metrics() -> dict:
                 ),
             }
     return _BATCH_METRICS
+
+
+_LOCALITY_GAUGE = None
+
+
+def _locality_gauge():
+    # no init lock needed: only ever touched under the head lock (_pick_node)
+    global _LOCALITY_GAUGE
+    if _LOCALITY_GAUGE is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _LOCALITY_GAUGE = Gauge(
+            "core_sched_locality_hit_rate",
+            "fraction of ref-arg task placements that landed on a node "
+            "already holding the args' shm bytes",
+        )
+    return _LOCALITY_GAUGE
 
 
 # --------------------------------------------------------------------------
@@ -707,6 +730,11 @@ class Head:
         # node add, pg placement): lets _schedule skip signatures that
         # already failed in the current generation
         self._sched_gen = 0
+        # locality-aware placement accounting (ISSUE 18): of the placements
+        # whose ref args had bytes resident on some node, how many landed on
+        # a byte-holding node (feeds core_sched_locality_hit_rate)
+        self._loc_hits = 0
+        self._loc_total = 0
         # actor_id -> actor_create rec awaiting its dedicated worker
         self._actor_create_recs: dict[bytes, dict] = {}
         self.tasks: dict[bytes, dict] = {}  # task_id -> record (pending/running)
@@ -1368,6 +1396,16 @@ class Head:
         return (None, self.data_port) if self.data_port else None
 
     def _run_request(self, conn, worker, seq, handler, payload):
+        if seq == 0:
+            # fire-and-forget request (free_ref, pipelined put): client
+            # seqs start at 1, so nobody waits on seq 0 — skip the dead
+            # resp write (one fewer socket frame per put/free in a burst)
+            try:
+                handler(**payload)
+            except BaseException as e:  # noqa: BLE001
+                warn_throttled(f"fire-and-forget {getattr(handler, '__name__', '?')}", e)
+            self.flush_outbox()
+            return
         try:
             result = handler(**payload)
             out = ("resp", seq, True, result)
@@ -1900,6 +1938,20 @@ class Head:
         cap = GLOBAL_CONFIG.core_max_spec_inline_bytes
         items = []
         for kind, body in payload["items"]:
+            if kind == "put":
+                # pipelined ray.put riding the submit window (ISSUE 18):
+                # process AT its window position — a later item in this
+                # same window may consume the ref as a task argument.
+                # rpc_put never raises (store failures land on the id),
+                # so the window always completes and always acks.
+                body.pop("return_ids", None)
+                # rpc_put returns False only for an ignored replay
+                # duplicate — tracking the session ref then would
+                # double-count the take_ref applied by the original
+                stored = self.rpc_put(**body)
+                if stored and session is not None:
+                    self._session_track(session, "put", body)
+                continue
             hid = body.pop("_hdr_ref", None)
             if hid is None:
                 spec = body
@@ -2121,11 +2173,53 @@ class Head:
             return eres  # template-cached (read-only by contract)
         return {k: v for k, v in spec.get("resources", {}).items() if v != 0}
 
+    def _locality_bytes(self, spec: dict) -> Optional[dict]:
+        """Lock held. Bytes of this spec's ref args resident per owning node
+        (ISSUE 18): the object directory already knows where every shm
+        locator lives (``ent.shm.node``), so placement can move the task to
+        its data instead of pulling bytes to an arbitrary worker. Head-host
+        bytes (``node is None``) are reachable from every same-host node and
+        carry no preference. Returns None when the spec has no args at all —
+        the no-arg hot path stays allocation-free."""
+        if not spec.get("args") and not spec.get("kwargs"):
+            return None
+        by_node = None
+        for _kind, oid in _iter_arg_refs(spec):
+            ent = self.objects.get(oid)
+            if ent is None or ent.shm is None or ent.shm.node is None:
+                continue
+            if by_node is None:
+                by_node = {}
+            nid = ent.shm.node
+            by_node[nid] = by_node.get(nid, 0) + (ent.size or 0)
+        return by_node
+
     def _pick_node(self, spec: dict, res: Optional[dict] = None) -> Optional[NodeState]:
         if res is None:
             res = self._effective_resources(spec)
         strategy = spec.get("strategy")
         if strategy is None:
+            # locality first (ISSUE 18): a task whose args' bytes already
+            # sit on some node runs where its data lives — most bytes wins,
+            # load breaks ties, infeasible byte-holders fall through to the
+            # hybrid policy below
+            loc_bytes = self._locality_bytes(spec)
+            if loc_bytes:
+                best = None
+                best_key = None
+                for nid, nbytes in loc_bytes.items():
+                    n = self.nodes.get(nid)
+                    if n is None or not n.alive or not n.can_fit(res):
+                        continue
+                    key = (-nbytes, n.utilization(res))
+                    if best_key is None or key < best_key:
+                        best, best_key = n, key
+                self._loc_total += 1
+                if best is not None:
+                    self._loc_hits += 1
+                _locality_gauge().set(self._loc_hits / self._loc_total)
+                if best is not None:
+                    return best
             # hot path (plain tasks, no placement constraint): first node in
             # stable order under the spread threshold — no alive-list or
             # feasible-list allocation, the common single/few-node case
@@ -2568,7 +2662,7 @@ class Head:
         if node is not None and node.agent is not None and node.alive:
             node.agent.send(("free_shm", loc))
 
-    def _store_locator(self, obj_id: bytes, locator):
+    def _store_locator(self, obj_id: bytes, locator, notify: bool = True):
         ent = self.objects.get(obj_id)
         if ent is None:
             ent = self.objects[obj_id] = ObjectEntry()
@@ -2586,8 +2680,9 @@ class Head:
                 self.shm_owner.register(payload)
         ent.last_access = time.monotonic()
         ent.is_error = is_err
-        self._deps_ready(obj_id)
-        self.cv.notify_all()
+        if notify:
+            self._deps_ready(obj_id)
+            self.cv.notify_all()
 
     def _unpin_deps(self, spec: dict):
         if not spec.get("args") and not spec.get("kwargs"):
@@ -3219,14 +3314,28 @@ class Head:
     def put_at(
         self, obj_id: bytes, sv: ser.SerializedValue, is_error=False, take_ref=False
     ):
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+        # same zero-copy cutoff as runtime.store_value (ISSUE 18): with the
+        # native arena up, driver puts above core_shm_inline_threshold go
+        # straight to shm — consumers map them instead of copying them off
+        # the control socket. Without the arena the old 100KB cutoff stands
+        # (a dedicated segment per mid-size object costs more than inlining).
+        threshold = (
+            GLOBAL_CONFIG.core_shm_inline_threshold
+            if self.arena_name is not None
+            else GLOBAL_CONFIG.max_direct_call_object_size
+        )
+        if sv.total_size <= threshold:
             locator = ("inline", sv.to_bytes(), is_error)
         else:
+            from ray_tpu._private.runtime import _data_counters
             from ray_tpu._private.shm_store import write_shm
 
             locator = ("shm", write_shm(sv), is_error)
+            _data_counters()[0].inc(sv.total_size)
         with self.lock:
-            self._store_locator(obj_id, locator)
+            # fresh put ids have no waiters (see rpc_put): skip the wakeup
+            fresh = obj_id not in self.objects
+            self._store_locator(obj_id, locator, notify=not fresh)
             if take_ref:
                 self.objects[obj_id].refcount += 1
 
@@ -4006,16 +4115,49 @@ class Head:
 
             _tb.print_exc()  # partial restore is better than none
 
-    def rpc_put(self, obj_id, small, shm, is_error=False, take_ref=False):
-        locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
-        locator = self._normalize_locator(locator)  # big memcpy outside lock
-        with self.lock:
-            self._store_locator(obj_id, locator)
-            if take_ref:
-                # the caller's ObjectRef refcount, folded into the put
-                # itself: one head round trip per ray.put, not two
-                self.objects[obj_id].refcount += 1
-        return True
+    def rpc_put(self, obj_id, small, shm, is_error=False, take_ref=False, replay=False):
+        """Store a put. Returns True when the delivery was APPLIED (stored,
+        or its failure stored as an error on the id) and False when a
+        replay-flagged redelivery was ignored as a duplicate — callers use
+        that to track side effects (session refs) exactly once."""
+        try:
+            if replay:
+                # redelivery after a client reconnect: the original window
+                # may have been processed before the conn dropped (only the
+                # ack was lost). Put ids are minted once per op, so a value
+                # already on the id means THIS put landed — applying again
+                # would double-count take_ref.
+                with self.lock:
+                    ent0 = self.objects.get(obj_id)
+                    if ent0 is not None and (
+                        ent0.small is not None or ent0.shm is not None or ent0.spill_path
+                    ):
+                        return False
+            locator = ("inline", small, is_error) if small is not None else ("shm", shm, is_error)
+            locator = self._normalize_locator(locator)  # big memcpy outside lock
+            with self.lock:
+                # a FIRST-time put id can have no waiters or queued deps: the
+                # head reads each conn in order, so no other party can have
+                # learned the id before the put itself landed — skip the
+                # notify_all, which otherwise wakes every parked get once
+                # per put in a burst (1-core ping-pong). Re-puts (lineage
+                # restore, retry) keep the wakeup.
+                fresh = obj_id not in self.objects
+                self._store_locator(obj_id, locator, notify=not fresh)
+                if take_ref:
+                    # the caller's ObjectRef refcount, folded into the put
+                    # itself: one head round trip per ray.put, not two
+                    self.objects[obj_id].refcount += 1
+            return True
+        except Exception as e:  # noqa: BLE001
+            # never raise: async (fire-and-forget) putters have no reply to
+            # carry the error, and a raise would strand their get() in the
+            # not-yet-arrived wait — the failure lands ON the object id
+            with self.lock:
+                self._store_error(obj_id, e)
+                if take_ref:
+                    self.objects[obj_id].refcount += 1
+            return True
 
     def rpc_get(self, obj_ids, timeout=None):
         return self.get_locators(obj_ids, timeout)

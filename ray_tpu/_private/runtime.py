@@ -31,8 +31,17 @@ _ctx: Optional["BaseContext"] = None
 _ctx_lock = threading.Lock()
 
 #: raylint RL012 registry — the submitter side of the pipelined task plane
-#: (ISSUE 14): window credits left before a submit flush blocks for acks
-METRIC_NAMES = ("core_submit_credits",)
+#: (ISSUE 14): window credits left before a submit flush blocks for acks;
+#: plus the zero-copy data plane (ISSUE 18): bytes written to / read from
+#: shared memory by this process, and whether each shm read was served by a
+#: same-host arena map (local hit) or a cross-host data-plane pull
+METRIC_NAMES = (
+    "core_submit_credits",
+    "core_shm_put_bytes",
+    "core_shm_get_bytes",
+    "core_data_local_hits",
+    "core_data_remote_pulls",
+)
 
 #: Canonical lock order of the client-side submit plane (PR 14), outermost
 #: first — raylint RL010 checks every acquisition edge against it and
@@ -50,6 +59,7 @@ LOCK_ORDER = (
 )
 
 _CREDIT_GAUGE = None
+_DATA_COUNTERS = None
 
 #: gc-queue wake sent by ObjectRef.__del__ on the free buffer's
 #: empty→non-empty edge (one futex wake per quiescent burst, never per ref)
@@ -71,6 +81,36 @@ def _credit_gauge():
             "remaining pipelined-submission window credits (tasks) in this process",
         )
     return _CREDIT_GAUGE
+
+
+def _data_counters():
+    """Data-plane counters (ISSUE 18), lazy like _credit_gauge: only
+    processes that actually move shm bytes pay the metric objects. Returns
+    (put_bytes, get_bytes, local_hits, remote_pulls)."""
+    global _DATA_COUNTERS
+    if _DATA_COUNTERS is None:
+        from ray_tpu.util.metrics import Counter
+
+        _DATA_COUNTERS = (
+            Counter(
+                "core_shm_put_bytes",
+                "serialized bytes this process wrote into shared memory "
+                "(locator-only socket traffic)",
+            ),
+            Counter(
+                "core_shm_get_bytes",
+                "serialized bytes this process read out of shared memory",
+            ),
+            Counter(
+                "core_data_local_hits",
+                "shm reads served zero-copy from a same-host arena/segment map",
+            ),
+            Counter(
+                "core_data_remote_pulls",
+                "shm reads that crossed hosts via the p2p data plane",
+            ),
+        )
+    return _DATA_COUNTERS
 
 
 def _split_for_wire(spec: dict, sent: set, hdrs_out: dict) -> dict:
@@ -490,10 +530,11 @@ class BaseContext:
                     # asking the head would hang forever: it may never have
                     # seen this id (failed fire-and-forget submission)
                     raise err
+        deadline = None if timeout is None else time.monotonic() + timeout
         locators = self.call("get", obj_ids=[r.binary() for r in refs], timeout=timeout)
         out = []
         for r, loc in zip(refs, locators):
-            value = self._materialize(r.binary(), loc)
+            value = self._materialize(r.binary(), loc, deadline=deadline)
             kind, payload, is_err = loc
             if is_err:
                 if isinstance(value, rex.RayTaskError):
@@ -510,10 +551,21 @@ class BaseContext:
         process without a local store (a ``ray://`` driver) ships inline."""
         from ray_tpu._private.shm_store import _current_write_arena, write_shm
 
-        if sv.total_size <= GLOBAL_CONFIG.max_direct_call_object_size:
+        arena = _current_write_arena()
+        # ISSUE 18 zero-copy plane: with an arena attached the inline cutoff
+        # drops to core_shm_inline_threshold — mid-size values (the
+        # (threshold, 100KB] band that used to ride the socket twice: reply
+        # in, get out) become one arena write plus a locator. Without an
+        # arena the old 100KB cutoff stands: a dedicated POSIX segment per
+        # mid-size object would cost more than the copy it saves.
+        threshold = (
+            GLOBAL_CONFIG.core_shm_inline_threshold
+            if arena is not None
+            else GLOBAL_CONFIG.max_direct_call_object_size
+        )
+        if sv.total_size <= threshold:
             return ("inline", sv.to_bytes(), is_error)
         if self.remote:
-            arena = _current_write_arena()
             if arena is None:
                 # no host-local store to serve from (remote driver, or agent
                 # without the native arena): the head re-lays these into its
@@ -530,6 +582,7 @@ class BaseContext:
                 return ("inline", sv.to_bytes(), is_error)
         loc = write_shm(sv)
         loc.node = self.node_id_bin
+        _data_counters()[0].inc(sv.total_size)
         return ("shm", loc, is_error)
 
     def _data_address_for(self, node_bin) -> Optional[tuple]:
@@ -553,11 +606,13 @@ class BaseContext:
         host, port = addr
         return (host or self.head_host, port)
 
-    def _fetch_via_data_plane(self, obj_id: bytes, payload):
+    def _fetch_via_data_plane(self, obj_id: bytes, payload, deadline=None):
         """Pull an object's bytes straight from its owning host (reference:
         pull_manager.cc chunked pulls). Returns (True, value) or (False,
         None) when the object is gone / the data plane can't serve it —
-        callers then run the lost-object recovery path."""
+        callers then run the lost-object recovery path. ``deadline``
+        (monotonic) bounds the head-mediated fallback; None = the caller
+        had no timeout, so the fallback may block like get does."""
         from ray_tpu._private import data_plane
 
         if self.authkey is None:
@@ -571,10 +626,17 @@ class BaseContext:
             return False, None
         except OSError:
             # owner unreachable (died? network?): drop the cached address
-            # and try the head-mediated inline fallback before declaring loss
-            self._data_addrs.pop(payload.node, None)
+            # and try the head-mediated inline fallback before declaring
+            # loss. The fallback honors the caller's REMAINING budget — a
+            # timeout=0 poll here used to declare loss on a locator the
+            # head was still re-laying (spill restore, lineage rebuild)
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
             try:
-                loc = self.call("get_inline", obj_ids=[obj_id], timeout=0)[0]
+                loc = self.call(
+                    "get_inline", obj_ids=[obj_id], timeout=remaining
+                )[0]
             except Exception:
                 return False, None
             if loc[0] == "inline":
@@ -582,9 +644,11 @@ class BaseContext:
                     ser.SerializedValue.from_bytes(loc[1])
                 )
             return False, None
+        _data_counters()[3].inc()
         return True, data_plane.read_layout(mv, payload)
 
-    def _materialize(self, obj_id: bytes, locator, _retry: bool = True):
+    def _materialize(self, obj_id: bytes, locator, _retry: bool = True,
+                     deadline=None):
         kind, payload, is_err = locator
         if kind == "inline":
             if payload == ser.NONE_BYTES:
@@ -610,7 +674,7 @@ class BaseContext:
         if reader is None:
             # the data plane must get its shot even on the recovery retry:
             # a lineage rebuild can land the fresh copy on a REMOTE host
-            ok, value = self._fetch_via_data_plane(obj_id, payload)
+            ok, value = self._fetch_via_data_plane(obj_id, payload, deadline)
             if ok:
                 return value
             if not _retry:
@@ -634,6 +698,9 @@ class BaseContext:
                 raise value
             return value
         value = reader.read()
+        ctrs = _data_counters()
+        ctrs[1].inc(payload.total_size)
+        ctrs[2].inc()
         self._sweep_readers()
         return value
 
@@ -795,8 +862,9 @@ class DriverContext(BaseContext):
             if head._outbox:
                 head.flush_outbox()
             oid = refs[0]._id
+            deadline = None if timeout is None else time.monotonic() + timeout
             loc = head.get_locators([oid], timeout)[0]
-            value = self._materialize(oid, loc)
+            value = self._materialize(oid, loc, deadline=deadline)
             if loc[2]:  # error locator: raise, never return
                 if isinstance(value, rex.RayTaskError):
                     raise value.as_instanceof_cause()
@@ -988,7 +1056,8 @@ class WorkerContext(BaseContext):
                     # fresh) poison a window that was delivered on the
                     # fresh conn — and the caller's retry double-submits
                     conn0 = self.conn
-                    self._submit_unacked[wid] = (ids, conn0)
+                    puts = [s for k, s in items if k == "put"]
+                    self._submit_unacked[wid] = (ids, conn0, puts)
                     self._submit_inflight += len(ids)
                     self._submit_last_flush = time.monotonic()
                     self._set_credit_gauge()
@@ -1011,9 +1080,12 @@ class WorkerContext(BaseContext):
                     with self._send_lock:
                         ser.conn_send(conn0, ("submit_batch", payload))
                 except Exception as e:
-                    # the window never reached the head: resolve its refs
-                    # locally with a retriable error (fail, never replay —
-                    # at-most-once is the pinned reconnect semantic)
+                    # the window never reached the head: resolve its TASK
+                    # refs locally with a retriable error (fail, never
+                    # replay — at-most-once is the pinned reconnect
+                    # semantic for tasks). Puts are idempotent (id minted
+                    # once per op; head dedupes replays) so they re-queue
+                    # for the next connection instead.
                     with self._submit_cv:
                         ent = self._submit_unacked.pop(wid, None)
                         if ent is not None:
@@ -1032,8 +1104,15 @@ class WorkerContext(BaseContext):
                                 "submitting a task window; the tasks did "
                                 f"not run — retry ({e})"
                             )
+                            put_ids = {s["obj_id"] for s in puts}
                             for rid in ids:
-                                self._poisoned[rid] = err
+                                if rid not in put_ids:
+                                    self._poisoned[rid] = err
+                            if puts:
+                                self._submit_buf = [
+                                    ("put", {**s, "replay": True})
+                                    for s in puts
+                                ] + self._submit_buf
                             self._set_credit_gauge()
                     return
 
@@ -1050,34 +1129,59 @@ class WorkerContext(BaseContext):
             max(0, GLOBAL_CONFIG.core_submit_window_tasks - self._submit_inflight)
         )
 
-    def _fail_submits(self, not_on=None) -> None:
-        """Connection died: resolve every ref in un-acked windows (the head
-        may or may not have processed them — the ack was lost with the
-        socket) and every unsent buffered spec to a retriable error.
-        FAIL, never replay, is the pinned choice: blind replay of a window
-        the head DID process would double-submit its tasks. ``not_on``
-        spares windows already sent on the fresh post-reconnect conn."""
+    def _fail_submits(self, not_on=None, replay_puts=True) -> None:
+        """Connection died: resolve every TASK ref in un-acked windows (the
+        head may or may not have processed them — the ack was lost with
+        the socket) and every unsent buffered task spec to a retriable
+        error. FAIL, never replay, is the pinned choice for tasks: blind
+        replay of a window the head DID process would double-submit them.
+        PUTS are the exception (ISSUE 18): a put id is minted exactly once
+        per op, so redelivery is idempotent — the head dedupes
+        replay-flagged puts — and un-acked/unsent put items re-queue for
+        the fresh connection instead of poisoning their refs.
+        ``replay_puts=False`` is the give-up sweep (reconnect failed or
+        the context is closing): poison puts too, or their refs would
+        hang. ``not_on`` spares windows already sent on the fresh
+        post-reconnect conn."""
         err = rex.RayError(
-            "connection to the cluster was lost before this task's submit "
-            "window was acknowledged; it may not have run — retry the call"
+            "connection to the cluster was lost before this submit window "
+            "was acknowledged; it may not have run — retry the call"
         )
         with self._submit_cv:
             doomed: list[bytes] = []
-            for wid, (ids, conn0) in list(self._submit_unacked.items()):
+            requeue: list = []
+            for wid, ent in list(self._submit_unacked.items()):
+                ids, conn0 = ent[0], ent[1]
+                puts = ent[2] if len(ent) > 2 else []
                 if not_on is None or conn0 is not not_on:
                     self._submit_unacked.pop(wid, None)
                     self._submit_inflight -= len(ids)
-                    doomed.extend(ids)
+                    if replay_puts and puts:
+                        put_ids = {s["obj_id"] for s in puts}
+                        doomed.extend(i for i in ids if i not in put_ids)
+                        requeue.extend(
+                            ("put", {**s, "replay": True}) for s in puts
+                        )
+                    else:
+                        doomed.extend(ids)
             if not_on is None:
                 # full-failure sweep (reconnect not yet attempted or gave
-                # up): unsent buffered specs would otherwise sit forever —
-                # fail them too. A post-reconnect sweep (not_on=fresh)
-                # KEEPS the buffer: those specs never touched any conn
-                # (shipping them on the fresh one cannot double-submit),
-                # and some may postdate the reconnect entirely.
+                # up): unsent buffered task specs would otherwise sit
+                # forever — fail them too. A post-reconnect sweep
+                # (not_on=fresh) KEEPS the buffer: those specs never
+                # touched any conn (shipping them on the fresh one cannot
+                # double-submit), and some may postdate the reconnect.
+                kept: list = []
                 for _kind, spec in self._submit_buf:
-                    doomed.extend(spec["return_ids"])
-                self._submit_buf = []
+                    if _kind == "put" and replay_puts:
+                        kept.append((_kind, spec))  # never sent: no flag
+                    else:
+                        doomed.extend(spec["return_ids"])
+                self._submit_buf = requeue + kept
+            else:
+                # replayed puts go to the FRONT: they predate everything
+                # currently buffered
+                self._submit_buf = requeue + self._submit_buf
             # header defs sent on the dead conn may not have survived
             # receiver-side (a fresh WorkerHandle starts with empty
             # submit_hdrs): re-ship every header on the next window —
@@ -1178,14 +1282,37 @@ class WorkerContext(BaseContext):
             self._flush_submits()
         self._send(msg)
 
+    # Pipelined put (ISSUE 18): puts ride the submit_batch window plane
+    # instead of blocking a round trip each — a put burst coalesces into
+    # one socket frame (bytes, or just the locator for arena-resident
+    # values) and is bounded by head processing, not N RTTs. Ordering is
+    # the window FIFO + the head consuming each connection in order: any
+    # later use of the ref (submit, get, task_done carrying it out) rides
+    # the same conn after the put. The window machinery supplies the
+    # failure contract for free: an un-acked or unsendable window poisons
+    # its ids (put ids included, via ``return_ids``) with a retriable
+    # error — which also makes async puts safe across a ray:// driver's
+    # reconnect — and head-side store failures land ON the object id as
+    # an error locator (rpc_put never raises), so get() raises either way
+    # instead of parking in the not-yet-arrived wait. Window credits
+    # double as put backpressure: a burst cannot buffer unbounded bytes.
+    _put_async = True
+
     def put_serialized(self, sv, is_error=False, take_ref=False) -> bytes:
         obj_id = ObjectID.for_put().binary()
         kind, payload, err = self.store_value(sv, is_error)
         small, shm = (payload, None) if kind == "inline" else (None, payload)
-        self.call(
-            "put", obj_id=obj_id, small=small, shm=shm, is_error=err,
-            take_ref=take_ref,
-        )
+        req = {
+            "obj_id": obj_id, "small": small, "shm": shm, "is_error": err,
+            "take_ref": take_ref,
+        }
+        if self._put_async and GLOBAL_CONFIG.core_put_pipeline:
+            # return_ids: the window plane's unit of accounting — credits,
+            # acks, and loss-poisoning all key off it
+            req["return_ids"] = [obj_id]
+            self._enqueue_submit("put", req)
+            return obj_id
+        self.call("put", **req)
         return obj_id
 
 
@@ -1201,7 +1328,12 @@ class RemoteDriverContext(WorkerContext):
     resumes the session (same namespace, refs intact — ClientSession in
     head.py); calls in flight AT the drop fail with a retriable RayError
     (resending them blindly could double-submit tasks), later calls ride
-    the new connection transparently."""
+    the new connection transparently. Pipelined puts survive the
+    reconnect: unlike tasks, a put id is minted exactly once per op, so a
+    put in an un-acked window at the drop is REPLAYED on the fresh conn
+    (the head dedupes replay-flagged puts) and unsent buffered puts ship
+    there too; only when the reconnect itself gives up are put refs
+    poisoned, so gets raise instead of hanging."""
 
     def __init__(
         self,
@@ -1314,6 +1446,9 @@ class RemoteDriverContext(WorkerContext):
                 self._fail_pending()
                 self._fail_submits()
                 if self.closed or not self._try_reconnect():
+                    # giving up for good: re-queued puts will never ship —
+                    # poison them so pending gets raise instead of hanging
+                    self._fail_submits(replay_puts=False)
                     return
                 continue
             if msg[0] == "resp":

@@ -171,6 +171,39 @@ def _profiling_panels() -> list:
     ]
 
 
+def _data_plane_panels() -> list:
+    """Zero-copy data-plane row (ISSUE 18), DERIVED from the object-plane
+    metric families (``_private.runtime.METRIC_NAMES`` counters + the
+    head's locality gauge): shm write/read throughput, where reads were
+    served from, and how often the scheduler moved tasks to their data."""
+    return [
+        ("Shm put throughput", "rate(ray_tpu_core_shm_put_bytes[1m])", "Bps",
+         "Serialized bytes/s producers wrote straight into shared memory "
+         "(core_shm_put_bytes) — these bytes ship as locators, never as "
+         "control-socket payload."),
+        ("Shm get throughput", "rate(ray_tpu_core_shm_get_bytes[1m])", "Bps",
+         "Serialized bytes/s consumers read back out of shared-memory "
+         "maps (core_shm_get_bytes)."),
+        ("Local hits vs remote pulls",
+         "rate(ray_tpu_core_data_local_hits[1m])", "short",
+         "Shm reads served zero-copy from a same-host map "
+         "(core_data_local_hits); plot ray_tpu_core_data_remote_pulls "
+         "beside it — a rising remote share means tasks are landing away "
+         "from their data."),
+        ("Remote pulls/s",
+         "rate(ray_tpu_core_data_remote_pulls[1m])", "short",
+         "Shm reads that crossed hosts via the p2p data plane "
+         "(core_data_remote_pulls) — each one is a full payload copy the "
+         "locality scheduler tries to avoid."),
+        ("Scheduler locality hit rate",
+         "ray_tpu_core_sched_locality_hit_rate", "percentunit",
+         "Fraction of ref-arg task placements that landed on a node "
+         "already holding the args' shm bytes "
+         "(core_sched_locality_hit_rate); sustained low values mean "
+         "byte-holding nodes are capacity-starved."),
+    ]
+
+
 def _slo_panels() -> list:
     """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
     panels interpolate the same threshold/objective/window the head's alert
@@ -280,7 +313,7 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     pid = 0
     for title, expr, unit, desc in (_CORE_PANELS + _LLM_PANELS
                                     + _prefix_panels() + _profiling_panels()
-                                    + _slo_panels()):
+                                    + _data_plane_panels() + _slo_panels()):
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:

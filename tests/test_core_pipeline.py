@@ -435,3 +435,49 @@ class TestChaosMidBurst:
                     time.sleep(0.3)
         finally:
             ray_tpu.shutdown()
+
+    def test_socket_death_mid_put_burst_replays_every_put(self, tcp_head):
+        """Puts are the at-most-once EXCEPTION: a put id is minted exactly
+        once per op, so un-acked/unsent puts at a socket drop are REPLAYED
+        on the fresh conn (head dedupes replay-flagged redelivery) instead
+        of poisoned like tasks. Every ref must resolve to its VALUE — not
+        a retriable error — once the redial lands."""
+        ray_tpu.init(address=f"ray://{tcp_head}")
+        try:
+            from ray_tpu._private.node_agent import shutdown_conn
+            from ray_tpu._private.runtime import get_ctx
+
+            ctx = get_ctx()
+            refs = []
+
+            def burst():
+                for i in range(200):
+                    refs.append(ray_tpu.put({"i": i}))
+
+            t = threading.Thread(target=burst)
+            t.start()
+            while len(refs) < 25:  # let real windows get in flight first
+                time.sleep(0.001)
+            shutdown_conn(ctx.conn)  # violent drop, no goodbye
+            t.join(timeout=120)
+            assert not t.is_alive(), "putter wedged after socket death"
+            assert len(refs) == 200
+
+            deadline = time.monotonic() + 90
+            for i, ref in enumerate(refs):
+                while True:
+                    try:
+                        assert ray_tpu.get(ref, timeout=60) == {"i": i}
+                        break
+                    except rex.GetTimeoutError:
+                        pytest.fail(f"put {i} hung after mid-burst socket death")
+                    except rex.RayError as e:
+                        # transient send-into-dying-socket errors during the
+                        # redial window retry; a POISONED put would repeat
+                        # forever and trip the deadline — that's the failure
+                        # this test exists to catch
+                        if time.monotonic() > deadline:
+                            pytest.fail(f"put {i} never resolved to its value: {e}")
+                        time.sleep(0.2)
+        finally:
+            ray_tpu.shutdown()
