@@ -50,10 +50,12 @@ def bench_environment() -> dict:
     }
 
 
-def timeit(name: str, fn, unit: str = "per_s", warmup=True, windows: int = 3) -> dict:
+def timeit(name: str, fn, unit: str = "per_s", warmup=True, windows: int = 3,
+           extra: dict = None) -> dict:
     """Median of three measurement windows (like bench.py's TPU metric):
     single short windows on a shared VM swing ±40% with scheduler noise,
-    which round 3 initially misread as regressions."""
+    which round 3 initially misread as regressions.  ``extra`` merges
+    qualifier tags into the printed record (e.g. ``loopback: true``)."""
     if warmup:
         fn()
     rates = []
@@ -62,6 +64,8 @@ def timeit(name: str, fn, unit: str = "per_s", warmup=True, windows: int = 3) ->
         n = fn()
         rates.append(n / (time.perf_counter() - t0))
     rec = {"metric": name, "value": round(sorted(rates)[len(rates) // 2], 2), "unit": unit}
+    if extra:
+        rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -488,7 +492,19 @@ def data_plane_main() -> dict:
                 assert t
                 return n * nb / 1e6
 
-            results.append(timeit(f"remote_get_{name}", remote_get, unit="MB_per_s"))
+            # loopback, not a network benchmark: both "remote" agents live
+            # on this host, so remote_get MB/s measures the TCP data-plane
+            # software path (chunking, recv_bytes_into, dispatch) with no
+            # NIC in the loop — compare arms against each other, never
+            # against real cross-host bandwidth
+            results.append(timeit(
+                f"remote_get_{name}", remote_get, unit="MB_per_s",
+                extra={
+                    "loopback": True,
+                    "note": "agents share the bench host; software-path "
+                            "MB/s, not network bandwidth",
+                },
+            ))
 
         # locality fraction: unconstrained single-arg consumers should land
         # on the node already holding the bytes (acceptance bar: >= 0.9)

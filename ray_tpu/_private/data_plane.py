@@ -37,6 +37,14 @@ import time
 from multiprocessing.connection import Client, Listener
 from typing import Optional
 
+from ray_tpu._private import events
+
+#: flight-recorder events this module emits (raylint RL012 registry): the
+#: serving half of a cross-host pull (``role="serve"``; the consumer half
+#: is emitted by runtime._fetch_via_data_plane).
+EVENT_NAMES = ("core.object.p2p_pull",)
+
+
 def _chunk_bytes() -> int:
     from ray_tpu._private.config import GLOBAL_CONFIG
 
@@ -110,6 +118,12 @@ class DataServer:
                         conn.send_bytes(mv[off : off + n])
                         off += n
                     self.bytes_served += total
+                    events.emit(
+                        "core.object.p2p_pull",
+                        size=total,
+                        seg=loc.name,
+                        role="serve",
+                    )
                 finally:
                     reader.close()
         finally:

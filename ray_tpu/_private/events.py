@@ -191,6 +191,71 @@ def _new_ring() -> _Ring:
     return ring
 
 
+_current_request_id = None  # lazily bound tracing.current_request_id
+
+
+def active_request_id() -> Optional[str]:
+    """The tracing request id bound to this thread, or ``None``. The cheap
+    gate for per-read emits: ``core.object.map``/``unmap`` ride every
+    zero-copy get, so they fire only inside a traced request (mint-time
+    sampling alignment — the same deal spans get). An untraced bulk loop
+    pays one thread-local read here instead of a ring append per read."""
+    global _current_request_id
+    rid_fn = _current_request_id
+    if rid_fn is None:
+        from ray_tpu.util.tracing import current_request_id as rid_fn
+
+        _current_request_id = rid_fn
+    return rid_fn()
+
+
+def emit(
+    etype: str,
+    obj_id: Optional[bytes] = None,
+    size: Optional[int] = None,
+    node: Optional[bytes] = None,
+    request_id: Optional[str] = None,
+    **fields: Any,
+) -> None:
+    """Object-plane emit: :func:`record` plus the ``core.object.*`` field
+    conventions (ISSUE 19). ``obj_id``/``node`` accept the binary ids the
+    runtime carries and land hex-encoded as ``oid``/``node`` (an explicit
+    ``node`` field overrides this process's node in ``snapshot()`` — owner
+    provenance, not emitter provenance). When no ``request_id`` is passed
+    the active one is read from the tracing thread-local, so a request's
+    data-plane hops line up under ``obs req <id>`` next to its waterfall.
+
+    Hot path: same zero-lock budget as ``record``, and cheaper — the
+    raw (obj_id, size, node, extras) tuple goes into the ring as-is and
+    the hex encodes + field-dict build are deferred to :func:`snapshot`,
+    so the emitting thread pays only the append (PR 11's rule: cost is
+    paid when a consumer drains, not on the path)."""
+    if not _enabled:
+        return
+    if request_id is None:
+        global _current_request_id
+        rid_fn = _current_request_id
+        if rid_fn is None:
+            from ray_tpu.util.tracing import current_request_id as rid_fn
+
+            _current_request_id = rid_fn
+        request_id = rid_fn()
+    # record()'s ring append, inlined (delegating would repack **fields a
+    # second time); item[4] is a TUPLE here, not a dict — snapshot()
+    # expands it. Nothing else looks inside item[4]: the collector folds
+    # and configure() re-deques ring items opaquely.
+    ring = getattr(_tls, "ring", None)
+    if ring is None:
+        ring = _new_ring()
+    dq = ring.dq
+    if len(dq) == dq.maxlen:
+        ring.dropped += 1
+    dq.append(
+        (next(_seq), time.time(), etype, request_id,
+         (obj_id, size, node, fields or None))
+    )
+
+
 def _iter_raw() -> list[tuple]:
     """All events currently held (retired + live rings), merged into
     global emission order by seq. Lock-free: list() over a deque and
@@ -231,7 +296,20 @@ def snapshot(request_id: Optional[str] = None) -> list[dict]:
             ev["node"] = node
         if rid is not None:
             ev["request_id"] = rid
-        if fields:
+        if type(fields) is tuple:
+            # deferred emit() payload: (obj_id, size, node, extras) raw
+            # off the hot path — format here, on the consumer's dime
+            obj_id, size, onode, extras = fields
+            if obj_id is not None:
+                ev["oid"] = obj_id.hex() if isinstance(obj_id, bytes) else obj_id
+            if size is not None:
+                ev["size"] = size
+            if onode is not None:
+                # owner provenance overrides emitter provenance
+                ev["node"] = onode.hex() if isinstance(onode, bytes) else onode
+            if extras:
+                ev.update(extras)
+        elif fields:
             ev.update(fields)
         out.append(ev)
     return out

@@ -41,6 +41,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private import events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private import config as _cfg
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -59,6 +60,30 @@ METRIC_NAMES = (
     "core_submit_batch_size",
     "core_reply_batch_size",
     "core_sched_locality_hit_rate",
+    # object-plane ledger (ISSUE 19): per-node arena/spill residency, the
+    # leak-audit verdict, object lifetime distribution, and spill churn
+    "core_arena_used_bytes",
+    "core_arena_capacity_bytes",
+    "core_arena_pinned_bytes",
+    "core_arena_occupancy",
+    "core_spill_bytes",
+    "core_object_leaks",
+    "core_object_age_s",
+    "core_object_spills",
+)
+
+#: flight-recorder events this module emits (raylint RL012 registry) — the
+#: directory half of the ``core.object.*`` lifecycle family (ISSUE 19):
+#: a driver put landing in head shm, a locator entering the directory,
+#: spill/restore transitions, a backing reaped by loss handling, and a
+#: directory entry freed (forensic tail also kept in ``_freed_ring``).
+EVENT_NAMES = (
+    "core.object.put",
+    "core.object.locator",
+    "core.object.spill",
+    "core.object.restore",
+    "core.object.reap",
+    "core.object.free",
 )
 
 #: raylint RL017 registry — DELIBERATE lock-free shared state, verified by
@@ -146,6 +171,65 @@ def _locality_gauge():
     return _LOCALITY_GAUGE
 
 
+#: object age buckets: sub-minute churn through multi-hour residents
+_OBJECT_AGE_BOUNDARIES = (1, 5, 15, 60, 300, 900, 3600, 14400)
+_OBJECT_METRICS = None
+
+
+def _object_metrics() -> dict:
+    # no init lock needed: only ever touched under the head lock (health
+    # loop tick, spill path, ledger/audit RPCs)
+    global _OBJECT_METRICS
+    if _OBJECT_METRICS is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _OBJECT_METRICS = {
+            "arena_used": Gauge(
+                "core_arena_used_bytes",
+                "bytes allocated in a node's native object arena",
+                tag_keys=("node",),
+            ),
+            "arena_capacity": Gauge(
+                "core_arena_capacity_bytes",
+                "a node's native object arena capacity",
+                tag_keys=("node",),
+            ),
+            "arena_pinned": Gauge(
+                "core_arena_pinned_bytes",
+                "arena bytes currently pinned by live readers on a node",
+                tag_keys=("node",),
+            ),
+            "arena_occupancy": Gauge(
+                "core_arena_occupancy",
+                "worst-node arena used/capacity ratio (the arena-pressure "
+                "SLO gauge)",
+            ),
+            "spill_bytes": Gauge(
+                "core_spill_bytes",
+                "bytes of directory objects currently spilled to a node's "
+                "disk",
+                tag_keys=("node",),
+            ),
+            "leaks": Gauge(
+                "core_object_leaks",
+                "findings of the last object-plane leak audit (orphaned "
+                "arena bytes / stale pins / dangling locators / orphaned "
+                "spill files)",
+            ),
+            "age": Histogram(
+                "core_object_age_s",
+                "lifetime of directory objects at free/evict",
+                boundaries=_OBJECT_AGE_BOUNDARIES,
+            ),
+            "spills": Counter(
+                "core_object_spills",
+                "directory objects spilled to disk under arena pressure "
+                "(the spill-burn SLO counter)",
+            ),
+        }
+    return _OBJECT_METRICS
+
+
 # --------------------------------------------------------------------------
 # Object directory
 
@@ -154,6 +238,7 @@ class ObjectEntry:
     __slots__ = (
         "small", "shm", "is_error", "refcount", "pins", "size",
         "spill_path", "last_access", "last_read", "borrow_nonces", "lineage",
+        "created",
     )
 
     def __init__(self):
@@ -163,6 +248,7 @@ class ObjectEntry:
         self.refcount = 0  # driver-side ObjectRef count
         self.pins = 0  # pending-task dependency pins
         self.size = 0
+        self.created = time.time()  # wall time: ledger ages are user-facing
         self.spill_path: Optional[str] = None  # on-disk copy (spilled)
         self.last_access = 0.0
         self.last_read = 0.0  # read lease: guards just-handed-out locators
@@ -630,6 +716,11 @@ class Head:
             self.arena_name = _shm.create_arena(GLOBAL_CONFIG.object_store_arena_bytes)
 
         self.objects: dict[bytes, ObjectEntry] = {}
+        # forensic tail of the object ledger (ISSUE 19): the newest freed
+        # entries — (oid hex, size, age_s, freed wall time, reason) — so
+        # ``obs objects`` can show what JUST left the directory. Appended
+        # under the head lock; bounded.
+        self._freed_ring: deque = deque(maxlen=256)
         self.functions: dict[bytes, bytes] = {}  # func table (reference: GCS fn table)
         self.kv: dict[str, bytes] = {}
         # pubsub: channel -> sinks; a sink is ("conn", conn) for socket
@@ -1125,6 +1216,9 @@ class Head:
         elif kind == "events_result":
             # flight-recorder drain replies ride the same mailbox
             self._mailbox_post(msg[1]["req_id"], {msg[1]["pid"]: msg[1]["events"]})
+        elif kind == "object_report_result":
+            # object-plane residency replies (ledger/audit rendezvous)
+            self._mailbox_post(msg[1]["req_id"], {msg[1]["pid"]: msg[1]["report"]})
 
     def _mailbox_post(self, req_id: str, update: dict) -> None:
         """Merge a reply into the stacks/profile rendezvous mailbox. Bounded:
@@ -1918,6 +2012,13 @@ class Head:
             if not self._shutdown:
                 for oid, ent in list(self.objects.items()):
                     if ent.shm is not None and ent.shm.node == nid:
+                        events.emit(
+                            "core.object.reap",
+                            obj_id=oid,
+                            size=ent.size,
+                            node=nid,
+                            reason="node-removed",
+                        )
                         self._reconstruct(oid, ent)
             self._schedule()
             self.cv.notify_all()
@@ -2673,6 +2774,13 @@ class Head:
         else:
             ent.shm = payload
             ent.size = payload.total_size
+            events.emit(
+                "core.object.locator",
+                obj_id=obj_id,
+                size=payload.total_size,
+                node=payload.node,
+                seg=payload.name,
+            )
             if self._loc_is_local(payload):
                 # only head-host bytes count toward this host's spill
                 # watermark; agent-host objects live in THEIR arenas
@@ -2800,6 +2908,13 @@ class Head:
                             n.stats = stats
             except Exception as e:
                 warn_throttled("health loop: /proc stats refresh", e)
+            # object-plane residency gauges (ISSUE 19): this host's arena /
+            # spill bytes every tick; agent-node gauges refresh when a
+            # ledger/audit rendezvous actually gathers their reports
+            try:
+                self._publish_object_gauges()
+            except Exception as e:
+                warn_throttled("health loop: object-plane gauges", e)
             # restored detached actors whose old workers never reconnected:
             # past the grace window, re-create them fresh (reference:
             # gcs_actor_manager restart of registered actors on failover)
@@ -3332,6 +3447,12 @@ class Head:
 
             locator = ("shm", write_shm(sv), is_error)
             _data_counters()[0].inc(sv.total_size)
+            events.emit(
+                "core.object.put",
+                obj_id=obj_id,
+                size=sv.total_size,
+                seg=locator[1].name,
+            )
         with self.lock:
             # fresh put ids have no waiters (see rpc_put): skip the wakeup
             fresh = obj_id not in self.objects
@@ -3486,9 +3607,26 @@ class Head:
                     ent.refcount -= 1
                     self._maybe_evict(obj_id, ent)
 
+    def _note_freed(self, obj_id: bytes, ent: ObjectEntry, reason: str) -> None:
+        """Lock held. Forensic trail for an entry leaving the directory:
+        the ``core.object.free`` event, the lifetime histogram observation,
+        and the bounded freed ring ``obs objects`` shows."""
+        age = max(0.0, time.time() - ent.created)
+        _object_metrics()["age"].observe(age)
+        self._freed_ring.append(
+            (ObjectID(obj_id).hex(), ent.size, age, time.time(), reason)
+        )
+        events.emit(
+            "core.object.free",
+            obj_id=obj_id,
+            size=ent.size,
+            reason=reason,
+        )
+
     def _maybe_evict(self, obj_id: bytes, ent: ObjectEntry):
         if ent.refcount <= 0 and ent.pins <= 0 and ent.ready:
             self.objects.pop(obj_id, None)
+            self._note_freed(obj_id, ent, "refcount")
             if ent.shm is not None:
                 self._release_loc(ent.shm)
             if ent.spill_path is not None:
@@ -3555,6 +3693,10 @@ class Head:
                 f.write(data)
         except Exception:
             return  # spill is best-effort; the object stays in shm
+        events.emit(
+            "core.object.spill", obj_id=obj_id, size=ent.size, path=path
+        )
+        _object_metrics()["spills"].inc()
         self.shm_owner.unlink(ent.shm)
         ent.shm = None
         ent.spill_path = path
@@ -3578,6 +3720,12 @@ class Head:
         self._ensure_capacity(sv.total_size)
         ent.shm = write_shm(sv)
         self.shm_owner.register(ent.shm)
+        events.emit(
+            "core.object.restore",
+            obj_id=obj_id,
+            size=sv.total_size,
+            seg=ent.shm.name,
+        )
         try:
             os.unlink(ent.spill_path)
         except OSError:
@@ -3698,6 +3846,13 @@ class Head:
             for oid in lost:
                 ent = self.objects.get(oid)
                 if ent is not None and ent.shm is not None:
+                    events.emit(
+                        "core.object.reap",
+                        obj_id=oid,
+                        size=ent.size,
+                        node=ent.shm.node,
+                        reason="backing-lost",
+                    )
                     self._release_loc(ent.shm)
                     self._reconstruct(oid, ent)  # failure stores ObjectLostError
             self.cv.notify_all()
@@ -3720,6 +3875,13 @@ class Head:
                     continue
                 ent = self.objects.get(oid)
                 if ent is not None and ent.shm is not None:
+                    events.emit(
+                        "core.object.reap",
+                        obj_id=oid,
+                        size=ent.size,
+                        node=ent.shm.node,
+                        reason="owner-dropped",
+                    )
                     self._release_loc(ent.shm)
                     self._reconstruct(oid, ent)
             self.cv.notify_all()
@@ -3728,8 +3890,10 @@ class Head:
         with self.lock:
             for oid in obj_ids:
                 ent = self.objects.pop(oid, None)
-                if ent is not None and ent.shm is not None:
-                    self._release_loc(ent.shm)
+                if ent is not None:
+                    self._note_freed(oid, ent, "explicit-free")
+                    if ent.shm is not None:
+                        self._release_loc(ent.shm)
 
     # -------------------------------------------------------- task cancel
 
@@ -4626,6 +4790,329 @@ class Head:
         # the head process's own ring (the in-process driver's, usually)
         out.setdefault("head", {})[str(os.getpid())] = _ev.snapshot()
         return out
+
+    # ------------------------------------------------- object-plane ledger
+
+    @staticmethod
+    def _object_state(ent: ObjectEntry) -> str:
+        """A directory entry's position in the object state machine
+        (inline → arena/segment → spilled; ``poisoned`` lives client-side
+        and is folded into the ledger from worker reports)."""
+        if ent.shm is not None:
+            return "arena" if ent.shm.offset is not None else "segment"
+        if ent.spill_path is not None:
+            return "spilled"
+        if ent.small is not None:
+            return "inline"
+        return "pending"
+
+    def _node_object_stats(self) -> dict:
+        """Lock held. This host's object-plane residency: arena occupancy
+        (owner-registry bytes when no native arena), this process's live
+        pins, and directory bytes spilled to this host's disk."""
+        from ray_tpu._private import shm_store as _shm
+
+        spill = sum(
+            ent.size for ent in self.objects.values()
+            if ent.spill_path is not None
+        )
+        arena = _shm.attach_arena(self.arena_name) if self.arena_name else None
+        pins = _shm.pin_stats()
+        return {
+            "arena": self.arena_name,
+            "used": (
+                arena.used if arena is not None else self.shm_owner.bytes_used
+            ),
+            "capacity": (
+                arena.capacity if arena is not None else self._spill_threshold()
+            ),
+            "n_objects": (
+                arena.n_objects if arena is not None
+                else len(self.shm_owner.snapshot())
+            ),
+            "pinned_bytes": pins["pinned_bytes"],
+            "pins": pins["count"],
+            "oldest_pin_age_s": pins["oldest_age_s"],
+            "spill_bytes": spill,
+            "owner_bytes": self.shm_owner.bytes_used,
+        }
+
+    def _publish_object_gauges(self, node_stats: Optional[dict] = None) -> None:
+        """Publish the per-node residency gauges. ``node_stats`` maps a
+        node tag to a ``_node_object_stats``-shaped dict (agent nodes,
+        from a ledger/audit rendezvous); None = just this host, the
+        health-loop tick. The untagged occupancy gauge carries the WORST
+        node's used/capacity ratio so the arena-pressure SLO rule watches
+        cluster-wide pressure in one series."""
+        m = _object_metrics()
+        stats = dict(node_stats or {})
+        with self.lock:
+            stats["head"] = self._node_object_stats()
+        worst = 0.0
+        for tag, s in stats.items():
+            used = s.get("used") or 0
+            cap = s.get("capacity") or 0
+            m["arena_used"].set(used, tags={"node": tag})
+            m["arena_capacity"].set(cap, tags={"node": tag})
+            m["arena_pinned"].set(s.get("pinned_bytes") or 0, tags={"node": tag})
+            m["spill_bytes"].set(s.get("spill_bytes") or 0, tags={"node": tag})
+            if cap:
+                worst = max(worst, used / cap)
+        m["arena_occupancy"].set(worst)
+
+    def _gather_object_reports(self, timeout: float) -> dict:
+        """Cluster object-plane residency — ``{node_hex: {pid: report}}``:
+        every live worker's arena pins / locally-poisoned ids / arena
+        occupancy (``object_report`` rendezvous, same broadcast/mailbox as
+        stacks and events), plus this process's own report."""
+        from ray_tpu._private import runtime as _rt
+        from ray_tpu._private import shm_store as _shm
+
+        out: dict = {}
+        if timeout > 0:
+            out = self._broadcast_rendezvous(
+                "object_report", {}, time.monotonic() + timeout
+            )
+        report = _shm.pin_stats()
+        ctx = _rt._ctx  # the in-process driver, when this head is local
+        report["poisoned"] = [
+            oid.hex() for oid in list(getattr(ctx, "_poisoned", None) or {})
+        ]
+        arena = _shm.attach_arena(self.arena_name) if self.arena_name else None
+        if arena is not None:
+            report["arena"] = {
+                "name": arena.name,
+                "used": arena.used,
+                "capacity": arena.capacity,
+                "n_objects": arena.n_objects,
+            }
+        out.setdefault("head", {})[str(os.getpid())] = report
+        return out
+
+    @staticmethod
+    def _fold_node_reports(reports: dict) -> tuple[dict, list]:
+        """Fold per-pid object reports into per-node residency stats and
+        the cluster poisoned-ref list. Simulated local nodes share the
+        head host's arena, so their entries mirror its occupancy."""
+        node_stats: dict[str, dict] = {}
+        poisoned: list[dict] = []
+        for node_hex, pids in reports.items():
+            agg = {
+                "pinned_bytes": 0, "pins": 0,
+                "oldest_pin_age_s": 0.0, "spill_bytes": 0,
+            }
+            for pid, rep in pids.items():
+                if pid == "_errors" or not isinstance(rep, dict):
+                    continue
+                agg["pinned_bytes"] += rep.get("pinned_bytes") or 0
+                agg["pins"] += rep.get("count") or 0
+                agg["oldest_pin_age_s"] = max(
+                    agg["oldest_pin_age_s"], rep.get("oldest_age_s") or 0.0
+                )
+                for oh in rep.get("poisoned", ()):
+                    poisoned.append(
+                        {"object_id": oh, "state": "poisoned",
+                         "node": node_hex, "pid": pid}
+                    )
+                ar = rep.get("arena")
+                if ar:
+                    agg["arena"] = ar.get("name")
+                    agg["used"] = ar.get("used")
+                    agg["capacity"] = ar.get("capacity")
+                    agg["n_objects"] = ar.get("n_objects")
+            node_stats[node_hex] = agg
+        return node_stats, poisoned
+
+    def rpc_object_ledger(self, top_n: int = 20, node: Optional[str] = None,
+                          state: Optional[str] = None, timeout: float = 2.0):
+        """The object ledger (ISSUE 19): every directory entry's state,
+        owner node, size, ref/pin counts, and age; client-side poisoned
+        refs folded in from the ``object_report`` rendezvous; the freed
+        forensics tail; and per-node arena/spill residency. ``top_n``
+        bounds the object rows (largest first; 0 = all) AFTER the
+        ``node``/``state`` filters. Also refreshes the per-node residency
+        gauges with whatever the rendezvous gathered."""
+        reports = self._gather_object_reports(timeout)
+        folded, poisoned = self._fold_node_reports(reports)
+        now = time.time()
+        with self.lock:
+            rows = []
+            by_state: dict[str, int] = {}
+            total_bytes = 0
+            for oid, ent in self.objects.items():
+                st = self._object_state(ent)
+                by_state[st] = by_state.get(st, 0) + 1
+                total_bytes += ent.size
+                owner = (
+                    ent.shm.node.hex()
+                    if ent.shm is not None and ent.shm.node is not None
+                    else "head"
+                )
+                if node is not None and owner != node:
+                    continue
+                if state is not None and st != state:
+                    continue
+                rows.append({
+                    "object_id": ObjectID(oid).hex(),
+                    "state": st,
+                    "node": owner,
+                    "size": ent.size,
+                    "refcount": ent.refcount,
+                    "pins": ent.pins,
+                    "age_s": now - ent.created,
+                    "seg": ent.shm.name if ent.shm is not None else None,
+                    "spill_path": ent.spill_path,
+                    "is_error": ent.is_error,
+                })
+            freed = [
+                {"object_id": o, "size": s, "age_s": a,
+                 "freed_at": t, "reason": r}
+                for o, s, a, t, r in list(self._freed_ring)
+            ]
+            node_stats = {"head": self._node_object_stats()}
+        for tag, s in folded.items():
+            if tag == "head":
+                # the directory-side head stats are authoritative; keep
+                # only the worker-pin fold the head process can't see
+                node_stats["head"]["worker_pinned_bytes"] = s["pinned_bytes"]
+                continue
+            node_stats[tag] = s
+        rows.sort(key=lambda r: r["size"], reverse=True)
+        if top_n:
+            rows = rows[: int(top_n)]
+        try:
+            self._publish_object_gauges(
+                {t: s for t, s in node_stats.items()
+                 if t != "head" and s.get("capacity")}
+            )
+        except Exception as e:  # gauges must never fail the ledger read
+            warn_throttled("object ledger: gauge refresh", e)
+        return {
+            "objects": rows,
+            "poisoned": poisoned,
+            "freed": freed,
+            "summary": {
+                "objects": sum(by_state.values()),
+                "bytes": total_bytes,
+                "by_state": by_state,
+                "poisoned": len(poisoned),
+            },
+            "nodes": node_stats,
+        }
+
+    def rpc_object_audit(self, timeout: float = 2.0,
+                         pin_lease_s: Optional[float] = None):
+        """Cluster-wide leak audit (ISSUE 19; the core-plane analogue of
+        ``KVBlockPool.audit()``). Invariants checked, each violation a
+        finding with node/object provenance:
+
+        * every owner-registered allocation (arena block or dedicated
+          segment) is owned by a live directory locator — orphaned bytes
+          are what a producer SIGKILLed after its put landed leaves;
+        * every live LOCAL locator's backing is still owner-registered
+          (dangling locator: a free raced a hand-out);
+        * every spill file belongs to a spilled entry, and every spilled
+          entry's file exists;
+        * every arena pin (cluster-wide, from the rendezvous reports) is
+          younger than the read lease ``pin_lease_s`` (default env
+          ``RAY_TPU_PIN_LEASE_S``, 300s) — pinned-forever readers block
+          block reuse.
+
+        Publishes the verdict as the ``core_object_leaks`` gauge."""
+        if pin_lease_s is None:
+            try:
+                pin_lease_s = float(os.environ.get("RAY_TPU_PIN_LEASE_S", "300"))
+            except ValueError:
+                pin_lease_s = 300.0
+        reports = self._gather_object_reports(timeout)
+        findings: list[dict] = []
+        with self.lock:
+            owned = self.shm_owner.snapshot()
+            live: dict[tuple, str] = {}
+            spill_by_path: dict[str, str] = {}
+            for oid, ent in self.objects.items():
+                if ent.shm is not None and self._loc_is_local(ent.shm):
+                    live[(ent.shm.name, ent.shm.offset)] = ObjectID(oid).hex()
+                if ent.spill_path is not None:
+                    spill_by_path[ent.spill_path] = ObjectID(oid).hex()
+            for key, (size, _gen) in owned.items():
+                if key not in live:
+                    findings.append({
+                        "kind": "orphaned-bytes", "node": "head",
+                        "seg": key[0], "offset": key[1], "size": size,
+                    })
+            for key, oid_hex in live.items():
+                if key not in owned:
+                    findings.append({
+                        "kind": "dangling-locator", "node": "head",
+                        "object_id": oid_hex,
+                        "seg": key[0], "offset": key[1],
+                    })
+            spill_dir = os.path.join(
+                os.path.dirname(self.socket_path), "spill"
+            )
+            try:
+                names = os.listdir(spill_dir)
+            except OSError:
+                names = []
+            for fn in names:
+                path = os.path.join(spill_dir, fn)
+                if path not in spill_by_path:
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        size = 0
+                    findings.append({
+                        "kind": "orphaned-spill-file", "node": "head",
+                        "path": path, "size": size,
+                    })
+            for path, oid_hex in spill_by_path.items():
+                if not os.path.exists(path):
+                    findings.append({
+                        "kind": "missing-spill-file", "node": "head",
+                        "object_id": oid_hex, "path": path,
+                    })
+            checked = {
+                "objects": len(self.objects),
+                "owned_allocations": len(owned),
+                "spill_files": len(names),
+            }
+        pins_checked = 0
+        for node_hex, pids in reports.items():
+            for pid, rep in pids.items():
+                if pid == "_errors" or not isinstance(rep, dict):
+                    continue
+                for p in rep.get("pins", ()):
+                    pins_checked += 1
+                    if (p.get("age_s") or 0) > pin_lease_s:
+                        findings.append({
+                            "kind": "stale-pin", "node": node_hex,
+                            "pid": pid, "seg": p.get("seg"),
+                            "offset": p.get("offset"),
+                            "size": p.get("size"), "age_s": p.get("age_s"),
+                        })
+        checked["pins"] = pins_checked
+        _object_metrics()["leaks"].set(len(findings))
+        return {
+            "findings": findings,
+            "checked": checked,
+            "pin_lease_s": pin_lease_s,
+        }
+
+    def rpc_inject_orphan_for_tests(self, size: int = 4096) -> dict:
+        """TEST-ONLY leak injection (ISSUE 19 acceptance): lay real bytes
+        out in this host's store and register them with the owner ledger
+        WITHOUT a directory entry — what a producer SIGKILLed between its
+        put landing and any ref existing leaves behind. Returns the
+        provenance ``rpc_object_audit`` must then report."""
+        from ray_tpu._private.shm_store import write_shm
+
+        sv = ser.serialize(b"\x00" * max(1, int(size)))
+        loc = write_shm(sv)
+        with self.lock:
+            self.shm_owner.register(loc)
+        return {"seg": loc.name, "offset": loc.offset,
+                "size": loc.total_size, "node": "head"}
 
     def rpc_waterfall(self, recent: int = 0):
         """Task-hop waterfall summary (``obs waterfall`` / the ``obs top``

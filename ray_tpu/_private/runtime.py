@@ -21,6 +21,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private import events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -41,6 +42,16 @@ METRIC_NAMES = (
     "core_shm_get_bytes",
     "core_data_local_hits",
     "core_data_remote_pulls",
+)
+
+#: flight-recorder events this module emits (raylint RL012 registry) — the
+#: consumer/producer half of the ``core.object.*`` lifecycle family
+#: (ISSUE 19): a put entering the shm plane, a cross-host pull, and a ref
+#: poisoned by window loss (its get will raise a retriable error).
+EVENT_NAMES = (
+    "core.object.put",
+    "core.object.p2p_pull",
+    "core.object.poison",
 )
 
 #: Canonical lock order of the client-side submit plane (PR 14), outermost
@@ -528,8 +539,12 @@ class BaseContext:
                 err = self._poisoned.get(r.binary())
                 if err is not None:
                     # asking the head would hang forever: it may never have
-                    # seen this id (failed fire-and-forget submission)
-                    raise err
+                    # seen this id (failed fire-and-forget submission).
+                    # Raise a FRESH instance: raising the stored one would
+                    # attach a traceback whose frames pin this refs list,
+                    # so the entry (cleared by the ref's __del__) could
+                    # never drop — a poison-dict leak the audit would flag
+                    raise err.__class__(*err.args)
         deadline = None if timeout is None else time.monotonic() + timeout
         locators = self.call("get", obj_ids=[r.binary() for r in refs], timeout=timeout)
         out = []
@@ -645,6 +660,12 @@ class BaseContext:
                 )
             return False, None
         _data_counters()[3].inc()
+        events.emit(
+            "core.object.p2p_pull",
+            obj_id=obj_id,
+            size=payload.total_size,
+            node=payload.node,
+        )
         return True, data_plane.read_layout(mv, payload)
 
     def _materialize(self, obj_id: bytes, locator, _retry: bool = True,
@@ -1108,6 +1129,11 @@ class WorkerContext(BaseContext):
                             for rid in ids:
                                 if rid not in put_ids:
                                     self._poisoned[rid] = err
+                                    events.emit(
+                                        "core.object.poison",
+                                        obj_id=rid,
+                                        reason="submit-window-lost",
+                                    )
                             if puts:
                                 self._submit_buf = [
                                     ("put", {**s, "replay": True})
@@ -1189,6 +1215,11 @@ class WorkerContext(BaseContext):
             self._sent_hdrs.clear()
             for rid in doomed:
                 self._poisoned[rid] = err
+                # give-up sweeps (replay_puts=False) poison PUT ids too —
+                # the forensic trail test_zero_copy_plane reads back
+                events.emit(
+                    "core.object.poison", obj_id=rid, reason="conn-lost"
+                )
             self._set_credit_gauge()
             self._submit_cv.notify_all()
 
@@ -1301,6 +1332,14 @@ class WorkerContext(BaseContext):
     def put_serialized(self, sv, is_error=False, take_ref=False) -> bytes:
         obj_id = ObjectID.for_put().binary()
         kind, payload, err = self.store_value(sv, is_error)
+        if kind == "shm":
+            events.emit(
+                "core.object.put",
+                obj_id=obj_id,
+                size=payload.total_size,
+                node=payload.node,
+                seg=payload.name,
+            )
         small, shm = (payload, None) if kind == "inline" else (None, payload)
         req = {
             "obj_id": obj_id, "small": small, "shm": shm, "is_error": err,

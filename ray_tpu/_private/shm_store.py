@@ -23,17 +23,74 @@ control-plane socket inline, like the reference's in-process memory store
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import pickle
 import sys
 import threading
+import time
 import uuid
 from multiprocessing import resource_tracker, shared_memory
 from typing import Optional
 
+from ray_tpu._private import events
 from ray_tpu._private.serialization import SerializedValue
 
 _ALIGN = 64
+
+# flight-recorder events this module emits (raylint RL012 registry): a
+# consumer attaching to an object's bytes and releasing them again. Both
+# carry segment/offset provenance (this layer doesn't know object ids —
+# the put/locator events tie object id to segment).
+EVENT_NAMES = (
+    "core.object.map",
+    "core.object.unmap",
+)
+
+#: raylint RL017 registry — the pin ledger is written only via the two
+#: GIL-atomic helpers below (dict store / dict pop), so arena pin/unpin
+#: stays on the PR 11 zero-lock hot path:
+#:
+#: - _pins: token -> (segment, offset, size, ts); note_pin is a plain
+#:   dict store from the pinning thread, drop_pin a plain pop (either the
+#:   same thread or the GC finalizer thread — one writer per token, so no
+#:   read-modify-write race). pin_stats() reads an atomic list() copy.
+LOCKFREE = ("_pins: atomic",)
+
+# Process-local arena pin ledger: every live ``_PinnedBlock`` (= one
+# arena pin) registers here so the cluster leak audit can prove "every
+# pin is held by a live reader" and flag pinned-forever consumers by age
+# (``head.rpc_object_audit`` read-lease threshold). Token is a process
+# counter; store/pop are single GIL-atomic dict ops (no lock — __del__
+# may run from any thread).
+_pins: dict[int, tuple[str, int, int, float]] = {}
+_pin_ids = itertools.count(1)
+
+
+def note_pin(token: int, name: str, offset: int, size: int) -> None:
+    """Register a live arena pin (hot path: one atomic dict store)."""
+    _pins[token] = (name, offset, size, time.time())
+
+
+def drop_pin(token: int) -> None:
+    """Release a pin's ledger entry (hot path: one atomic dict pop)."""
+    _pins.pop(token, None)
+
+
+def pin_stats() -> dict:
+    """This process's live arena pins (leak-audit input): total pinned
+    bytes, count, and per-pin provenance with age. Lock-free snapshot."""
+    now = time.time()
+    rows = [
+        {"seg": name, "offset": off, "size": size, "age_s": now - ts}
+        for name, off, size, ts in list(_pins.values())
+    ]
+    return {
+        "pinned_bytes": sum(r["size"] for r in rows),
+        "count": len(rows),
+        "oldest_age_s": max((r["age_s"] for r in rows), default=0.0),
+        "pins": rows,
+    }
 
 
 def _align(n: int) -> int:
@@ -258,10 +315,14 @@ class _PinnedBlock:
     are safe without copying out.
     """
 
-    def __init__(self, arena, offset: int, size: int):
+    def __init__(self, arena, offset: int, size: int, rid=None):
         self._arena = arena  # also keeps the mapping alive until released
         self._offset = offset
+        self._size = size
+        self._rid = rid  # request that mapped us; unmap pairs with it
         self._mv = arena.view(offset, size)
+        self._token = next(_pin_ids)
+        note_pin(self._token, arena.name, offset, size)
 
     def __buffer__(self, flags):
         return self._mv
@@ -269,6 +330,18 @@ class _PinnedBlock:
     def __del__(self):
         try:
             self._arena.unpin(self._offset)
+        except Exception:  # noqa: BLE001 - interpreter-exit teardown
+            pass
+        try:
+            drop_pin(self._token)
+            if self._rid is not None:
+                events.emit(
+                    "core.object.unmap",
+                    size=self._size,
+                    seg=self._arena.name,
+                    offset=self._offset,
+                    request_id=self._rid,
+                )
         except Exception:  # noqa: BLE001 - interpreter-exit teardown
             pass
 
@@ -290,12 +363,26 @@ class ShmReader:
         self.loc = loc
         self.shm = None
         self._block = None
+        # map/unmap ride EVERY zero-copy read, so they fire only inside a
+        # traced request (mint-time sampling alignment, like spans) — the
+        # pin ledger below stays unconditional, so the leak audit never
+        # depends on this gate. Unmap reuses the rid captured here: the
+        # exporter's __del__ runs under whatever request GC interrupts.
+        rid = events.active_request_id()
         if loc.offset is not None:
             arena = attach_arena(loc.name)
             if arena is None or not arena.pin(loc.offset, loc.gen):
                 raise FileNotFoundError(f"arena object gone: {loc.name}+{loc.offset}")
+            if rid is not None:
+                events.emit(
+                    "core.object.map",
+                    size=loc.total_size,
+                    seg=loc.name,
+                    offset=loc.offset,
+                    request_id=rid,
+                )
             if sys.version_info >= (3, 12):
-                self._block = _PinnedBlock(arena, loc.offset, loc.total_size)
+                self._block = _PinnedBlock(arena, loc.offset, loc.total_size, rid)
             else:
                 # pre-PEP 688 interpreters can't export a buffer from a
                 # Python class, so views could not keep the pin alive —
@@ -305,9 +392,23 @@ class ShmReader:
                     self._block = bytes(arena.view(loc.offset, loc.total_size))
                 finally:
                     arena.unpin(loc.offset)
+                if rid is not None:
+                    events.emit(
+                        "core.object.unmap",
+                        size=loc.total_size,
+                        seg=loc.name,
+                        offset=loc.offset,
+                        request_id=rid,
+                    )
             return
+        self._rid = rid
         self.shm = shared_memory.SharedMemory(name=loc.name)
         _untrack(self.shm)
+        if rid is not None:
+            events.emit(
+                "core.object.map", size=loc.total_size, seg=loc.name,
+                request_id=rid,
+            )
         # If this reader is GC'd while deserialized values still hold views
         # into the mapping, SharedMemory.__del__ would raise BufferError as
         # an unraisable error (noisy at exit; pytest's unraisable capture
@@ -338,9 +439,15 @@ class ShmReader:
     def close(self):
         if self.shm is None:
             # drop our reference; the pin releases when the last value view
-            # over the block dies (PEP 688 exporter lifetime)
+            # over the block dies (PEP 688 exporter lifetime — the exporter
+            # emits the unmap event when it finally lets go)
             self._block = None
             return
+        if self._rid is not None:
+            events.emit(
+                "core.object.unmap", size=self.loc.total_size,
+                seg=self.loc.name, request_id=self._rid,
+            )
         try:
             self.shm.close()
         except BufferError:
@@ -388,6 +495,13 @@ class ShmOwner:
             if key not in self._objects:
                 self._objects[key] = (loc.total_size, loc.gen)
                 self.bytes_used += loc.total_size
+
+    def snapshot(self) -> dict:
+        """Atomic copy of the ledger — ``(name, offset) -> (size, gen)`` —
+        for the head's leak audit (every registered byte must be owned by
+        a live directory locator)."""
+        with self._lock:
+            return dict(self._objects)
 
     def unlink(self, loc: ShmLocation) -> None:
         key = (loc.name, loc.offset)

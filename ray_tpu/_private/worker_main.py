@@ -22,10 +22,16 @@ import traceback
 from typing import Optional
 
 from ray_tpu import exceptions as rex
+from ray_tpu._private import events
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.log_util import warn_throttled
 from ray_tpu._private.runtime import ObjectRef, WorkerContext, set_ctx
+
+#: flight-recorder events this module emits (raylint RL012 registry): a
+#: task result / stream item entering the shm object plane from this
+#: worker (the producer half of ``core.object.*`` for non-put objects).
+EVENT_NAMES = ("core.object.put",)
 
 #: raylint RL017 — the worker's recv/exec/cancel hand-off state is
 #: deliberately lock-free (':atomic' = every write is one GIL-atomic
@@ -366,6 +372,8 @@ def _recv_loop(conn, ctx: WorkerContext, state: WorkerState):
             _start_profile(ctx, msg[1])
         elif kind == "events_drain":
             _drain_events(ctx, msg[1])
+        elif kind == "object_report":
+            _object_report(ctx, msg[1])
         elif kind == "exit":
             state.running = False
             state.task_queue.put(None)
@@ -502,6 +510,44 @@ def _drain_events(ctx, req: dict) -> None:
     threading.Thread(target=_run, daemon=True, name="rt-events-drain").start()
 
 
+def _object_report(ctx, req: dict) -> None:
+    """Reply with this process's object-plane residency (head rendezvous:
+    ``rpc_object_ledger``/``rpc_object_audit``): live arena pins with
+    ages (leak-audit input — every pin must map to a live reader), ids
+    this context has poisoned locally, and the attached arena's
+    occupancy. Off the recv loop like the events drain."""
+
+    def _run():
+        from ray_tpu._private import shm_store
+
+        report: dict = {}
+        try:
+            report = shm_store.pin_stats()
+            report["poisoned"] = [
+                oid.hex() for oid in list(getattr(ctx, "_poisoned", {}))
+            ]
+            arena = shm_store._current_write_arena()
+            if arena is not None:
+                report["arena"] = {
+                    "name": arena.name,
+                    "used": arena.used,
+                    "capacity": arena.capacity,
+                    "n_objects": arena.n_objects,
+                }
+        except Exception as e:  # noqa: BLE001 — report is best-effort
+            report = {"error": repr(e)}
+        try:
+            ctx.send_raw(
+                ("object_report_result",
+                 {"req_id": req["req_id"], "pid": os.getpid(),
+                  "report": report})
+            )
+        except Exception:
+            pass  # head gone: nothing to report to
+
+    threading.Thread(target=_run, daemon=True, name="rt-object-report").start()
+
+
 def _handle_cancel(state: WorkerState, task_id: bytes):
     state.cancel_requested.add(task_id)
     atask = state.async_tasks.get(task_id)
@@ -635,7 +681,16 @@ def _store_results(state: WorkerState, spec: dict, value, is_error=False):
         # large results land in THIS host's shm and only the locator travels
         # (agent hosts serve the bytes peer-to-peer; see data_plane.py) —
         # remote processes without a local store fall back to inline
-        results.append((rid, state.ctx.store_value(sv, is_error)))
+        locator = state.ctx.store_value(sv, is_error)
+        if locator[0] == "shm":
+            events.emit(
+                "core.object.put",
+                obj_id=rid,
+                size=locator[1].total_size,
+                node=locator[1].node,
+                seg=locator[1].name,
+            )
+        results.append((rid, locator))
     return results
 
 
@@ -701,6 +756,13 @@ def _stream_results_inner(state: WorkerState, spec: dict, gen) -> None:
             err = rex.RayTaskError.from_exception(spec.get("name", "task"), e)
             break
         locator = state.ctx.store_value(sv)
+        if locator[0] == "shm":
+            events.emit(
+                "core.object.put",
+                size=locator[1].total_size,
+                node=locator[1].node,
+                seg=locator[1].name,
+            )
         with state.stream_cv:
             while (
                 idx - state.stream_acked.get(task_id, 0) >= cap

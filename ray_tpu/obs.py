@@ -48,6 +48,20 @@ Commands::
         cluster has data (the CI waterfall-probe job does exactly this
         and uploads the --json output).
 
+    python -m ray_tpu.obs objects --address HOST:PORT [--top 20] [--audit]
+        The object-plane ledger: every directory entry's state (inline /
+        arena / segment / spilled / poisoned), owner node, size, ref and
+        pin counts, and age, largest first, plus the freed-forensics
+        tail.  ``--audit`` runs the cluster-wide leak audit (orphaned
+        arena bytes, dangling locators, orphaned/missing spill files,
+        stale pins) and exits non-zero when it finds anything — CI runs
+        it after the chaos suite.
+
+    python -m ray_tpu.obs arena --address HOST:PORT
+        Per-node arena residency bars: occupancy against capacity with
+        the 90% degrade watermark marked, pinned bytes, live pin count
+        and oldest pin age, and bytes spilled to disk.
+
     python -m ray_tpu.obs export -o otlp.json --address HOST:PORT
         OTLP-JSON export of spans, flight-recorder events, and metric
         series (resourceSpans/resourceLogs/resourceMetrics in one file);
@@ -134,22 +148,38 @@ def _load_crash_files(events_dir: Optional[str]) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def _series_rate_text(merged: dict, name: str) -> str:
-    """Newest delta/dt of a cluster-merged counter series, or ``—`` when
-    fewer than 2 samples exist — a one-frame ``obs top`` must never fake a
-    rate out of a lifetime counter."""
+def _series_rate(merged: dict, name: str) -> Optional[float]:
+    """Newest delta/dt of a cluster-merged counter series (summed across
+    tagsets), or None when fewer than 2 samples exist — a one-frame
+    ``obs top`` must never fake a rate out of a lifetime counter."""
     from ray_tpu.util.metrics import latest_rate
 
     ent = merged.get(name)
     if not ent:
-        return "—"
+        return None
     rates = [
         r for r in (latest_rate(points) for points in ent["series"].values())
         if r is not None
     ]
     if not rates:
-        return "—"
-    return f"{sum(rates):.1f}"
+        return None
+    return sum(rates)
+
+
+def _series_rate_text(merged: dict, name: str) -> str:
+    rate = _series_rate(merged, name)
+    return "—" if rate is None else f"{rate:.1f}"
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TB"
 
 
 def _render_top() -> None:
@@ -183,6 +213,9 @@ def _render_top() -> None:
     batch_line = core_batch_top_row(metrics, histogram_percentiles())
     if batch_line:
         lines.append(batch_line)
+    dp_line = core_data_plane_top_row(metrics, series)
+    if dp_line:
+        lines.append(dp_line)
     if "llm_running_requests" in metrics:
         acc = gauge("llm_spec_acceptance_rate")
         # runtime retrace count (device_prof): nonzero after warmup means
@@ -250,6 +283,40 @@ def core_batch_top_row(metrics: dict, pcts: dict) -> Optional[str]:
         f"reply={hist('core_reply_batch_size')}"
         + (f" credits={int(credits)}" if credits is not None else "")
     )
+
+
+def core_data_plane_top_row(metrics: dict, series: dict) -> Optional[str]:
+    """The ``obs top`` data-plane row (ISSUE 19): shm put/get throughput
+    (rates from the drained time-series, same below-2-samples ``—``
+    contract as every other rate on the frame), the zero-copy locality
+    hit rate (lifetime local hits over all shm reads), and the worst
+    node's arena occupancy."""
+    if (
+        "core_shm_put_bytes" not in metrics
+        and "core_shm_get_bytes" not in metrics
+        and "core_arena_occupancy" not in metrics
+    ):
+        return None
+
+    def mbps(name: str) -> str:
+        rate = _series_rate(series, name)
+        return "—" if rate is None else f"{rate / (1 << 20):.1f}"
+
+    def total(name: str) -> float:
+        return sum(
+            v for v in metrics.get(name, {}).values()
+            if isinstance(v, (int, float))
+        )
+
+    parts = [f"put={mbps('core_shm_put_bytes')}MB/s",
+             f"get={mbps('core_shm_get_bytes')}MB/s"]
+    reads = total("core_data_local_hits") + total("core_data_remote_pulls")
+    if reads:
+        parts.append(f"local={total('core_data_local_hits') / reads:.0%}")
+    occ = _first_series(metrics.get("core_arena_occupancy", {}))
+    if occ is not None:
+        parts.append(f"arena={float(occ):.0%}")
+    return "data-plane: " + " ".join(parts)
 
 
 def waterfall_top_row(summary: dict) -> str:
@@ -862,6 +929,170 @@ def cmd_timeline(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# objects / arena: the object-plane flight deck (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def render_objects(ledger: dict, sort: str = "size", top: int = 0) -> str:
+    """The ``obs objects`` table: directory rows (already size-sorted by
+    the head; re-sorted here for ``--sort age``), the poisoned refs folded
+    from worker reports, the freed-forensics tail, and the summary."""
+    rows = list(ledger.get("objects", ()))
+    if sort == "age":
+        rows.sort(key=lambda r: r.get("age_s") or 0.0, reverse=True)
+    if top:
+        rows = rows[:top]
+    s = ledger.get("summary", {})
+    by_state = s.get("by_state") or {}
+    lines = [
+        f"object ledger: {s.get('objects', 0)} objects, "
+        f"{_fmt_bytes(s.get('bytes', 0))}  "
+        + " ".join(f"{k}={v}" for k, v in sorted(by_state.items())),
+        f"{'OBJECT':<18} {'STATE':<9} {'NODE':<10} {'SIZE':>9} "
+        f"{'REFS':>5} {'PINS':>5} {'AGE':>8}  LOCATION",
+    ]
+    for r in rows:
+        loc = r.get("spill_path") or r.get("seg") or "-"
+        flag = " !err" if r.get("is_error") else ""
+        lines.append(
+            f"{r['object_id'][:16]:<18} {r['state']:<9} "
+            f"{str(r['node'])[:10]:<10} {_fmt_bytes(r['size']):>9} "
+            f"{r.get('refcount', 0):>5} {r.get('pins', 0):>5} "
+            f"{r.get('age_s', 0.0):>7.1f}s  {loc}{flag}"
+        )
+    if not rows:
+        lines.append("(no live objects match)")
+    for p in ledger.get("poisoned", ()):
+        lines.append(
+            f"{p['object_id'][:16]:<18} {'poisoned':<9} "
+            f"{str(p.get('node', '-'))[:10]:<10} {'-':>9} {'-':>5} {'-':>5} "
+            f"{'-':>8}  pid={p.get('pid')}"
+        )
+    freed = ledger.get("freed") or []
+    if freed:
+        lines.append(f"recently freed ({len(freed)}):")
+        for f in freed[-5:]:
+            lines.append(
+                f"  {f['object_id'][:16]} {_fmt_bytes(f['size'])} "
+                f"lived {f['age_s']:.1f}s ({f['reason']})"
+            )
+    return "\n".join(lines)
+
+
+def render_audit(audit: dict) -> str:
+    """The ``obs objects --audit`` leak report: one line per finding with
+    node/object provenance, or the clean bill with coverage counts."""
+    checked = audit.get("checked", {})
+    coverage = (
+        f"checked {checked.get('objects', 0)} objects, "
+        f"{checked.get('owned_allocations', 0)} allocations, "
+        f"{checked.get('spill_files', 0)} spill files, "
+        f"{checked.get('pins', 0)} pins "
+        f"(pin lease {audit.get('pin_lease_s', 0):.0f}s)"
+    )
+    findings = audit.get("findings") or []
+    if not findings:
+        return f"object-plane audit: no leaks — {coverage}"
+    lines = [f"object-plane audit: {len(findings)} finding(s) — {coverage}"]
+    for f in findings:
+        detail = " ".join(
+            f"{k}={v}" for k, v in f.items() if k != "kind" and v is not None
+        )
+        lines.append(f"  LEAK {f['kind']}: {detail}")
+    return "\n".join(lines)
+
+
+def cmd_objects(args) -> int:
+    from ray_tpu._private.runtime import get_ctx
+
+    ray_tpu = _attach(args.address)
+    try:
+        ctx = get_ctx()
+        # --sort age needs every row (the head's top-N cut is size-order)
+        server_top = 0 if args.sort == "age" else args.top
+        ledger = ctx.call(
+            "object_ledger", top_n=server_top, node=args.node,
+            state=args.state, timeout=args.timeout,
+        )
+        audit = (
+            ctx.call("object_audit", timeout=args.timeout)
+            if args.audit else None
+        )
+        doc = {"ledger": ledger}
+        if audit is not None:
+            doc["audit"] = audit
+        if args.output:
+            with open(args.output, "w") as fh:
+                json.dump(doc, fh, default=repr)
+        if args.json:
+            print(json.dumps(doc, default=repr))
+        else:
+            print(render_objects(ledger, sort=args.sort, top=args.top))
+            if audit is not None:
+                print()
+                print(render_audit(audit))
+        return 1 if (audit is not None and audit.get("findings")) else 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def _bar(frac: float, width: int = 30, mark: float = 0.9) -> str:
+    """Occupancy bar with the degrade watermark marked: ``####..|...``."""
+    frac = max(0.0, min(1.0, frac))
+    fill = int(frac * width)
+    cells = ["#" if i < fill else "." for i in range(width)]
+    m = int(mark * width)
+    if 0 <= m < width and cells[m] == ".":
+        cells[m] = "|"
+    return "".join(cells)
+
+
+def render_arena(nodes: dict) -> str:
+    """The ``obs arena`` per-node residency view: occupancy against
+    capacity (watermark at the 90% degrade threshold data_plane puts
+    honor), pinned bytes/count, oldest pin age, and spilled bytes."""
+    if not nodes:
+        return "no object-plane residency reported"
+    lines = []
+    for tag in sorted(nodes):
+        s = nodes[tag] or {}
+        used = s.get("used") or 0
+        cap = s.get("capacity") or 0
+        frac = (used / cap) if cap else 0.0
+        pin_age = s.get("oldest_pin_age_s") or 0.0
+        lines.append(
+            f"{str(tag)[:12]:<12} [{_bar(frac)}] {frac:>4.0%} "
+            f"{_fmt_bytes(used)}/{_fmt_bytes(cap)}  "
+            f"pinned={_fmt_bytes(s.get('pinned_bytes') or 0)}"
+            f"({s.get('pins') or 0})"
+            + (f" oldest-pin={pin_age:.0f}s" if pin_age else "")
+            + (
+                f" spilled={_fmt_bytes(s['spill_bytes'])}"
+                if s.get("spill_bytes") else ""
+            )
+        )
+    return "\n".join(lines)
+
+
+def cmd_arena(args) -> int:
+    from ray_tpu._private.runtime import get_ctx
+
+    ray_tpu = _attach(args.address)
+    try:
+        ledger = get_ctx().call(
+            "object_ledger", top_n=1, timeout=args.timeout
+        )
+        nodes = ledger.get("nodes", {})
+        if args.json:
+            print(json.dumps(nodes, default=repr))
+        else:
+            print(render_arena(nodes))
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None) -> int:
@@ -930,6 +1161,34 @@ def main(argv=None) -> int:
     p.add_argument("-n", type=int, default=200_000, help="iterations per probe")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_overhead)
+
+    p = sub.add_parser(
+        "objects",
+        help="object-plane ledger: states, sizes, ages; --audit hunts leaks",
+    )
+    p.add_argument("--top", type=int, default=20,
+                   help="row cap after filters (0 = all)")
+    p.add_argument("--sort", choices=("size", "age"), default="size")
+    p.add_argument("--node", default=None, help="owner-node hex filter")
+    p.add_argument("--state", default=None,
+                   help="state filter (inline/arena/segment/spilled/poisoned)")
+    p.add_argument("--audit", action="store_true",
+                   help="run the cluster leak audit; exit non-zero on findings")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="worker report rendezvous deadline seconds")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("-o", "--output", default=None,
+                   help="also write the ledger (+audit) JSON to a file")
+    p.set_defaults(fn=cmd_objects)
+
+    p = sub.add_parser(
+        "arena",
+        help="per-node arena occupancy/watermark/pin bars",
+    )
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="worker report rendezvous deadline seconds")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_arena)
 
     p = sub.add_parser(
         "export", help="OTLP-JSON export of spans + events + metric series"

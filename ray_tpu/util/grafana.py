@@ -204,6 +204,51 @@ def _data_plane_panels() -> list:
     ]
 
 
+def _objects_panels() -> list:
+    """Object-ledger row (ISSUE 19), DERIVED from the head's object-plane
+    metric family (``_private.head.METRIC_NAMES`` — tests cross-check this
+    row against the registry): per-node arena residency, pin pressure,
+    spill churn, object lifetimes, and the standing leak-audit verdict."""
+    return [
+        ("Arena used by node",
+         'ray_tpu_core_arena_used_bytes{{node=~".+"}}', "bytes",
+         "Bytes allocated in each node's native object arena "
+         "(core_arena_used_bytes) — plot against "
+         "ray_tpu_core_arena_capacity_bytes; the worst ratio drives the "
+         "arena-pressure SLO rule."),
+        ("Arena pinned by node",
+         'ray_tpu_core_arena_pinned_bytes{{node=~".+"}}', "bytes",
+         "Arena bytes held by live reader pins per node "
+         "(core_arena_pinned_bytes) — pinned bytes can't be recycled; "
+         "obs objects --audit flags pins older than the read lease."),
+        ("Arena occupancy (worst node)",
+         "ray_tpu_core_arena_occupancy", "percentunit",
+         "Worst-node used/capacity ratio (core_arena_occupancy) — the "
+         "arena-pressure SLO gauge."),
+        ("Spilled bytes by node",
+         'ray_tpu_core_spill_bytes{{node=~".+"}}', "bytes",
+         "Directory objects currently spilled to each node's disk "
+         "(core_spill_bytes)."),
+        ("Object spills/s",
+         "rate(ray_tpu_core_object_spills[1m])", "short",
+         "Directory objects spilled under arena pressure "
+         "(core_object_spills) — any sustained rate fires the spill-burn "
+         "SLO rule."),
+        ("Object lifetime p99",
+         'histogram_quantile(0.99, rate(ray_tpu_core_object_age_s_bucket[5m]))',
+         "s",
+         "Object age at free/evict (core_object_age_s) — a growing tail "
+         "means refs are outliving their usefulness and holding arena "
+         "bytes."),
+        ("Object-plane leaks",
+         "ray_tpu_core_object_leaks", "short",
+         "Findings of the last leak audit (core_object_leaks; obs objects "
+         "--audit / rpc_object_audit) — anything non-zero deserves a "
+         "look: orphaned arena bytes, stale pins, dangling locators, or "
+         "orphaned spill files."),
+    ]
+
+
 def _slo_panels() -> list:
     """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
     panels interpolate the same threshold/objective/window the head's alert
@@ -313,7 +358,8 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     pid = 0
     for title, expr, unit, desc in (_CORE_PANELS + _LLM_PANELS
                                     + _prefix_panels() + _profiling_panels()
-                                    + _data_plane_panels() + _slo_panels()):
+                                    + _data_plane_panels() + _objects_panels()
+                                    + _slo_panels()):
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:

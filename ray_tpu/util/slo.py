@@ -299,4 +299,42 @@ def default_rules() -> list[SLORule]:
                         "work pending — streams are frozen; the watchdog's "
                         "llm.watchdog.stall event carries the diagnosis.",
         ),
+        SLORule(
+            name="arena-pressure",
+            metric="core_arena_occupancy",
+            kind="gauge_threshold",
+            # the head publishes the WORST node's arena used/capacity
+            # ratio (ISSUE 19 object ledger); sustained occupancy above
+            # the bound means puts are about to degrade to the inline
+            # path (agents) or start spilling (head) — check obs arena
+            # for the node and obs objects for what holds the bytes
+            threshold=_envf("RAY_TPU_SLO_ARENA_OCCUPANCY", 0.9),
+            for_s=_envf("RAY_TPU_SLO_ARENA_FOR_S", 30.0),
+            resolve_after_s=resolve,
+            labels={"severity": "warn"},
+            description="A node's object arena is sustained at/above the "
+                        "occupancy bound — zero-copy puts are about to "
+                        "degrade (agent inline fallback / head spilling).",
+        ),
+        SLORule(
+            name="spill-burn",
+            metric="core_object_spills",
+            kind="counter_burn",
+            # EVERY spill is a bad event (bad_tags None selects all
+            # series): each one is a full serialize-to-disk round trip
+            # plus a restore on next access, so a sustained window rate
+            # burns the whole budget while the thrash is live; zero
+            # spills is the steady state and evaluates as no-evidence
+            objective=_envf("RAY_TPU_SLO_SPILL_OBJECTIVE", 0.99),
+            fast_window_s=fast,
+            slow_window_s=slow,
+            fast_burn=_envf("RAY_TPU_SLO_FAST_BURN", 14.4),
+            slow_burn=_envf("RAY_TPU_SLO_SLOW_BURN", 6.0),
+            resolve_after_s=resolve,
+            labels={"severity": "warn"},
+            description="The head is spilling directory objects to disk "
+                        "under arena pressure — the working set no longer "
+                        "fits; every get of a spilled object pays a "
+                        "restore round trip.",
+        ),
     ]

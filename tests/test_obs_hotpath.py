@@ -64,6 +64,14 @@ HOT_PATHS = (
     ("ray_tpu/util/waterfall.py", "ray_tpu.util.waterfall", "stamp"),
     ("ray_tpu/util/device_prof.py", "ray_tpu.util.device_prof",
      "JitProfiler.note"),
+    # object-plane flight deck (ISSUE 19): the core.object.* emit helper
+    # rides every put/map/unmap/pull on the data plane, and the reader
+    # pin ledger notes/drops a pin per zero-copy read — all lock-free
+    ("ray_tpu/_private/events.py", "ray_tpu._private.events", "emit"),
+    ("ray_tpu/_private/shm_store.py", "ray_tpu._private.shm_store",
+     "note_pin"),
+    ("ray_tpu/_private/shm_store.py", "ray_tpu._private.shm_store",
+     "drop_pin"),
 )
 
 

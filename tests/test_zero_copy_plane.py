@@ -523,3 +523,148 @@ def test_pipelined_put_then_immediate_use_as_arg(ray_start_regular):
 
     expect = int((np.arange(32 * 1024, dtype=np.int64) * 2).sum())
     assert ray_tpu.get(chain.remote(), timeout=60) == expect
+
+
+# ---------------------------------------------------------------------------
+# object-plane flight deck: poison forensics, ledger, leak audit (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_giveup_swept_put_leaves_poison_forensics(p2p_cluster):
+    """A pipelined put caught in the give-up sweep (reconnect failed /
+    context closing) must leave a forensic trail: a ``core.object.poison``
+    event on this process's flight-recorder ring, a retriable error on
+    the ref's get, and a ``_poisoned`` entry that lives exactly as long
+    as the ref — dropping the last handle drops the entry."""
+    from ray_tpu._private import events
+    from ray_tpu._private.runtime import ObjectID, ObjectRef
+
+    ray_tpu.init(address=p2p_cluster["address"])
+    ctx = get_ctx()
+    oid = ObjectID.for_put().binary()
+    # a buffered fire-and-forget put that never reached any connection
+    with ctx._submit_cv:
+        ctx._submit_buf.append(("put", {
+            "obj_id": oid, "small": b"\x01", "shm": None, "is_error": False,
+            "take_ref": True, "return_ids": [oid],
+        }))
+    ctx._fail_submits(replay_puts=False)  # the give-up sweep
+
+    evs = [
+        e for e in events.snapshot()
+        if e["type"] == "core.object.poison" and e.get("oid") == oid.hex()
+    ]
+    assert evs, "give-up sweep emitted no core.object.poison event"
+    assert evs[-1]["reason"] == "conn-lost"
+
+    ref = ObjectRef(oid, owned=True)
+    # plain try/except, not pytest.raises: the raised error IS the
+    # _poisoned entry, and excinfo would pin its traceback (whose frames
+    # reference the ref) past the del below
+    try:
+        ray_tpu.get(ref, timeout=5)
+    except rex.RayError:
+        pass
+    else:
+        pytest.fail("get on a poisoned ref did not raise")
+    assert oid in ctx._poisoned
+    del ref
+    gc.collect()
+    assert oid not in ctx._poisoned, "ref drop must clear the poison entry"
+
+
+def test_poisoned_ref_folds_into_ledger_until_drop(ray_start_regular):
+    """The ledger shows a client-side poisoned ref as state ``poisoned``
+    (worker/driver reports folded in) until the ref drops."""
+    from ray_tpu._private.runtime import ObjectID
+
+    ctx = get_ctx()
+    oid = ObjectID.for_put().binary()
+    ctx._poisoned[oid] = rex.RayError("submit window lost")
+    try:
+        led = ctx.call("object_ledger", timeout=0.0)
+        mine = [p for p in led["poisoned"] if p["object_id"] == oid.hex()]
+        assert mine and mine[0]["state"] == "poisoned"
+        assert mine[0]["node"] == "head"
+        assert led["summary"]["poisoned"] >= 1
+    finally:
+        ctx._poisoned.pop(oid, None)
+    led = ctx.call("object_ledger", timeout=0.0)
+    assert not [p for p in led["poisoned"] if p["object_id"] == oid.hex()]
+
+
+def test_object_ledger_states_and_freed_tail(ray_start_regular):
+    """Directory rows carry state/node/size/age; a freed object lands in
+    the forensics tail with its lifetime and reason."""
+    ctx = get_ctx()
+    blob = np.ones(64 * 1024, np.uint8)  # shm band
+    ref = ray_tpu.put(blob)
+    small = ray_tpu.put(b"tiny")  # inline band
+    led = ctx.call("object_ledger", timeout=0.0)
+    by_id = {r["object_id"]: r for r in led["objects"]}
+    row = by_id[ref.binary().hex()]
+    assert row["state"] in ("arena", "segment")
+    assert row["size"] >= blob.nbytes
+    assert row["age_s"] >= 0.0 and row["seg"]
+    assert by_id[small.binary().hex()]["state"] == "inline"
+    assert led["summary"]["by_state"].get("inline", 0) >= 1
+    assert "head" in led["nodes"]
+    assert led["nodes"]["head"]["capacity"] > 0
+
+    freed_hex = ref.binary().hex()
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        led = ctx.call("object_ledger", timeout=0.0)
+        hits = [f for f in led["freed"] if f["object_id"] == freed_hex]
+        if hits:
+            assert hits[0]["reason"] == "refcount"
+            assert hits[0]["size"] >= blob.nbytes
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("freed object never reached the forensics tail")
+
+
+def test_audit_clean_after_sigkill_chaos_then_detects_injected_orphan(
+    p2p_cluster,
+):
+    """The acceptance invariant: after producer-SIGKILL chaos the audit
+    reports ZERO leaks — every owner-registered byte has a live locator,
+    every spill file a spilled entry — and a deliberately injected
+    orphan (the test-only hook registers real bytes with no directory
+    entry, exactly what a producer SIGKILLed after its put landed
+    leaves) is detected with node + object provenance."""
+    ray_tpu.init(address=p2p_cluster["address"])
+    ctx = get_ctx()
+
+    @ray_tpu.remote(resources={"nodeA": 1.0})
+    def produce():
+        ref = ray_tpu.put(np.full(32 * 1024, 7, dtype=np.int64))
+        return os.getpid(), ref
+
+    pid, ref = ray_tpu.get(produce.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)  # producer dies, its arena blocks live on
+    time.sleep(0.5)
+    out = ray_tpu.get(ref, timeout=60)
+    assert (out[::1024] == 7).all()
+    # head-side churn too: locators the head itself lays out and frees
+    churn = [ray_tpu.put(np.ones(200 * 1024, np.uint8)) for _ in range(3)]
+    for r in churn:
+        assert ray_tpu.get(r, timeout=30).nbytes == 200 * 1024
+
+    audit = ctx.call("object_audit", timeout=1.0)
+    assert audit["findings"] == [], audit["findings"]
+    assert audit["checked"]["objects"] >= 2
+
+    inj = ctx.call("inject_orphan_for_tests", size=8192)
+    audit = ctx.call("object_audit", timeout=1.0)
+    orphans = [
+        f for f in audit["findings"]
+        if f["kind"] == "orphaned-bytes"
+        and f["seg"] == inj["seg"] and f["offset"] == inj["offset"]
+    ]
+    assert orphans, f"injected orphan not reported: {audit['findings']}"
+    assert orphans[0]["node"] == "head"
+    assert orphans[0]["size"] == inj["size"]
