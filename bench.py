@@ -95,6 +95,7 @@ def main():
     sections: dict = {}
     core = {}
     llm = {}
+    phases_ab = {}
     prefix = {}
     fit = {}
     train = {}
@@ -105,6 +106,7 @@ def main():
         core = _section(sections, "core_microbench", _core_microbench) or {}
         core_obs = _section(sections, "core_obs_ab", _core_obs_ab) or {}
         llm = _section(sections, "llm_serving", _llm_serving_bench) or {}
+        phases_ab = _section(sections, "llm_phases_ab", _llm_phases_ab) or {}
         prefix = _section(sections, "llm_prefix", _llm_prefix_bench) or {}
         fit = _section(sections, "gptj_fit_proof", _gptj_fit_proof) or {}
         train = _section(sections, "train_headline", _train_headline) or {}
@@ -129,6 +131,10 @@ def main():
             # decode under staggered arrivals + speculative-decode
             # comparison (ray_tpu/llm/bench.py)
             detail["llm_serving"] = llm
+        if phases_ab:
+            # per-request phase-ledger stamping ON vs OFF on the engine hot
+            # loops — the attribution plane's overhead acquittal (≤5%)
+            detail["llm_phases_ab"] = phases_ab
         if prefix:
             # cross-request prefix cache on the shared-system-prompt
             # workload: prefill-tokens-computed + warm TTFT, on vs off
@@ -420,6 +426,50 @@ def _llm_serving_bench() -> dict:
         return {}
     except Exception as e:
         print(f"[bench] llm serving bench failed: {e!r}", file=sys.stderr)
+        return {}
+
+
+def _llm_phases_ab() -> dict:
+    """Phase-ledger stamping ON vs OFF on the continuous-batching engine
+    (``python -m ray_tpu.llm.bench --only continuous``), same honest-A/B
+    shape as ``_core_obs_ab``: ``RAY_TPU_PHASES`` is import-time, so each
+    arm is a fresh CPU-only subprocess.  The per-request ledger rides the
+    engine's admission/prefill/decode hot loops — a ratio ≈ 1.0 says the
+    stamping (a list add + two float ops per transition, zero locks)
+    stays within noise; the acceptance bar is OFF/ON ≤ 1.05."""
+    import os
+    import subprocess
+    import sys
+
+    def one_arm(phases_on: bool) -> float:
+        env = dict(
+            os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+            RAY_TPU_PHASES="1" if phases_on else "0",
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.llm.bench", "--only", "continuous"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                rec = json.loads(line)
+                if rec.get("metric") == "llm_continuous_batching_tokens_per_sec":
+                    return float(rec["value"])
+        raise RuntimeError(
+            f"no continuous record (rc={out.returncode}): {out.stderr[-300:]}"
+        )
+
+    try:
+        on = one_arm(True)
+        off = one_arm(False)
+        return {
+            "phases_on_tokens_per_sec": on,
+            "phases_off_tokens_per_sec": off,
+            "on_over_off_ratio": round(on / off, 4) if off else None,
+        }
+    except Exception as e:
+        print(f"[bench] llm phases A/B failed: {e!r}", file=sys.stderr)
         return {}
 
 

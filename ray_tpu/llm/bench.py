@@ -30,6 +30,12 @@
   hardware underneath — expect < 1x); on real TPUs the same pairing
   measures the multi-chip speedup.  The ``MULTICHIP_r0x`` CI artifact
   records these numbers.
+* ``llm_loadgen_healthy_p99_s`` (``--only loadgen``) — the open-loop
+  load harness (``llm.loadgen``): boots a served app and drives the
+  three standard arms (healthy / overload / replica-kill), reporting the
+  healthy-arm client p99 with the full per-phase attribution report in
+  ``detail``.  Excluded from ``--only all`` — it boots a serve cluster
+  and belongs to its own CI job (``loadgen-smoke``).
 
 Sized to run on CPU in seconds (the same comparison holds on TPU with
 the real model; the ratio is what travels).  ``--smoke`` shrinks the
@@ -458,6 +464,27 @@ def run_multichip_bench(smoke: bool = False) -> dict:
     }
 
 
+def run_loadgen_bench(smoke: bool = False) -> dict:
+    """Open-loop load harness over the served HTTP path (``llm.loadgen``):
+    healthy / overload / replica-kill arms against a tiny 2-replica app,
+    client-side percentiles joined with the server-side phase ledgers.
+    The headline is the healthy-arm p99; ``vs_baseline`` carries the
+    phase-sum identity fraction (1.0 = every attributed request's phases
+    sum to its end-to-end latency within ε)."""
+    from ray_tpu.llm import loadgen
+
+    report = loadgen.run_report(smoke=smoke)
+    healthy = report["arms"]["healthy"]["client"]
+    ident = report["identity"]
+    return {
+        "metric": "llm_loadgen_healthy_p99_s",
+        "value": healthy["e2e_s"].get("p99") or 0.0,
+        "unit": "s",
+        "vs_baseline": ident["within_eps_frac"] or 0.0,
+        "detail": report,
+    }
+
+
 def main(argv=None) -> list:
     import argparse
     import os
@@ -469,7 +496,8 @@ def main(argv=None) -> list:
     )
     ap.add_argument(
         "--only",
-        choices=("all", "serving", "continuous", "spec", "prefix", "multichip"),
+        choices=("all", "serving", "continuous", "spec", "prefix",
+                 "multichip", "loadgen"),
         default="all",
         help="run a subset instead of the full set (bench.py's llm_serving "
         "section uses --only serving, its llm_prefix section --only prefix "
@@ -482,9 +510,11 @@ def main(argv=None) -> list:
         "spec": lambda: run_spec_bench(smoke=args.smoke),
         "prefix": lambda: run_prefix_bench(smoke=args.smoke),
         "multichip": lambda: run_multichip_bench(smoke=args.smoke),
+        "loadgen": lambda: run_loadgen_bench(smoke=args.smoke),
     }
     groups = {
-        "all": list(benches),
+        # loadgen boots a whole serve cluster — it runs only when asked
+        "all": [n for n in benches if n != "loadgen"],
         "serving": ["continuous", "spec"],
     }
     names = groups.get(args.only, [args.only])

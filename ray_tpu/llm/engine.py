@@ -46,6 +46,8 @@ import numpy as np
 from ray_tpu._private import events as _events
 from ray_tpu.llm.cache import CacheConfig, KVBlockPool
 from ray_tpu.llm.model_runner import PagedModelRunner, _sample_rows
+from ray_tpu.util import phases as _phases
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.llm.scheduler import (
     FINISH_CANCELLED,
     FINISH_DEADLINE,
@@ -464,6 +466,10 @@ class LLMEngine:
             )
         deadline = time.time() + deadline_s if deadline_s is not None else None
         req = Request(prompt, params, deadline=deadline, resume_tokens=resume_tokens)
+        if req.phase_led is not None:
+            # cross-process dispatch leg: the proxy's stream thread stamped
+            # its dispatch anchor into the sampled trace-ctx dict it minted
+            _phases.note_dispatch(req, _tracing.get_trace_context())
         # staleness stamp: the policy version this trajectory STARTS under
         # (a mid-generation hot-swap is fine — per-token behavior logprobs
         # stay exact regardless; the stamp drives the rlhf admission gate)
@@ -484,6 +490,12 @@ class LLMEngine:
         if done_reason is not None:
             req.state = FINISHED
             req.finish_reason = done_reason
+            if req.phase_led is not None:
+                # fold the (near-empty) ledger so obs attribute still sees
+                # this attempt — its whole life was the submit check
+                now = time.time()
+                _phases.charge(req.phase_led, _phases.QUEUE, now)
+                _phases.fold_engine(req, now, done_reason)
             _events.record(
                 "llm.finish", request_id=req.trace_id, engine_req=req.id,
                 reason=done_reason, tokens_out=len(req.out),
@@ -870,6 +882,11 @@ class LLMEngine:
             self.pool.k, self.pool.v = self.runner.fork_blocks(
                 self.pool.k, self.pool.v, src, dst
             )
+        now = time.time()
+        for _s, _d, rid in pend:
+            req = self._requests.get(rid)
+            if req is not None and req.phase_led is not None:
+                _phases.charge(req.phase_led, _phases.COW_FORK, now)
 
     def _prefill_one(self) -> bool:
         """One chunk for the oldest admission still prefilling."""
@@ -893,6 +910,13 @@ class LLMEngine:
         self.pool.k, self.pool.v = k, v
         req.prefill_pos += n_valid
         self._prefill_tokens += n_valid
+        if req.phase_led is not None:
+            # a recompute's re-prefill is preemption cost, not prefill
+            _phases.charge(
+                req.phase_led,
+                _phases.PREEMPT if req.phase_recompute else _phases.PREFILL,
+                time.time(),
+            )
         _metrics()["prefill_tokens"].inc(n_valid)
         _events.record(
             "llm.prefill_chunk", request_id=req.trace_id, engine_req=req.id,
@@ -921,6 +945,7 @@ class LLMEngine:
                 np.asarray([p.top_p], np.float32),
             )
             req.state = RUNNING
+            req.phase_recompute = False  # recompute ends where decode resumes
             self._emit(req, int(tok[0]), float(lp[0]))
         return True
 
@@ -982,6 +1007,10 @@ class LLMEngine:
         import jax
 
         nxt, logp = jax.device_get((nxt, logp))  # ONE host sync for the batch
+        now = time.time()
+        for i, req in active:
+            if req.phase_led is not None:
+                _phases.charge(req.phase_led, _phases.DECODE, now)
         for i, req in active:
             _events.record(
                 "llm.decode", request_id=req.trace_id, engine_req=req.id,
@@ -1053,6 +1082,10 @@ class LLMEngine:
         )
         self.pool.k, self.pool.v = k, v
         n_acc, out, out_lp = jax.device_get((n_acc, out, out_lp))  # ONE host sync
+        now = time.time()
+        for i, req in active:
+            if req.phase_led is not None:
+                _phases.charge(req.phase_led, _phases.SPEC_VERIFY, now)
         emitted = 0
         accepted = 0
         for i, req in active:

@@ -45,6 +45,7 @@ from typing import Optional
 
 from ray_tpu._private import events as _events
 from ray_tpu.llm.cache import KVBlockPool
+from ray_tpu.util import phases as _phases
 from ray_tpu.util import tracing as _tracing
 
 _req_counter = itertools.count()
@@ -131,6 +132,21 @@ class Request:
         self.cache_epoch = 0
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None
+        # phase-attribution ledger (util.phases): cursor + per-phase
+        # accumulators, anchored at submit. A resumed request gets a FRESH
+        # ledger — only THIS attempt's time is attributed (the dead
+        # replica's never folded). None when RAY_TPU_PHASES=0.
+        self.phase_led: Optional[list] = (
+            _phases.new_ledger(self.arrival_t) if _phases.enabled() else None
+        )
+        # True from preemption until the recompute's prefill completes:
+        # queue/admit/prefill charges reroute to the `preempt` phase so
+        # recompute cost is attributed, not lumped into first-time phases
+        self.phase_recompute = False
+        # cross-process dispatch leg (engine submit − proxy dispatch
+        # anchor), stamped by phases.note_dispatch when the trace context
+        # carries the anchor
+        self.phase_dispatch_s: Optional[float] = None
         self.cancelled = threading.Event()
         # stream events: ("token", id) ... ("done", reason)
         self.stream: queue.SimpleQueue = queue.SimpleQueue()
@@ -203,6 +219,16 @@ class Scheduler:
             if not free:
                 break
             req = self.waiting[0]
+            if req.phase_led is not None:
+                # close the queue leg HERE so the admission work that
+                # follows (prefix match, evict-to-fit, allocate, install)
+                # lands in `admit`; a failed attempt (break below) merges
+                # back into queue at the next inspection
+                _phases.charge(
+                    req.phase_led,
+                    _phases.PREEMPT if req.phase_recompute else _phases.QUEUE,
+                    time.time(),
+                )
             # prompt (+ recomputed tokens after preempt) + one generation
             # block of headroom, capped at the table width for sequences
             # already near the model-length limit
@@ -266,6 +292,12 @@ class Scheduler:
                 req.prefill_pos = 0
                 self.waiting.appendleft(req)
                 raise
+            if req.phase_led is not None:
+                _phases.charge(
+                    req.phase_led,
+                    _phases.PREEMPT if req.phase_recompute else _phases.ADMIT,
+                    time.time(),
+                )
             admitted.append(req)
             _events.record(
                 "llm.admit", request_id=req.trace_id, engine_req=req.id,
@@ -316,6 +348,12 @@ class Scheduler:
         self._admitted_at.pop(req.id, None)
         self._drop_pending_cow(req.id)
         self.preempt_count += 1
+        if req.phase_led is not None:
+            # the evicted step's partial work is lost to the recompute —
+            # charge it to `preempt` and reroute everything until the
+            # re-prefill completes (engine clears the flag at RUNNING)
+            _phases.charge(req.phase_led, _phases.PREEMPT, time.time())
+            req.phase_recompute = True
         req.prefill_pos = 0
         req.state = WAITING
         self.waiting.appendleft(req)
@@ -325,6 +363,21 @@ class Scheduler:
         )
 
     def finish(self, req: Request, reason: str) -> None:
+        if req.phase_led is not None:
+            # tail charge: attribute the interval since the last stamp by
+            # what the request was doing, then fold — Σ phases now equals
+            # finish − submit exactly
+            now = time.time()
+            if req.phase_recompute:
+                idx = _phases.PREEMPT
+            elif req.state == RUNNING:
+                idx = _phases.DECODE
+            elif req.state == PREFILL:
+                idx = _phases.PREFILL
+            else:
+                idx = _phases.QUEUE
+            _phases.charge(req.phase_led, idx, now)
+            _phases.fold_engine(req, now, reason)
         slot = self._slot_of(req)
         if slot is not None:
             self.slots[slot] = None

@@ -23,6 +23,13 @@ Commands::
         decode/verify with accepted counts, preemptions, finish), with
         relative timestamps and a latency summary.
 
+    python -m ray_tpu.obs attribute --address HOST:PORT [--top 10]
+        Request latency attribution: joins the per-request phase ledgers
+        (``llm.phase.*`` events, live drain + crash-flush rings) into
+        per-phase p50/p95/p99, the slowest requests with their dominant
+        phase, and the p99-budget identity (phases sum to end-to-end
+        within ε).
+
     python -m ray_tpu.obs events --address HOST:PORT [--tail 50]
         Tail the cluster-wide flight recorder (newest last).
 
@@ -124,6 +131,16 @@ def _fmt_pcts(p: dict) -> str:
         f"p50={one(p.get('p50'))} p95={one(p.get('p95'))} "
         f"p99={one(p.get('p99'))} (n={p.get('count', 0)})"
     )
+
+
+def hist_pcts_row(p: Optional[dict]) -> str:
+    """Percentile summary honoring the below-2-samples contract shared by
+    every series-derived row (waterfall_top_row, core_batch_top_row, the
+    phase tables): fewer than two observations renders ``—``, never a
+    percentile faked out of one sample."""
+    if not p or p.get("count", 0) < 2:
+        return "—"
+    return _fmt_pcts(p)
 
 
 def _first_series(per_tag: dict):
@@ -244,9 +261,9 @@ def _render_top() -> None:
         ttft = _first_series(pcts.get("llm_time_to_first_token_s", {}))
         itl = _first_series(pcts.get("llm_inter_token_latency_s", {}))
         if ttft:
-            lines.append(f"TTFT: {_fmt_pcts(ttft)}")
+            lines.append(f"TTFT: {hist_pcts_row(ttft)}")
         if itl:
-            lines.append(f"ITL:  {_fmt_pcts(itl)}")
+            lines.append(f"ITL:  {hist_pcts_row(itl)}")
     else:
         lines.append("engine: (no llm_* metrics published — no LLM replica running)")
     firing = _firing_alerts()
@@ -682,6 +699,13 @@ def measure_overhead(n: int = 200_000) -> dict:
     out["waterfall_stamp_ns"] = bench(lambda: wfl.stamp([0.0]))
     out["waterfall_unsampled_ns"] = bench(lambda: wfl.maybe_start(None))
 
+    # request phase-ledger charge (util.phases): the per-stamp cost every
+    # engine phase transition pays — the ≤2µs/stamp budget's probe
+    from ray_tpu.util import phases as ph
+
+    led = ph.new_ledger(time.time())
+    out["phase_charge_ns"] = bench(lambda: ph.charge(led, ph.DECODE, 1.0))
+
     # device-step profiler emit path (cache-size probe + tagged observe);
     # the probe target has no _cache_size, like any non-jit callable
     prof = dp.JitProfiler(event="obs.overhead.retrace")
@@ -709,6 +733,7 @@ def cmd_overhead(args) -> int:
         ("Histogram.observe()", res["histogram_observe_ns"]),
         ("waterfall stamp (sampled)", res["waterfall_stamp_ns"]),
         ("waterfall check (unsampled)", res["waterfall_unsampled_ns"]),
+        ("phase-ledger charge()", res["phase_charge_ns"]),
         ("step-profiler note()", res["device_prof_note_ns"]),
     ]
     for label, v in rows:
@@ -816,6 +841,21 @@ def render_request(request_id: str, evs: list[dict]) -> str:
         )
     if parts:
         lines.append("  -- " + "  ".join(parts))
+    # phase lane: the request's own latency decomposition (one ledger
+    # fold per engine attempt; attribute_rows joins it with the proxy
+    # anchors for the cross-process legs)
+    rows = attribute_rows(evs)
+    for row in rows:
+        lane = "  ".join(
+            f"{k}={_fmt_ms(v)}"
+            for k, v in row["phases"].items()
+            if v > 0
+        )
+        lines.append(
+            f"  -- phases ({row['scope']}, e2e={_fmt_ms(row['e2e'])}"
+            + (", resumed" if row["resumed"] else "")
+            + f"): {lane}"
+        )
     return "\n".join(lines)
 
 
@@ -835,6 +875,226 @@ def cmd_req(args) -> int:
         return 0 if evs else 1
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# attribute: per-request phase decomposition + fleet critical-path report
+# ---------------------------------------------------------------------------
+
+
+def attribute_rows(evs: list[dict]) -> list[dict]:
+    """Join the phase-plane events (``llm.phase.ledger`` from the engine,
+    ``llm.phase.proxy`` from the HTTP proxy) into one decomposition per
+    request.  The join is pure anchor arithmetic and telescopes exactly:
+    ``proxy + dispatch|failover + Σ(engine phases) + stream == t_done −
+    t_recv`` (engine-only rows: ``Σ(engine phases) == t_finish −
+    t_submit`` — the by-construction cursor identity).  A resumed
+    request's surviving ledger covers only the second attempt; the gap
+    back to the proxy's dispatch anchor — the dead attempt plus the
+    re-dispatch — is reported as ``failover``, never re-counted into
+    token phases."""
+    from ray_tpu.util import phases as ph
+
+    ledgers: dict = {}
+    proxies: dict = {}
+    for e in evs:
+        rid = e.get("request_id")
+        if not rid:
+            continue
+        t = e.get("type")
+        if t == "llm.phase.ledger":
+            cur = ledgers.get(rid)
+            # keep the newest fold: after a mid-stream failover only the
+            # surviving attempt's ledger describes delivered work
+            if cur is None or e.get("t_finish", 0.0) >= cur.get("t_finish", 0.0):
+                ledgers[rid] = e
+        elif t == "llm.phase.proxy":
+            proxies[rid] = e
+    order = [name for name, _o, _d in ph.PHASES]
+    rows = []
+    for rid, led in sorted(ledgers.items()):
+        eng = led.get("phases") or {}
+        phases = {k: float(eng.get(k, 0.0)) for k in ph.ENGINE_PHASES}
+        row = {
+            "request_id": rid,
+            "resumed": bool(led.get("resumed")),
+            "reason": led.get("reason"),
+        }
+        t_submit = led.get("t_submit", 0.0)
+        t_finish = led.get("t_finish", 0.0)
+        prox = proxies.get(rid)
+        if prox is not None and prox.get("t_dispatch") is not None:
+            t_recv, t_done = prox["t_recv"], prox["t_done"]
+            t_disp = prox["t_dispatch"]
+            phases["proxy"] = max(0.0, t_disp - t_recv)
+            if row["resumed"]:
+                phases["failover"] = max(0.0, t_submit - t_disp)
+            else:
+                phases["dispatch"] = max(0.0, t_submit - t_disp)
+            phases["stream"] = max(0.0, t_done - t_finish)
+            row["e2e"] = max(0.0, t_done - t_recv)
+            row["scope"] = "proxy"
+        else:
+            row["e2e"] = max(0.0, t_finish - t_submit)
+            row["scope"] = "engine"
+        row["phases"] = {
+            k: round(phases[k], 6) for k in order if phases.get(k)
+        }
+        s = sum(phases.values())
+        row["phase_sum"] = round(s, 6)
+        row["err"] = (
+            abs(s - row["e2e"]) / row["e2e"] if row["e2e"] > 0 else 0.0
+        )
+        row["dominant"] = (
+            max(row["phases"], key=row["phases"].get) if row["phases"] else None
+        )
+        rows.append(row)
+    return rows
+
+
+def _pcts_of(vals: list[float]) -> dict:
+    vals = sorted(vals)
+    n = len(vals)
+
+    def q(p: float):
+        return vals[min(n - 1, int(round(p * (n - 1))))] if n else None
+
+    return {
+        "count": n,
+        "p50": q(0.50),
+        "p95": q(0.95),
+        "p99": q(0.99),
+        "mean": (sum(vals) / n) if n else None,
+    }
+
+
+def attribution_report(
+    rows: list[dict], top: int = 10, eps: float = 0.05
+) -> dict:
+    """Fleet-level critical-path report over per-request decompositions:
+    per-phase p50/p95/p99, the top-N slowest requests with their dominant
+    phase, and the p99-budget identity — the fraction of requests whose
+    phases sum to measured end-to-end within ``eps`` (the acceptance
+    gate loadgen and the CI smoke assert headlessly)."""
+    from ray_tpu.util import phases as ph
+
+    per_phase: dict = {}
+    for r in rows:
+        for k, v in r["phases"].items():
+            per_phase.setdefault(k, []).append(v)
+    order = [name for name, _o, _d in ph.PHASES]
+    within = [r for r in rows if r["err"] <= eps]
+    slowest = sorted(rows, key=lambda r: -r["e2e"])[:top]
+    e2e = _pcts_of([r["e2e"] for r in rows])
+    return {
+        "n_requests": len(rows),
+        "eps": eps,
+        "within_eps": len(within),
+        "within_eps_frac": (len(within) / len(rows)) if rows else None,
+        "worst_err": max((r["err"] for r in rows), default=None),
+        "scopes": {
+            s: sum(1 for r in rows if r["scope"] == s)
+            for s in ("proxy", "engine")
+        },
+        "resumed": sum(1 for r in rows if r["resumed"]),
+        "e2e": e2e,
+        "per_phase": {
+            k: _pcts_of(per_phase[k]) for k in order if k in per_phase
+        },
+        "slowest": [
+            {
+                "request_id": r["request_id"],
+                "e2e": round(r["e2e"], 6),
+                "dominant": r["dominant"],
+                "dominant_s": round(
+                    r["phases"].get(r["dominant"], 0.0), 6
+                ) if r["dominant"] else 0.0,
+                "resumed": r["resumed"],
+                "reason": r["reason"],
+            }
+            for r in slowest
+        ],
+    }
+
+
+def render_attribution(report: dict) -> str:
+    """The ``obs attribute`` tables: per-phase percentiles (below-2-samples
+    ``—`` contract), the p99 budget line, and the slowest requests."""
+    n = report["n_requests"]
+    if not n:
+        return "no phase ledgers found (no llm.phase.* events — is the " \
+               "engine serving with RAY_TPU_PHASES enabled?)"
+    lines = [
+        f"request phase attribution: {n} requests "
+        f"(proxy-joined={report['scopes']['proxy']} "
+        f"engine-only={report['scopes']['engine']} "
+        f"resumed={report['resumed']})",
+        f"{'PHASE':<12} {'N':>6}  {'P50':>9} {'P95':>9} {'P99':>9}",
+    ]
+    for name, p in report["per_phase"].items():
+        if p.get("count", 0) < 2:
+            lines.append(f"{name:<12} {p.get('count', 0):>6}  "
+                         f"{'—':>9} {'—':>9} {'—':>9}")
+            continue
+        lines.append(
+            f"{name:<12} {p['count']:>6}  {_fmt_us(p['p50']):>9} "
+            f"{_fmt_us(p['p95']):>9} {_fmt_us(p['p99']):>9}"
+        )
+    e2e = report["e2e"]
+    lines.append(
+        f"{'e2e':<12} {e2e['count']:>6}  "
+        + (
+            f"{_fmt_us(e2e['p50']):>9} {_fmt_us(e2e['p95']):>9} "
+            f"{_fmt_us(e2e['p99']):>9}"
+            if e2e.get("count", 0) >= 2
+            else f"{'—':>9} {'—':>9} {'—':>9}"
+        )
+    )
+    frac = report["within_eps_frac"]
+    lines.append(
+        f"p99 budget: phases sum to e2e within ε={report['eps']:.0%} for "
+        f"{report['within_eps']}/{n} requests ({frac:.1%})"
+        + (
+            f", worst err {report['worst_err']:.2%}"
+            if report.get("worst_err") is not None
+            else ""
+        )
+    )
+    if report["slowest"]:
+        lines.append(f"{'SLOWEST':<28} {'E2E':>9}  DOMINANT")
+        for s in report["slowest"]:
+            lines.append(
+                f"{s['request_id'][:26]:<28} {_fmt_us(s['e2e']):>9}  "
+                f"{s['dominant']}={_fmt_us(s['dominant_s'])}"
+                + (" (resumed)" if s["resumed"] else "")
+                + (f" [{s['reason']}]" if s.get("reason") else "")
+            )
+    return "\n".join(lines)
+
+
+def cmd_attribute(args) -> int:
+    from ray_tpu._private import events as ev
+
+    ray_tpu = None
+    if not _offline(args):
+        ray_tpu = _attach(args.address)
+    try:
+        evs = ev.collect_cluster_events() if ray_tpu is not None else []
+        evs.extend(_load_crash_files(args.events_dir))
+        evs = _dedup(evs)
+        rows = attribute_rows(evs)
+        report = attribution_report(rows, top=args.top, eps=args.eps)
+        if args.output:
+            with open(args.output, "w") as fh:
+                json.dump({"report": report, "rows": rows}, fh, default=repr)
+        if args.json:
+            print(json.dumps(report, default=repr))
+        else:
+            print(render_attribution(report))
+        return 0 if rows else 1
+    finally:
+        if ray_tpu is not None:
+            ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -1112,6 +1372,22 @@ def main(argv=None) -> int:
     p.add_argument("request_id")
     p.add_argument("--events-dir", default=None, help="crash-flush JSONL dir")
     p.set_defaults(fn=cmd_req)
+
+    p = sub.add_parser(
+        "attribute",
+        help="per-request phase decomposition + fleet p50/p95/p99 "
+        "critical-path report (joins llm.phase.* events across processes)",
+    )
+    p.add_argument("--top", type=int, default=10,
+                   help="slowest-requests rows to show")
+    p.add_argument("--eps", type=float, default=0.05,
+                   help="phase-sum identity tolerance (fraction of e2e)")
+    p.add_argument("--events-dir", default=None,
+                   help="also read crash-flush JSONL (offline with no address)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the full report + per-request rows JSON")
+    p.set_defaults(fn=cmd_attribute)
 
     p = sub.add_parser("events", help="tail the cluster flight recorder")
     p.add_argument("--tail", type=int, default=50)

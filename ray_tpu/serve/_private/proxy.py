@@ -42,6 +42,7 @@ import h11
 
 from ray_tpu._private import events as _events
 from ray_tpu.serve._private.common import CONTROLLER_NAME
+from ray_tpu.util import phases as _phases
 from ray_tpu.util import tracing as _tracing
 
 _READ_CHUNK = 1 << 16
@@ -368,7 +369,7 @@ class ProxyActor:
 
     def _run_stream(self, app: str, payload, loop, q: "asyncio.Queue",
                     cancel: threading.Event, window: threading.Semaphore,
-                    request_id: str = "", deadline_s=None):
+                    request_id: str = "", deadline_s=None, stamps=None):
         """Dedicated thread per stream (long-lived by nature — must not
         occupy the dispatch pool): iterates the streaming generator with a
         bounded chunk window and stops (disposing the remote stream) when
@@ -383,11 +384,19 @@ class ProxyActor:
             # inherits the proxy-minted request_id (mint_context makes the
             # head-sampling decision once; an unsampled stream ships no
             # context downstream and records no spans)
-            _tracing.set_trace_context(
-                _tracing.mint_context(request_id) if request_id else None
-            )
+            ctx = _tracing.mint_context(request_id) if request_id else None
+            _tracing.set_trace_context(ctx)
             handle, _ = self._handle_for(app)
             self._shed_if_doomed(handle, app, deadline_s, request_id)
+            if stamps is not None:
+                # phase-ledger dispatch anchor: kept proxy-side for the
+                # fold AND ridden downstream on the sampled trace-ctx dict
+                # so the engine can observe the cross-process dispatch leg
+                # (phases.note_dispatch)
+                t_disp = time.time()
+                stamps["t_dispatch"] = t_disp
+                if type(ctx) is dict:
+                    ctx["t_dispatch"] = t_disp
             gen = handle.options(stream=True).remote(payload)
             for item in gen:
                 if isinstance(item, (bytes, bytearray, memoryview)):
@@ -400,6 +409,10 @@ class ProxyActor:
                 if cancel.is_set():
                     raise _StreamCancelled
                 post(("chunk", data))
+            if stamps is not None:
+                # done-sentinel receipt ≈ engine finish + one hop; the
+                # `stream` phase (delivery tail) starts here
+                stamps["t_finish"] = time.time()
             post(("end", None))
         except _StreamCancelled:
             pass
@@ -466,16 +479,24 @@ class ProxyActor:
         await self._send(writer, conn, h11.EndOfMessage())
 
     async def _respond_stream(self, writer, conn, app: str, payload, loop,
-                              request_id: str = "", deadline_s=None):
+                              request_id: str = "", deadline_s=None,
+                              t_recv=None):
         """Chunked transfer: h11 frames chunks automatically when no
         content-length is declared. Errors after the header cannot become a
         second response — truncate the stream (close) like the reference."""
         q: asyncio.Queue = asyncio.Queue()
         cancel = threading.Event()
         window = threading.Semaphore(_STREAM_WINDOW)
+        # phase-ledger anchors for this request (util.phases): the stream
+        # thread writes dispatch/finish, this coroutine first-chunk, and
+        # the successful-completion branch folds them
+        stamps = {} if _phases.enabled() else None
+        if t_recv is None:
+            t_recv = time.time()
         threading.Thread(
             target=self._run_stream,
-            args=(app, payload, loop, q, cancel, window, request_id, deadline_s),
+            args=(app, payload, loop, q, cancel, window, request_id,
+                  deadline_s, stamps),
             name="proxy-stream",
             daemon=True,
         ).start()
@@ -506,10 +527,20 @@ class ProxyActor:
             kind, val = first_kind, first_val
             while True:
                 if kind == "chunk":
+                    if stamps is not None and "t_first" not in stamps:
+                        stamps["t_first"] = time.time()
                     await self._send(writer, conn, h11.Data(data=val))
                 elif kind == "end":
                     await self._send(writer, conn, h11.EndOfMessage())
                     _count_request(200)
+                    if stamps is not None:
+                        _phases.fold_proxy(
+                            request_id, t_recv,
+                            stamps.get("t_dispatch"),
+                            stamps.get("t_first"),
+                            stamps.get("t_finish"),
+                            time.time(),
+                        )
                     return True
                 else:  # mid-stream error: truncate
                     import traceback
@@ -578,7 +609,7 @@ class ProxyActor:
                     if kind == "stream":
                         ok = await self._respond_stream(
                             writer, conn, app, payload, loop, request_id=rid,
-                            deadline_s=deadline_s,
+                            deadline_s=deadline_s, t_recv=t_req,
                         )
                         if ok:
                             # failures already recorded proxy.response /

@@ -249,6 +249,41 @@ def _objects_panels() -> list:
     ]
 
 
+def _phases_panels() -> list:
+    """Request-phases row (ISSUE 20), DERIVED from the phase registry
+    (``util.phases.PHASES`` — tests cross-check this row against it):
+    where a served request's milliseconds go, per phase. Assembly-only
+    phases (computed by ``obs attribute`` from anchors, never exported
+    as series) are skipped — a panel over a never-emitted series would
+    be permanently empty."""
+    from ray_tpu.util.phases import PHASES
+
+    m = "ray_tpu_llm_request_phase_s"
+    panels = [
+        ("Request phase p99 (by phase)",
+         f"histogram_quantile(0.99, sum by (le, phase) "
+         f"(rate({m}_bucket[5m])))", "s",
+         "p99 seconds per phase of the request lifecycle "
+         "(llm_request_phase_s) — the fleet view of `obs attribute`: "
+         "whichever line dominates owns the latency budget."),
+        ("Request phase share (mean s/req)",
+         f"sum by (phase) (rate({m}_sum[5m])) / ignoring(phase) "
+         f"group_left sum(rate({m}_count[5m]))", "s",
+         "Mean seconds each phase contributes per request — the stacked "
+         "decomposition of end-to-end latency."),
+    ]
+    for name, owner, edges in PHASES:
+        if owner == "assembly":
+            continue  # no series: derived at attribution time
+        panels.append((
+            f"Phase {name} p99",
+            f'histogram_quantile(0.99, rate({m}_bucket{{phase="{name}"}}'
+            f"[5m]))", "s",
+            f"{edges} (owner: {owner}).",
+        ))
+    return panels
+
+
 def _slo_panels() -> list:
     """SLO / burn-rate row DERIVED from ``util.slo.default_rules()`` — the
     panels interpolate the same threshold/objective/window the head's alert
@@ -262,9 +297,16 @@ def _slo_panels() -> list:
         window = f"[{max(int(rule.fast_window_s), 15)}s]"
         if rule.kind == "histogram_burn":
             m = f"ray_tpu_{rule.metric}"
+            # the rule's series filter (e.g. phase="queue") rides both the
+            # bucket and count selectors, matching _tags_match at eval time
+            tagsel = "".join(
+                f', {k}="{v}"' for k, v in (rule.tags or {}).items()
+            )
+            csel = "{" + tagsel[2:] + "}" if tagsel else ""
             expr = (
-                f'(1 - (rate({m}_bucket{{le="{rule.threshold:g}"}}{window}) '
-                f"/ rate({m}_count{window}))) / {budget:g}"
+                f'(1 - (rate({m}_bucket{{le="{rule.threshold:g}"{tagsel}}}'
+                f"{window}) "
+                f"/ rate({m}_count{csel}{window}))) / {budget:g}"
             )
             title = f"{rule.name} fast burn rate"
         elif rule.kind == "counter_burn":
@@ -308,6 +350,8 @@ _LLM_NAMES = {
     "llm_hbm_params_bytes", "llm_hbm_kv_pool_bytes", "llm_hbm_kv_seq_bytes",
     "llm_hbm_kv_cache_bytes", "llm_hbm_kv_free_bytes",
     "llm_hbm_drafter_bytes",
+    # request-phases row (_phases_panels)
+    "llm_request_phase_s",
 }
 
 
@@ -359,7 +403,7 @@ def dashboard_json(extra_metric_names: Optional[list[str]] = None) -> dict:
     for title, expr, unit, desc in (_CORE_PANELS + _LLM_PANELS
                                     + _prefix_panels() + _profiling_panels()
                                     + _data_plane_panels() + _objects_panels()
-                                    + _slo_panels()):
+                                    + _phases_panels() + _slo_panels()):
         panels.append(_panel(pid, title, expr, unit, desc, y))
         pid += 1
         if pid % 2 == 0:

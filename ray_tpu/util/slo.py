@@ -44,6 +44,10 @@ class SLORule:
     threshold: float = 0.0
     #: tag subset selecting the BAD series of a counter_burn metric
     bad_tags: Optional[dict] = None
+    #: tag subset selecting WHICH series of the metric the rule reads at
+    #: all (histogram_burn over one member of a tagged family, e.g.
+    #: ``llm_request_phase_s{phase=queue}``); None = every series
+    tags: Optional[dict] = None
     fast_window_s: float = 60.0
     slow_window_s: float = 300.0
     #: SRE-workbook page factors scaled to the in-memory retention window
@@ -121,7 +125,9 @@ def evaluate_rule(rule: SLORule, merged: dict, now: Optional[float] = None) -> d
     if rule.kind == "histogram_burn":
         bounds = ent.get("boundaries") or ()
         bf = bt = sf = st_ = 0.0
-        for points in series.values():
+        for tagset, points in series.items():
+            if not _tags_match(tagset, rule.tags):
+                continue
             b, t = _hist_bad_total(points, bounds, rule.threshold,
                                    rule.fast_window_s, now)
             bf, bt = bf + b, bt + t
@@ -217,6 +223,27 @@ def default_rules() -> list[SLORule]:
             labels={"serve": "upscale", "severity": "page"},
             description="99% of requests reach their first token within the "
                         "threshold; both burn windows above factor pages.",
+        ),
+        SLORule(
+            name="queue-time-burn",
+            metric="llm_request_phase_s",
+            kind="histogram_burn",
+            tags={"phase": "queue"},
+            objective=_envf("RAY_TPU_SLO_QUEUE_OBJECTIVE", 0.99),
+            threshold=_envf("RAY_TPU_SLO_QUEUE_THRESHOLD_S", 1.0),
+            fast_window_s=fast,
+            slow_window_s=slow,
+            fast_burn=_envf("RAY_TPU_SLO_FAST_BURN", 14.4),
+            slow_burn=_envf("RAY_TPU_SLO_SLOW_BURN", 6.0),
+            resolve_after_s=resolve,
+            labels={"serve": "upscale", "severity": "page"},
+            description="99% of requests spend under the threshold waiting "
+                        "in the engine queue (phase ledger's queue leg) — "
+                        "queue burn is the capacity signal: it pages and "
+                        "asks the autoscaler for replicas BEFORE TTFT "
+                        "breaches, because queueing is where overload "
+                        "lands first (the loadgen overload arm is the "
+                        "reproduction).",
         ),
         SLORule(
             name="request-errors",

@@ -152,6 +152,10 @@ class TestEngineCapture:
         )
         np.testing.assert_allclose(req.out_logprobs, ref, atol=2e-4)
 
+    # tier-1 budget (ISSUE 20): 10.9s measured — rides slow;
+    # tests/test_llm_spec.py keeps spec-decode token identity in tier-1 and
+    # the logprob-capture goldens above keep the capture contract gated
+    @pytest.mark.slow
     def test_spec_decode_on_vs_off_identical(self, tiny_params):
         """Spec decode must capture the SAME logprobs the plain path
         captures — the verify path computes per-index distributions, so

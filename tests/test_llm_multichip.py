@@ -106,6 +106,10 @@ def test_greedy_and_seeded_token_identity(tp):
     assert got == ref
 
 
+# tier-1 budget (ISSUE 20): 11.0s measured at tp=2 — rides slow; the
+# multichip-engine-smoke CI job runs this file in full on every push and
+# single-chip spec identity stays gated by tests/test_llm_spec.py
+@pytest.mark.slow
 @pytest.mark.parametrize("tp", [2, 4])
 def test_spec_decode_token_identity(tp):
     """Speculative decoding under tp: drafting is host-side, the sharded
@@ -116,6 +120,11 @@ def test_spec_decode_token_identity(tp):
     assert eng.stats()["spec_proposed"] > 0
 
 
+# tier-1 budget (ISSUE 20): 6.7s measured across params — rides slow; the
+# multichip-engine-smoke CI job runs this file in full, single-chip prefix
+# identity stays gated by tests/test_llm_prefix.py, and the warm-path
+# identity below stays in tier-1
+@pytest.mark.slow
 @pytest.mark.parametrize("tp", [2, 4])
 def test_prefix_cache_off_token_identity(tp):
     ref, _ = _matrix(1, 0, False)
@@ -138,6 +147,10 @@ def test_prefix_cache_warm_path_identity(tp):
     assert eng.prefix_cache.stats()["hit_tokens"] > hits_before
 
 
+# tier-1 budget (ISSUE 20): 8.8s measured across params — rides slow; the
+# multichip-engine-smoke CI job runs this file in full and single-chip
+# preemption identity stays gated by tests/test_llm_spec.py
+@pytest.mark.slow
 @pytest.mark.parametrize("tp", [2, 4])
 def test_preemption_recompute_identity(tp):
     """A pool too small for all completions forces recompute preemption;
@@ -232,6 +245,10 @@ def test_hbm_gauges_carry_device_tag():
         == led["pool_bytes"]
 
 
+# tier-1 budget (ISSUE 20): 9.6s measured across params — rides slow; the
+# multichip-engine-smoke CI job runs this file in full and the swap contract
+# stays gated by tests/test_llm_weight_swap.py + the rlhf hot-swap tests
+@pytest.mark.slow
 @pytest.mark.parametrize("tp", [2, 4])
 def test_update_weights_sharded_hot_swap(tp):
     """update_weights routes through the tp runner's prepare_params:

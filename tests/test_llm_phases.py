@@ -1,0 +1,346 @@
+"""Phase-ledger identity matrix (ISSUE 20: request latency attribution).
+
+The contract under test: every finished request's engine phase ledger is
+COMPLETE and NON-OVERLAPPING — the phases sum to the request's measured
+end-to-end engine latency (finish − submit) — across every scheduling
+regime the engine knows:
+
+* greedy and seeded sampling;
+* speculative decode (``spec_verify`` attributed, not lumped into
+  ``decode``);
+* preemption recompute (re-queue/re-admit/re-prefill charged to
+  ``preempt``, so recompute cost is its own line);
+* mid-stream failover resume (a fresh ledger for the second attempt —
+  already-delivered token phases are never re-counted);
+* prefix-cache hits (matched-prefix time lands in ``admit``; ``prefill``
+  covers only the uncached suffix).
+
+The identity is exact by construction (cursor model: every interval is
+charged to exactly one phase) — the tolerance below only absorbs the
+6-decimal rounding the fold applies per phase.
+
+Plus the '—'-below-2-samples contract pins for the tables the loadgen
+report reuses (``obs.hist_pcts_row``, the attribution per-phase table),
+and the ≤2µs stamp budget.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu._private import events as ev
+from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.models.gptj import GPTJConfig, gptj_init
+from ray_tpu.util import phases
+
+TINY = GPTJConfig(
+    vocab_size=128, seq_len=64, d_model=32, n_layers=2, n_heads=2,
+    rotary_dim=8, dtype="float32", remat=False, attn_impl="xla",
+    fused_loss=False,
+)
+
+#: per-phase durations are rounded to 1µs in the fold — 7 phases of
+#: half-ulp each bounds the identity slack at a few µs
+ROUND_SLACK = 1e-4
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return gptj_init(jax.random.PRNGKey(0), TINY)
+
+
+@pytest.fixture(autouse=True)
+def fresh_ring():
+    st = ev.stats()
+    ev.clear()
+    ev.set_enabled(True)
+    yield
+    ev.set_enabled(st["enabled"])
+    ev.clear()
+
+
+def _prompt(n, seed=1):
+    return list(np.random.RandomState(seed).randint(0, TINY.vocab_size, n))
+
+
+def _engine(params, **kw):
+    defaults = dict(
+        max_slots=3, num_blocks=32, block_size=4, max_blocks_per_seq=12,
+        prefill_chunk=8,
+    )
+    defaults.update(kw)
+    return LLMEngine(TINY, params, EngineConfig(**defaults))
+
+
+def _drive(engine, reqs, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while not all(r.finished for r in reqs):
+        engine.step()
+        assert time.monotonic() < deadline, "engine did not finish in time"
+
+
+def _ledgers():
+    return [e for e in ev.snapshot() if e["type"] == "llm.phase.ledger"]
+
+
+def _assert_identity(led):
+    """One ledger event: known phase names, non-negative durations, and
+    Σ phases == t_finish − t_submit (complete + non-overlapping)."""
+    assert set(led["phases"]) <= set(phases.ENGINE_PHASES), led
+    assert all(v >= 0.0 for v in led["phases"].values()), led
+    e2e = led["t_finish"] - led["t_submit"]
+    total = sum(led["phases"].values())
+    assert abs(total - e2e) <= ROUND_SLACK + 1e-3 * e2e, (
+        f"phase sum {total:.6f}s != e2e {e2e:.6f}s for {led['request_id']}: "
+        f"{led['phases']}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the matrix
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_identity(tiny_params):
+    eng = _engine(tiny_params)
+    reqs = [
+        eng.submit(_prompt(8, seed=s), SamplingParams(max_tokens=10))
+        for s in (1, 2, 3)
+    ]
+    _drive(eng, reqs)
+    leds = _ledgers()
+    assert len(leds) == 3
+    for led in leds:
+        _assert_identity(led)
+        assert led["phases"]["prefill"] > 0.0
+        assert led["phases"]["decode"] > 0.0
+        assert not led["resumed"]
+
+
+def test_seeded_sampling_identity(tiny_params):
+    eng = _engine(tiny_params)
+    sp = dict(max_tokens=8, temperature=1.0, top_k=16)
+    reqs = [
+        eng.submit(_prompt(6, seed=s), SamplingParams(seed=s, **sp))
+        for s in (4, 5)
+    ]
+    _drive(eng, reqs)
+    leds = _ledgers()
+    assert len(leds) == 2
+    for led in leds:
+        _assert_identity(led)
+
+
+def test_spec_decode_attributes_verify_not_decode(tiny_params):
+    # patterned prompt: the ngram drafter's home turf, so spec steps run
+    eng = _engine(tiny_params, spec_k=2)
+    prompt = [7, 8, 9] * 4
+    reqs = [eng.submit(list(prompt), SamplingParams(max_tokens=12))]
+    _drive(eng, reqs)
+    (led,) = _ledgers()
+    _assert_identity(led)
+    # verified speculative steps are their own line, not lumped decode
+    assert led["phases"]["spec_verify"] > 0.0
+
+
+def test_preemption_recompute_charged_to_preempt(tiny_params):
+    eng = _engine(
+        tiny_params, max_slots=3, num_blocks=13, block_size=4,
+        max_blocks_per_seq=10,
+    )
+    reqs = [
+        eng.submit(_prompt(8, seed=s), SamplingParams(max_tokens=16))
+        for s in (5, 6, 7)
+    ]
+    _drive(eng, reqs)
+    assert eng.stats()["preemptions"] > 0, "pool was sized to force preemption"
+    leds = _ledgers()
+    assert len(leds) == 3
+    for led in leds:
+        _assert_identity(led)
+    # at least one request's recompute (re-queue, re-admit, re-prefill)
+    # is visible as its own phase — not smeared into queue/prefill
+    assert any(led["phases"]["preempt"] > 0.0 for led in leds)
+
+
+def test_failover_resume_fresh_ledger_no_recount(tiny_params):
+    eng = _engine(tiny_params)
+    prompt = _prompt(8, seed=9)
+    full = eng.submit(prompt, SamplingParams(max_tokens=12))
+    _drive(eng, [full])
+    ev.clear()  # drop the first attempt's ledger: only the resume remains
+
+    t_resume = time.time()
+    resumed = eng.submit(
+        prompt, SamplingParams(max_tokens=12),
+        resume_tokens=tuple(full.out[:5]),
+    )
+    _drive(eng, [resumed])
+    assert full.out[5:] == resumed.out[5:]  # token-identical continuation
+    (led,) = _ledgers()
+    _assert_identity(led)
+    assert led["resumed"] == 5
+    # the fresh ledger covers ONLY the second attempt: its submit anchor
+    # postdates the resume call, so the 5 already-delivered tokens' phase
+    # time (first attempt) cannot be re-counted here
+    assert led["t_submit"] >= t_resume - ROUND_SLACK
+    # and the resumed fold carries no dispatch leg — the gap back to any
+    # proxy dispatch anchor spans the dead attempt (assembly reports it
+    # as `failover`, never as engine time)
+    assert "dispatch_s" not in led
+
+
+def test_prefix_cache_hit_lands_in_admit_not_prefill(tiny_params):
+    eng = _engine(
+        tiny_params, num_blocks=64, max_blocks_per_seq=16, prefill_chunk=8,
+    )
+    shared = _prompt(48, seed=11)
+    cold = eng.submit(list(shared), SamplingParams(max_tokens=4))
+    _drive(eng, [cold])
+    warm = eng.submit(list(shared), SamplingParams(max_tokens=4))
+    _drive(eng, [warm])
+    led_cold, led_warm = _ledgers()
+    _assert_identity(led_cold)
+    _assert_identity(led_warm)
+    assert cold.out == warm.out
+    # the warm request's radix match happened in admission; its prefill
+    # covers only the uncached suffix (≤1 chunk of 8 vs the cold 6) —
+    # the matched-prefix time must NOT reappear as prefill
+    assert led_warm["phases"]["prefill"] < led_cold["phases"]["prefill"] / 2
+
+
+def test_phases_disabled_costs_nothing(tiny_params):
+    phases.set_enabled(False)
+    try:
+        eng = _engine(tiny_params)
+        req = eng.submit(_prompt(6, seed=12), SamplingParams(max_tokens=4))
+        _drive(eng, [req])
+        assert req.phase_led is None
+        assert not _ledgers()
+    finally:
+        phases.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# the '—'-below-2-samples contract (PR 5) on the tables loadgen reuses
+# ---------------------------------------------------------------------------
+
+
+def test_hist_pcts_row_dash_below_two_samples():
+    from ray_tpu.obs import hist_pcts_row
+
+    assert hist_pcts_row({"count": 0}) == "—"
+    assert hist_pcts_row({"count": 1, "p50": 1.0, "p95": 1.0, "p99": 1.0}) == "—"
+    row = hist_pcts_row({"count": 2, "p50": 0.5, "p95": 0.9, "p99": 0.99})
+    assert row != "—" and "p50=500.0ms" in row
+
+
+def test_attribution_table_dash_below_two_samples():
+    from ray_tpu.obs import attribute_rows, attribution_report, render_attribution
+
+    def ledger(rid, t0):
+        return {
+            "type": "llm.phase.ledger", "request_id": rid, "engine_req": 1,
+            "reason": "complete", "t_submit": t0, "t_finish": t0 + 1.0,
+            "resumed": 0,
+            "phases": {"queue": 0.1, "prefill": 0.4, "decode": 0.5},
+        }
+
+    one = attribution_report(attribute_rows([ledger("r1", 100.0)]))
+    txt = render_attribution(one)
+    assert "—" in txt  # N=1 rows refuse to print fake percentiles
+    two = attribution_report(
+        attribute_rows([ledger("r1", 100.0), ledger("r2", 200.0)])
+    )
+    txt2 = render_attribution(two)
+    assert "decode" in txt2 and "p99 budget" in txt2
+    assert two["within_eps_frac"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# grafana / SLO derivations track the phase registry
+# ---------------------------------------------------------------------------
+
+
+def test_grafana_phases_row_tracks_registry():
+    """The dashboard's request-phases row is GENERATED from
+    ``phases.PHASES`` — every exported phase gets a panel, assembly-only
+    phases (no series exists) get none, and the family lands in the
+    skip-set so the dynamic fallback doesn't duplicate it."""
+    from ray_tpu.util.grafana import _LLM_NAMES, _phases_panels
+
+    doc = str(_phases_panels())
+    for name, owner, _edges in phases.PHASES:
+        if owner == "assembly":
+            assert f'phase="{name}"' not in doc, name
+        else:
+            assert f'phase="{name}"' in doc, name
+    assert "llm_request_phase_s" in doc
+    assert "llm_request_phase_s" in _LLM_NAMES
+
+
+def test_slo_queue_burn_rule_filters_phase_series(monkeypatch):
+    from ray_tpu.util import slo
+
+    monkeypatch.setenv("RAY_TPU_SLO_QUEUE_THRESHOLD_S", "0.5")
+    rules = {r.name: r for r in slo.default_rules()}
+    rule = rules["queue-time-burn"]
+    assert rule.metric == "llm_request_phase_s"
+    assert rule.tags == {"phase": "queue"}
+    assert rule.threshold == 0.5
+
+    # merged-series fixture: queue series burning hard, decode series
+    # clean — the rule must read ONLY the queue series
+    now = 1000.0
+    bounds = (0.25, 0.5, 1.0)
+
+    def hist_points(bad, good):
+        # (ts, vector) points; vector = per-bucket counts (≤0.25, ≤0.5,
+        # ≤1.0, +inf) + sum + count.  good lands in the ≤0.5 bucket, bad
+        # beyond the 0.5 threshold; baseline point zeroes the delta.
+        zero = [0.0] * 6
+        vec = [0.0, good, bad / 2, bad / 2, 1.0, good + bad]
+        return [(now - 200.0, zero), (now - 1.0, vec)]
+
+    merged = {
+        "llm_request_phase_s": {
+            "kind": "histogram",
+            "boundaries": bounds,
+            "series": {
+                '{"phase":"queue"}': hist_points(bad=50.0, good=50.0),
+                '{"phase":"decode"}': hist_points(bad=0.0, good=1000.0),
+            },
+        }
+    }
+    res = slo.evaluate_rule(rule, merged, now=now)
+    # 50% bad on a 1% budget = burn 50 — far above both factors; the
+    # clean decode series would dilute this to ~4.5 if it leaked in
+    assert res["breached"], res
+    assert res["value"] > 14.4, res
+
+
+def test_grafana_queue_burn_promql_carries_phase_selector():
+    from ray_tpu.util.grafana import _slo_panels
+
+    exprs = {title: expr for title, expr, _u, _d in _slo_panels()}
+    q = exprs["queue-time-burn fast burn rate"]
+    assert 'phase="queue"' in q
+    assert "llm_request_phase_s_bucket" in q
+    assert 'ray_tpu_llm_request_phase_s_count{phase="queue"}' in q
+
+
+# ---------------------------------------------------------------------------
+# the stamp budget
+# ---------------------------------------------------------------------------
+
+
+def test_charge_within_stamp_budget():
+    """ISSUE 20 hot-path bar: ≤2µs per stamp. charge() is two float ops
+    and two list stores — the generous bar catches a lock or an
+    allocation creeping in (10-100x), not scheduler noise."""
+    from ray_tpu.obs import measure_overhead
+
+    res = measure_overhead(n=30_000)
+    assert res["phase_charge_ns"] <= 2_000.0, res

@@ -45,6 +45,10 @@ def test_moe_capacity_drops_dont_nan():
     assert np.isfinite(float(loss))
 
 
+# tier-1 budget (ISSUE 20): 7.9s measured — the loss-decrease training loop
+# rides slow; forward shapes/aux, capacity drops, EP-sharding parity and the
+# dense-config guard keep MoE correctness in tier-1
+@pytest.mark.slow
 def test_moe_trains_loss_decreases():
     cfg = _cfg()
     mesh = make_mesh(MeshConfig(dp=2, fsdp=1, ep=2, tp=2), devices=jax.devices()[:8])

@@ -72,6 +72,13 @@ HOT_PATHS = (
      "note_pin"),
     ("ray_tpu/_private/shm_store.py", "ray_tpu._private.shm_store",
      "drop_pin"),
+    # request latency attribution plane (ISSUE 20): the phase ledger is
+    # charged on every admission / prefill chunk / decode step / preempt
+    # under the engine lock's critical sections — the stamp itself must
+    # acquire nothing (a list add + two float ops; the fold at finish
+    # pays for assembly, never the per-step charge)
+    ("ray_tpu/util/phases.py", "ray_tpu.util.phases", "new_ledger"),
+    ("ray_tpu/util/phases.py", "ray_tpu.util.phases", "charge"),
 )
 
 

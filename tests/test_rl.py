@@ -241,6 +241,11 @@ def _run_until(algo, key, threshold, max_iters):
     return best, None
 
 
+# tier-1 budget (ISSUE 20): 10.3s measured — suite growth pushed the 870s
+# command past its wall clock, so the heaviest learning (convergence) tests
+# ride the slow tier; test_ppo_training_step_smoke keeps PPO mechanics in
+# tier-1
+@pytest.mark.slow
 def test_ppo_learns_cartpole():
     """PPO must reach mean episode return >= 200 on CartPole-v1 (random play
     scores ~20) within a bounded budget — mirrors
@@ -267,6 +272,9 @@ def test_ppo_learns_cartpole():
         algo.stop()
 
 
+# tier-1 budget (ISSUE 20): 8.4s measured — convergence rides slow;
+# test_dqn_training_step_smoke_prioritized keeps DQN mechanics in tier-1
+@pytest.mark.slow
 def test_dqn_learns_cartpole():
     """DQN (double-Q + prioritized replay) must clearly beat random play on
     CartPole within a small budget."""
@@ -299,6 +307,10 @@ def test_dqn_learns_cartpole():
 # ---------------------------------------------------------------------------
 
 
+# tier-1 budget (ISSUE 20): 11.2s measured — convergence rides slow;
+# test_impala_training_step_smoke_local keeps IMPALA mechanics in tier-1 and
+# test_env_runner_fault_tolerance keeps the async-runner plumbing gated
+@pytest.mark.slow
 def test_impala_async_runners_learn(ray_start_regular):
     """IMPALA with 2 remote env-runner actors: async futures pipeline works
     and the policy improves (loose threshold — the point is the plumbing)."""
@@ -414,6 +426,9 @@ def test_multi_agent_vector_env_slots():
     assert trunc.all()
 
 
+# tier-1 budget (ISSUE 20): ~7s measured — convergence rides slow;
+# test_multi_agent_vector_env_slots keeps the multi-agent plumbing in tier-1
+@pytest.mark.slow
 def test_shared_policy_ppo_learns_multi_agent():
     """PPO trains ONE shared policy over all agents of a MultiAgentEnv via
     the slot-flattened vector view; coordination reward improves toward the
